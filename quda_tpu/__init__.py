@@ -1,0 +1,25 @@
+"""quda_tpu — a TPU-native lattice QCD framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of QUDA
+(https://github.com/lattice/quda): Dirac stencils, mixed-precision Krylov
+solvers, adaptive multigrid, eigensolvers, and the HMC gauge sector —
+built on sharded jax.Arrays over a 4-D device mesh with XLA collectives
+for halo exchange.
+
+Subpackages
+-----------
+fields    lattice geometry, ColorSpinorField / GaugeField / CloverField
+ops       stencils, BLAS/reductions, SU(3) algebra, gamma algebra
+models    Dirac operator classes (Wilson, clover, twisted, staggered, DWF...)
+solvers   CG family, BiCGStab(L), GCR, CA solvers, multi-shift, mixed prec
+mg        adaptive multigrid (transfer, coarse ops, V-cycle)
+eig       TRLM / IRAM eigensolvers, Chebyshev acceleration, deflation
+gauge     HMC forces, smearing, gauge fixing, observables, heatbath
+parallel  device mesh, sharding layouts, halo exchange
+utils     tuning cache, profiling, RNG, I/O, checkpointing
+interfaces  C-ABI shim and MILC-style entry points
+"""
+
+__version__ = "0.1.0"
+
+from .fields.geometry import EVEN, FULL, ODD, LatticeGeometry  # noqa: F401

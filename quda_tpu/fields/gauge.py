@@ -1,0 +1,60 @@
+"""GaugeField: SU(3) link fields as sharded jax.Arrays.
+
+TPU-native re-design of QUDA's GaugeField (reference: include/gauge_field.h:151,
+lib/gauge_field.cpp).  Canonical layout is ``(4, T, Z, Y, X, 3, 3)`` complex
+with the direction axis leading (mu = 0,1,2,3 = x,y,z,t).  QUDA's
+reconstruct-12/8 compression (include/gauge_field_order.h) is deliberately
+NOT the default on TPU: the stencils are HBM-bandwidth bound, but XLA prefers
+dense tiles and recomputing the third row costs transcendental-free FLOPs we
+can spend — a reconstruct-12 storage codec lives in ops/reconstruct.py for
+the memory-limited cases instead of being wired through every accessor.
+
+Halos: there is no ghost-buffer machinery here (lattice_field.h:250-440).
+Sharded shifts go through parallel/halo.py (collective_permute under
+shard_map) or plain jnp.roll on a single device — XLA owns the exchange.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import su3
+from .geometry import FULL, LatticeGeometry
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class GaugeField:
+    data: jax.Array  # (4, T, Z, Y, X, 3, 3)
+    geom: LatticeGeometry = dataclasses.field(metadata=dict(static=True))
+    ncolor: int = dataclasses.field(default=3, metadata=dict(static=True))
+
+    @classmethod
+    def unit(cls, geom: LatticeGeometry, dtype=jnp.complex128):
+        data = su3.unit_gauge((4,) + geom.lattice_shape, dtype)
+        return cls(data, geom)
+
+    @classmethod
+    def random(cls, key, geom: LatticeGeometry, dtype=jnp.complex128,
+               scale: float = 0.7):
+        """Random SU(3) configuration (tests/utils/host_utils.cpp:1022 analog)."""
+        data = su3.random_su3(key, (4,) + geom.lattice_shape, dtype, scale)
+        return cls(data, geom)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def mu(self, mu: int) -> jax.Array:
+        """Links in direction mu: (T,Z,Y,X,3,3)."""
+        return self.data[mu]
+
+    def like(self, data: jax.Array) -> "GaugeField":
+        return GaugeField(data, self.geom, self.ncolor)
+
+    def astype(self, dtype) -> "GaugeField":
+        return self.like(self.data.astype(dtype))

@@ -1,0 +1,113 @@
+"""Lattice geometry: dimensions, axis conventions, parity decomposition.
+
+Replaces QUDA's LatticeField geometry bookkeeping
+(reference: include/lattice_field.h:155, lib/lattice_field.cpp) with a small
+static (hashable) descriptor suitable for use as a jit-static argument.
+
+Conventions
+-----------
+* Array axis order for lattice fields is ``(T, Z, Y, X, *internal)`` —
+  X is the fastest-varying lattice axis (matches QUDA's x-fastest site
+  ordering, include/index_helper.cuh).
+* Directions ``mu = 0,1,2,3`` mean ``x,y,z,t`` (QUDA convention).
+  ``axis_of_mu(mu) == 3 - mu`` maps a direction onto the array axis.
+* Parity of a site is ``(x+y+z+t) % 2``; 0 = even, 1 = odd
+  (QUDA QudaParity, include/enum_quda.h).
+* Even/odd (checkerboarded) fields keep full extent in T,Z,Y and half
+  extent in X: shape ``(T, Z, Y, X//2, *internal)``.  The physical x of
+  element ``(t,z,y,xh)`` on parity ``p`` is ``2*xh + ((t+z+y+p) % 2)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import cached_property
+from typing import Tuple
+
+NDIM = 4
+
+# parity codes (QUDA QudaParity analog)
+EVEN = 0
+ODD = 1
+FULL = 2
+
+
+def axis_of_mu(mu: int) -> int:
+    """Array axis carrying direction mu (mu: 0=x,1=y,2=z,3=t)."""
+    return 3 - mu
+
+
+@dataclasses.dataclass(frozen=True)
+class LatticeGeometry:
+    """Static description of a 4-D lattice.
+
+    ``dims`` is (X, Y, Z, T) in QUDA order (lib/interface_quda.cpp uses
+    param->X[4] with X[0]=x fastest).
+    """
+
+    dims: Tuple[int, int, int, int]  # (X, Y, Z, T)
+
+    def __post_init__(self):
+        if len(self.dims) != NDIM:
+            raise ValueError(f"need 4 dims, got {self.dims}")
+        if any(d <= 0 for d in self.dims):
+            raise ValueError(f"dims must be positive: {self.dims}")
+        if self.dims[0] % 2 != 0:
+            raise ValueError(
+                f"X extent must be even for even/odd decomposition: {self.dims}")
+
+    # -- basic sizes ---------------------------------------------------
+    @property
+    def X(self) -> int:
+        return self.dims[0]
+
+    @property
+    def Y(self) -> int:
+        return self.dims[1]
+
+    @property
+    def Z(self) -> int:
+        return self.dims[2]
+
+    @property
+    def T(self) -> int:
+        return self.dims[3]
+
+    @cached_property
+    def volume(self) -> int:
+        v = 1
+        for d in self.dims:
+            v *= d
+        return v
+
+    @property
+    def half_volume(self) -> int:
+        return self.volume // 2
+
+    @cached_property
+    def lattice_shape(self) -> Tuple[int, int, int, int]:
+        """Array shape of the lattice axes: (T, Z, Y, X)."""
+        return (self.T, self.Z, self.Y, self.X)
+
+    @cached_property
+    def half_lattice_shape(self) -> Tuple[int, int, int, int]:
+        """Array shape of checkerboarded lattice axes: (T, Z, Y, X//2)."""
+        return (self.T, self.Z, self.Y, self.X // 2)
+
+    def extent(self, mu: int) -> int:
+        """Extent along direction mu (0=x..3=t)."""
+        return self.dims[mu]
+
+    # -- shapes with internal dof --------------------------------------
+    def spinor_shape(self, nspin: int = 4, ncolor: int = 3):
+        return self.lattice_shape + (nspin, ncolor)
+
+    def half_spinor_shape(self, nspin: int = 4, ncolor: int = 3):
+        return self.half_lattice_shape + (nspin, ncolor)
+
+    def gauge_shape(self, ncolor: int = 3):
+        """(mu, T, Z, Y, X, c, c) — one SU(N) link per direction per site."""
+        return (NDIM,) + self.lattice_shape + (ncolor, ncolor)
+
+    def __str__(self):
+        return f"LatticeGeometry(X={self.X},Y={self.Y},Z={self.Z},T={self.T})"

@@ -1,0 +1,149 @@
+"""ColorSpinorField: fermion fields as sharded jax.Arrays.
+
+TPU-native re-design of QUDA's ColorSpinorField
+(reference: include/color_spinor_field.h:287, lib/color_spinor_field.cpp).
+Instead of layout-polymorphic accessor templates
+(include/color_spinor_field_order.h) we keep ONE canonical layout —
+``(T, Z, Y, X, spin, color)`` complex, or the checkerboarded half-lattice
+variant ``(T, Z, Y, X//2, spin, color)`` — and let XLA pick physical tiling.
+Multi-RHS ("composite" fields, color_spinor_field.h:93-120) are a leading
+batch axis, not a C++ descriptor.
+
+The class is a registered pytree: `data` is traced, everything else static,
+so fields pass through jit/shard_map/scan directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .geometry import EVEN, FULL, ODD, LatticeGeometry
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ColorSpinorField:
+    data: jax.Array  # (..., T, Z, Y, X[/2], spin, color)
+    geom: LatticeGeometry = dataclasses.field(metadata=dict(static=True))
+    parity: int = dataclasses.field(default=FULL, metadata=dict(static=True))
+    nspin: int = dataclasses.field(default=4, metadata=dict(static=True))
+    ncolor: int = dataclasses.field(default=3, metadata=dict(static=True))
+
+    # -- construction --------------------------------------------------
+    @classmethod
+    def zeros(cls, geom: LatticeGeometry, parity: int = FULL, nspin: int = 4,
+              ncolor: int = 3, dtype=jnp.complex128, batch: Tuple[int, ...] = ()):
+        shape = batch + cls._site_shape(geom, parity) + (nspin, ncolor)
+        return cls(jnp.zeros(shape, dtype), geom, parity, nspin, ncolor)
+
+    @classmethod
+    def gaussian(cls, key, geom: LatticeGeometry, parity: int = FULL,
+                 nspin: int = 4, ncolor: int = 3, dtype=jnp.complex128,
+                 batch: Tuple[int, ...] = ()):
+        """Gaussian noise source (reference: lib/spinor_noise.in.cu)."""
+        shape = batch + cls._site_shape(geom, parity) + (nspin, ncolor)
+        rdt = jnp.zeros((), dtype).real.dtype
+        k1, k2 = jax.random.split(key)
+        re = jax.random.normal(k1, shape, rdt)
+        im = jax.random.normal(k2, shape, rdt)
+        return cls((re + 1j * im).astype(dtype) / jnp.sqrt(2.0).astype(rdt),
+                   geom, parity, nspin, ncolor)
+
+    @classmethod
+    def point(cls, geom: LatticeGeometry, site=(0, 0, 0, 0), spin: int = 0,
+              color: int = 0, nspin: int = 4, ncolor: int = 3,
+              dtype=jnp.complex128):
+        """Point source delta_{x,site} delta_{s,spin} delta_{c,color}."""
+        x, y, z, t = site
+        data = jnp.zeros(geom.spinor_shape(nspin, ncolor), dtype)
+        data = data.at[t, z, y, x, spin, color].set(1.0)
+        return cls(data, geom, FULL, nspin, ncolor)
+
+    @staticmethod
+    def _site_shape(geom: LatticeGeometry, parity: int):
+        return geom.lattice_shape if parity == FULL else geom.half_lattice_shape
+
+    # -- views ---------------------------------------------------------
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def is_full(self) -> bool:
+        return self.parity == FULL
+
+    def like(self, data: jax.Array) -> "ColorSpinorField":
+        return ColorSpinorField(data, self.geom, self.parity, self.nspin,
+                                self.ncolor)
+
+    def astype(self, dtype) -> "ColorSpinorField":
+        return self.like(self.data.astype(dtype))
+
+    # -- reductions (thin wrappers; solver hot loops use ops.blas) -----
+    def norm2(self):
+        d = self.data
+        return jnp.sum(d.real * d.real + d.imag * d.imag)
+
+    def dot(self, other: "ColorSpinorField"):
+        return jnp.sum(jnp.conjugate(self.data) * other.data)
+
+
+def even_odd_split(full: jax.Array, geom: LatticeGeometry):
+    """Split a full-lattice site array into (even, odd) checkerboard halves.
+
+    Layout rule (fields/geometry.py): element (t,z,y,xh) of the parity-p
+    half-field holds the physical site x = 2*xh + ((t+z+y+p) % 2).
+    Equivalent to QUDA's even/odd subsets (color_spinor_field.h Even()/Odd()).
+    Works for any trailing internal shape; the lattice axes must be the
+    leading four axes of `full` after optional batch axes are vmapped away.
+    """
+    T, Z, Y, X = geom.lattice_shape
+    lead = full.ndim - 4 - _n_internal(full, geom)
+    assert lead == 0, "batch axes: vmap even_odd_split"
+    t, z, y = _tzy_grids(geom, full.dtype)
+    # shift rows of odd (t+z+y) so that even sites land at even x-slots
+    xh = X // 2
+    resh = full.reshape((T, Z, Y, xh, 2) + full.shape[4:])
+    # site (t,z,y,2*xh+r): parity = (t+z+y+r)%2
+    s = ((t + z + y) % 2).astype(jnp.int32)  # (T,Z,Y)
+    idx = jnp.broadcast_to(s[..., None], (T, Z, Y, xh))
+    mask = _expand(idx == 0, resh[:, :, :, :, 0].ndim)
+    even = jnp.where(mask, resh[:, :, :, :, 0], resh[:, :, :, :, 1])
+    odd = jnp.where(mask, resh[:, :, :, :, 1], resh[:, :, :, :, 0])
+    return even, odd
+
+
+def even_odd_join(even: jax.Array, odd: jax.Array, geom: LatticeGeometry):
+    """Inverse of even_odd_split."""
+    T, Z, Y, X = geom.lattice_shape
+    t, z, y = _tzy_grids(geom, even.dtype)
+    idx = jnp.broadcast_to(((t + z + y) % 2).astype(jnp.int32)[..., None],
+                           (T, Z, Y, X // 2))
+    mask = _expand(idx == 0, even.ndim)
+    slot0 = jnp.where(mask, even, odd)   # physical x even slot content
+    slot1 = jnp.where(mask, odd, even)
+    full = jnp.stack([slot0, slot1], axis=4)
+    return full.reshape((T, Z, Y, X) + even.shape[4:])
+
+
+def _tzy_grids(geom: LatticeGeometry, dtype):
+    T, Z, Y, _ = geom.lattice_shape
+    t = jnp.arange(T)[:, None, None]
+    z = jnp.arange(Z)[None, :, None]
+    y = jnp.arange(Y)[None, None, :]
+    return t, z, y
+
+
+def _expand(mask, ndim):
+    while mask.ndim < ndim:
+        mask = mask[..., None]
+    return mask
+
+
+def _n_internal(arr, geom):
+    # internal axes = everything after the 4 lattice axes
+    return arr.ndim - 4

@@ -1,0 +1,75 @@
+"""Dirac operator base classes — the algebra objects solvers act on.
+
+TPU-native analog of QUDA's Dirac hierarchy (include/dirac_quda.h:156-420,
+factory lib/dirac.cpp:145).  A Dirac instance owns immutable operator data
+(gauge links, clover, masses) and exposes pure functions M / Mdag / MdagM
+that close over it — directly jittable and scan-able.  QUDA's wrapper
+functors DiracM/DiracMdagM/DiracG5M (include/dirac_quda.h:145-151) become
+plain method references.
+
+Preconditioned (PC) operators act on half-lattice (checkerboarded) arrays;
+``prepare``/``reconstruct`` implement the even/odd Schur complement source
+preparation and solution reconstruction (lib/dirac_wilson.cpp prepare /
+reconstruct and friends).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..fields.geometry import EVEN, ODD, LatticeGeometry
+
+# QudaMatPCType analog
+MATPC_EVEN_EVEN = EVEN
+MATPC_ODD_ODD = ODD
+
+
+def apply_gamma5(psi: jnp.ndarray) -> jnp.ndarray:
+    """gamma5 psi in the DeGrand-Rossi basis: diag(+1,+1,-1,-1) on spin."""
+    sign = jnp.array([1.0, 1.0, -1.0, -1.0], dtype=psi.real.dtype)
+    return psi * sign[:, None].astype(psi.dtype)
+
+
+class Dirac:
+    """Base: gamma5-hermitian lattice Dirac operator (full or PC)."""
+
+    geom: LatticeGeometry
+    hermitian = False        # True for operators where M == Mdag (e.g. MdagM wrap)
+    g5_hermitian = True      # gamma5 M gamma5 == Mdag
+
+    def M(self, psi):
+        raise NotImplementedError
+
+    def Mdag(self, psi):
+        if self.g5_hermitian:
+            return apply_gamma5(self.M(apply_gamma5(psi)))
+        raise NotImplementedError
+
+    def MdagM(self, psi):
+        return self.Mdag(self.M(psi))
+
+    def MMdag(self, psi):
+        return self.M(self.Mdag(psi))
+
+    # normal-op wrapper used by CG (DiracMdagM functor analog)
+    @property
+    def normal(self):
+        return self.MdagM
+
+    def flops_per_site_M(self) -> int:
+        """Flop count of one M application per lattice site (for perf)."""
+        return 0
+
+
+class DiracPC(Dirac):
+    """Even/odd preconditioned operator acting on one parity."""
+
+    matpc: int = MATPC_EVEN_EVEN
+
+    def prepare(self, b_even, b_odd):
+        """Return the PC right-hand side from a full source (b_e, b_o)."""
+        raise NotImplementedError
+
+    def reconstruct(self, x_p, b_even, b_odd):
+        """Return (x_e, x_o) full solution from the PC solution x_p."""
+        raise NotImplementedError

@@ -1,0 +1,59 @@
+"""Fermion boundary conditions folded into the gauge links.
+
+QUDA applies the temporal anti-periodic boundary (QudaGaugeParam::t_boundary,
+include/quda.h:61) and staggered phases (lib/gauge_phase.cu) by premultiplying
+links.  We do the same: it keeps every stencil purely periodic so `jnp.roll`
+(-> CollectivePermute) needs no edge special-casing.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields.geometry import LatticeGeometry
+
+
+def apply_t_boundary(gauge: jnp.ndarray, geom: LatticeGeometry,
+                     sign: int = -1) -> jnp.ndarray:
+    """Multiply the t-links on the last time slice by ``sign``.
+
+    With periodic shifts this implements (anti)periodic fermion BCs.
+    gauge: (4, T, Z, Y, X, 3, 3).
+    """
+    if sign == 1:
+        return gauge
+    t_links = gauge[3]
+    t_links = t_links.at[geom.T - 1].multiply(sign)
+    return gauge.at[3].set(t_links)
+
+
+def staggered_phases_milc(geom: LatticeGeometry) -> np.ndarray:
+    """MILC-convention staggered phases eta_mu(x) (lib/gauge_phase.cu:70).
+
+    eta_x = 1, eta_y = (-1)^x, eta_z = (-1)^(x+y), eta_t = (-1)^(x+y+z).
+    Returns (4, T, Z, Y, X) float array of +-1.
+    """
+    T, Z, Y, X = geom.lattice_shape
+    t = np.arange(T)[:, None, None, None]
+    z = np.arange(Z)[None, :, None, None]
+    y = np.arange(Y)[None, None, :, None]
+    x = np.arange(X)[None, None, None, :]
+    ones = np.ones((T, Z, Y, X))
+    eta = np.stack([
+        ones,
+        (-1.0) ** x * ones,
+        (-1.0) ** (x + y) * ones,
+        (-1.0) ** (x + y + z) * ones,
+    ])
+    return eta
+
+
+def apply_staggered_phases(gauge: jnp.ndarray, geom: LatticeGeometry,
+                           antiperiodic_t: bool = True) -> jnp.ndarray:
+    """Fold MILC staggered phases (and optional antiperiodic-t) into links."""
+    eta = jnp.asarray(staggered_phases_milc(geom))
+    out = gauge * eta[..., None, None].astype(gauge.dtype)
+    if antiperiodic_t:
+        out = apply_t_boundary(out, geom, -1)
+    return out

@@ -1,0 +1,84 @@
+"""Nearest-neighbour (and n-hop) lattice shifts, full and checkerboarded.
+
+This is the TPU replacement for QUDA's ghost-zone machinery: the halo
+pack/exchange/scatter pipeline (lib/dslash_pack2.cu, include/lattice_field.h
+ghost buffers, lib/dslash_policy.hpp) collapses into `jnp.roll`, which XLA
+lowers to a CollectivePermute on sharded axes (parallel/halo.py wires the
+explicit shard_map variant) and into a cheap copy on local axes.
+
+Index convention (fields/geometry.py): array axes are (T,Z,Y,X,...) with
+mu = 0,1,2,3 = x,y,z,t; ``shift(psi, mu, +1)[x] == psi[x + mu_hat]``.
+
+Checkerboarded shifts: with the half-lattice layout
+``x = 2*xh + ((t+z+y+p) % 2)`` a shift along y/z/t keeps xh fixed and only
+rolls the corresponding axis; a shift along x rolls xh only on the sites
+whose slot wraps, selected by the (t,z,y,parity) mask.  This mirrors what
+QUDA's index helpers do arithmetically per-thread
+(include/index_helper.cuh coordsFromIndex / getNeighborIndexCB) but as a
+branch-free vector select.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields.geometry import LatticeGeometry, axis_of_mu
+
+
+def shift(arr: jnp.ndarray, mu: int, sign: int, nhop: int = 1) -> jnp.ndarray:
+    """Full-lattice shift: result[x] = arr[x + sign*nhop*mu_hat] (periodic).
+
+    Lattice axes are assumed to be the first four axes of ``arr``.
+    """
+    return jnp.roll(arr, -sign * nhop, axis=axis_of_mu(mu))
+
+
+@lru_cache(maxsize=None)
+def _slot_mask(geom: LatticeGeometry, parity: int, n_internal: int):
+    """Boolean mask over (T,Z,Y,1,[1]*n_internal): True where the parity-p
+    half-site at (t,z,y,xh) occupies the even x slot (r == 0)."""
+    T, Z, Y, _ = geom.lattice_shape
+    t = np.arange(T)[:, None, None]
+    z = np.arange(Z)[None, :, None]
+    y = np.arange(Y)[None, None, :]
+    r = (t + z + y + parity) % 2
+    mask = (r == 0)[..., None]
+    mask = mask.reshape(mask.shape + (1,) * n_internal)
+    return jnp.asarray(mask)
+
+
+def shift_eo(arr: jnp.ndarray, geom: LatticeGeometry, mu: int, sign: int,
+             target_parity: int, nhop: int = 1) -> jnp.ndarray:
+    """Checkerboarded shift.
+
+    ``arr`` holds a half-lattice field of parity ``1 - target_parity`` when
+    nhop is odd (``target_parity`` when even); the result, indexed by
+    parity-``target_parity`` half-sites, is ``arr`` evaluated at
+    ``x + sign*nhop*mu_hat``.
+    """
+    ax = axis_of_mu(mu)
+    if mu != 0:
+        return jnp.roll(arr, -sign * nhop, axis=ax)
+    # x direction: roll pattern depends on slot parity r of the target site
+    n_int = arr.ndim - 4
+    mask_r0 = _slot_mask(geom, target_parity, n_int)
+    if nhop % 2 == 0:
+        return jnp.roll(arr, -sign * (nhop // 2), axis=3)
+    k = (nhop - 1) // 2  # odd hop = k full slots + one slot-parity flip
+    base = jnp.roll(arr, -sign * k, axis=3)
+    moved = jnp.roll(base, -sign, axis=3)
+    if sign > 0:
+        # target slot r==0 -> neighbour in same xh; r==1 -> next xh
+        return jnp.where(mask_r0, base, moved)
+    else:
+        # target slot r==1 -> same xh; r==0 -> previous xh
+        return jnp.where(mask_r0, moved, base)
+
+
+def shift_gauge_eo(gauge_mu: jnp.ndarray, geom: LatticeGeometry, mu: int,
+                   sign: int, target_parity: int, nhop: int = 1) -> jnp.ndarray:
+    """Same as shift_eo but for a (T,Z,Y,X//2,3,3) half-lattice link array."""
+    return shift_eo(gauge_mu, geom, mu, sign, target_parity, nhop)

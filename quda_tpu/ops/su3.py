@@ -1,0 +1,113 @@
+"""SU(N) matrix utilities: random links, projection, exponential map.
+
+Covers what QUDA spreads across lib/gauge_random.cu (Gaussian momenta /
+random links), include/svd_quda.h + lib/unitarize_links_quda.cu
+(reunitarization), and the exponentiation inside lib/gauge_update_quda.cu.
+All functions are batched over arbitrary leading axes — fields pass their
+(T,Z,Y,X) site axes straight through; XLA maps the small (3,3) algebra onto
+the VPU/MXU without per-site loops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Gell-Mann matrices (su(3) generators, T_a = lambda_a / 2).
+import numpy as np
+
+_l = np.zeros((8, 3, 3), dtype=np.complex128)
+_l[0, 0, 1] = _l[0, 1, 0] = 1
+_l[1, 0, 1] = -1j
+_l[1, 1, 0] = 1j
+_l[2, 0, 0] = 1
+_l[2, 1, 1] = -1
+_l[3, 0, 2] = _l[3, 2, 0] = 1
+_l[4, 0, 2] = -1j
+_l[4, 2, 0] = 1j
+_l[5, 1, 2] = _l[5, 2, 1] = 1
+_l[6, 1, 2] = -1j
+_l[6, 2, 1] = 1j
+_l[7, 0, 0] = _l[7, 1, 1] = 1 / np.sqrt(3)
+_l[7, 2, 2] = -2 / np.sqrt(3)
+GELL_MANN = _l
+
+
+def dagger(m: jnp.ndarray) -> jnp.ndarray:
+    """Hermitian conjugate over the trailing (c,c) axes."""
+    return jnp.conjugate(jnp.swapaxes(m, -1, -2))
+
+
+def mat_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.einsum("...ab,...bc->...ac", a, b)
+
+
+def trace(m: jnp.ndarray) -> jnp.ndarray:
+    return jnp.einsum("...aa->...", m)
+
+
+def random_hermitian_traceless(key, shape, n=3, dtype=jnp.complex128):
+    """Gaussian traceless Hermitian matrices H = sum_a xi_a T_a, xi~N(0,1).
+
+    This is the HMC momentum distribution (reference: lib/gauge_random.cu
+    gaussGaugeQuda with the momentum flag).
+    """
+    real_dtype = jnp.finfo(dtype).dtype if jnp.issubdtype(
+        dtype, jnp.floating) else jnp.real(jnp.zeros((), dtype)).dtype
+    xi = jax.random.normal(key, shape + (8,), dtype=real_dtype)
+    gen = jnp.asarray(GELL_MANN / 2.0, dtype=dtype)
+    return jnp.einsum("...a,aij->...ij", xi.astype(dtype), gen)
+
+
+def expm_su3(h: jnp.ndarray, order: int = 16) -> jnp.ndarray:
+    """exp(i h) for (batched) Hermitian h via scaling-and-squaring Taylor.
+
+    Used for the HMC gauge update U <- exp(i eps p) U (reference:
+    lib/gauge_update_quda.cu, kernels/gauge_update.cuh) and stout smearing.
+    A fixed 6-squaring/Taylor scheme is exact to machine precision for the
+    step sizes HMC uses and is branch-free (jit/TPU friendly).
+    """
+    x = 1j * h / (2.0 ** 6)
+    eye = jnp.broadcast_to(jnp.eye(h.shape[-1], dtype=h.dtype), h.shape)
+    term = eye
+    acc = eye
+    for k in range(1, order):
+        term = mat_mul(term, x) / k
+        acc = acc + term
+    for _ in range(6):
+        acc = mat_mul(acc, acc)
+    return acc
+
+
+def random_su3(key, shape, dtype=jnp.complex128, scale: float = 1.0):
+    """Random SU(3) links: exp(i * scale * H) with H Gaussian in su(3).
+
+    scale ~ 0.5-1 gives a "hot" disordered configuration; small scale gives
+    links near identity (QUDA tests' weak-field configs,
+    tests/utils/host_utils.cpp:1022 constructs random SU(3) similarly).
+    """
+    h = random_hermitian_traceless(key, shape, dtype=dtype)
+    return expm_su3(scale * h)
+
+
+def project_su3(u: jnp.ndarray, iters: int = 2) -> jnp.ndarray:
+    """Project a near-SU(3) matrix back onto SU(3).
+
+    Polar-type projection: W = U (U^dag U)^{-1/2} via Newton iteration for
+    the inverse square root, then fix det to 1 by phase division.  This is
+    the TPU-friendly replacement for QUDA's SVD-based reunitarization
+    (include/svd_quda.h:616) for links that are already close to unitary
+    (smearing / gauge updates).  HISQ force differentiation uses its own
+    routine in gauge/hisq.py.
+    """
+    w = u
+    for _ in range(iters + 2):
+        # Newton iteration for polar decomposition: w <- 0.5 (w + w^-dag)
+        w = 0.5 * (w + jnp.linalg.inv(dagger(w)))
+    det = jnp.linalg.det(w)
+    phase = det ** (-1.0 / 3.0)
+    return w * phase[..., None, None]
+
+
+def unit_gauge(shape, dtype=jnp.complex128):
+    return jnp.broadcast_to(jnp.eye(3, dtype=dtype), shape + (3, 3))

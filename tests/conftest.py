@@ -1,0 +1,34 @@
+"""Test harness config: CPU backend, 8 virtual devices, float64 enabled.
+
+Mirrors QUDA's test strategy (SURVEY.md §4): correctness runs against host
+references with double precision available, and multi-"chip" paths are
+exercised on a virtual 8-device CPU mesh (the strictly-better analog of
+QUDA's single no-op communicator + mpirun -np N on one node).
+"""
+
+import os
+
+# Must be set before the backend initialises; the axon TPU plugin ignores
+# JAX_PLATFORMS, so the platform itself is forced via jax.config below.
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(7)

@@ -1,0 +1,64 @@
+"""Independent NumPy host reference for the Wilson dslash.
+
+Analog of tests/host_reference/wilson_dslash_reference.cpp in the reference:
+a deliberately different implementation style (explicit per-site neighbour
+index arithmetic, no jnp.roll) so shift-direction or parity bugs in the
+device path cannot cancel out.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# gamma matrices duplicated here on purpose (independent of quda_tpu.ops.gamma)
+_i = 1j
+GX = np.array([[0, 0, 0, _i], [0, 0, _i, 0], [0, -_i, 0, 0], [-_i, 0, 0, 0]])
+GY = np.array([[0, 0, 0, -1], [0, 0, 1, 0], [0, 1, 0, 0], [-1, 0, 0, 0]])
+GZ = np.array([[0, 0, _i, 0], [0, 0, 0, -_i], [-_i, 0, 0, 0], [0, _i, 0, 0]])
+GT = np.array([[0, 0, 1, 0], [0, 0, 0, 1], [1, 0, 0, 0], [0, 1, 0, 0]])
+GAMMA = [GX, GY, GZ, GT]
+ID4 = np.eye(4)
+
+
+def wilson_dslash_ref(gauge: np.ndarray, psi: np.ndarray,
+                      antiperiodic_t: bool = True) -> np.ndarray:
+    """D psi with D = sum_mu [(1-g_mu) U_mu(x) psi(x+mu)
+                             + (1+g_mu) U_mu^dag(x-mu) psi(x-mu)].
+
+    gauge: (4,T,Z,Y,X,3,3) WITHOUT boundary phases folded in;
+    psi: (T,Z,Y,X,4,3).  Site loop implementation.
+    """
+    T, Z, Y, X = psi.shape[:4]
+    out = np.zeros_like(psi)
+    for t in range(T):
+        for z in range(Z):
+            for y in range(Y):
+                for x in range(X):
+                    acc = np.zeros((4, 3), dtype=psi.dtype)
+                    coords = (x, y, z, t)
+                    for mu in range(4):
+                        fwd = list(coords)
+                        fwd[mu] = (coords[mu] + 1) % psi.shape[3 - mu]
+                        bwd = list(coords)
+                        bwd[mu] = (coords[mu] - 1) % psi.shape[3 - mu]
+                        xf, yf, zf, tf = fwd
+                        xb, yb, zb, tb = bwd
+                        u = gauge[mu, t, z, y, x]
+                        ub = gauge[mu, tb, zb, yb, xb]
+                        sf = 1.0
+                        sb = 1.0
+                        if antiperiodic_t and mu == 3:
+                            if coords[3] == T - 1:
+                                sf = -1.0
+                            if coords[3] == 0:
+                                sb = -1.0
+                        pf = psi[tf, zf, yf, xf]  # (4,3)
+                        pb = psi[tb, zb, yb, xb]
+                        acc += sf * (ID4 - GAMMA[mu]) @ pf @ u.T
+                        acc += sb * (ID4 + GAMMA[mu]) @ pb @ ub.conj()
+                    out[t, z, y, x] = acc
+    return out
+
+
+def wilson_mat_ref(gauge, psi, kappa, antiperiodic_t=True):
+    return psi - kappa * wilson_dslash_ref(gauge, psi, antiperiodic_t)

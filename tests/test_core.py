@@ -1,0 +1,99 @@
+"""Core correctness: gamma algebra, SU(3) utilities, even/odd layout, shifts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import EVEN, ODD, LatticeGeometry
+from quda_tpu.fields.spinor import ColorSpinorField, even_odd_join, even_odd_split
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.ops import gamma as g
+from quda_tpu.ops import su3
+from quda_tpu.ops.shift import shift, shift_eo
+
+GEOM = LatticeGeometry((4, 4, 4, 8))  # X,Y,Z,T
+
+
+def test_clifford_algebra():
+    g.check_clifford()
+    assert np.allclose(g.GAMMA_5, np.diag([1, 1, -1, -1]))
+
+
+def test_sigma_antisymmetric():
+    for mu in range(4):
+        assert np.allclose(g.SIGMA[mu, mu], 0)
+        for nu in range(4):
+            assert np.allclose(g.SIGMA[mu, nu], -g.SIGMA[nu, mu])
+
+
+def test_random_su3(key):
+    u = su3.random_su3(key, (5,))
+    eye = np.eye(3)
+    prod = np.asarray(su3.mat_mul(u, su3.dagger(u)))
+    assert np.allclose(prod, np.broadcast_to(eye, (5, 3, 3)), atol=1e-12)
+    assert np.allclose(np.asarray(jnp.linalg.det(u)), 1.0, atol=1e-12)
+
+
+def test_project_su3(key):
+    u = su3.random_su3(key, (4,))
+    noisy = u + 0.05 * (jax.random.normal(jax.random.PRNGKey(3), (4, 3, 3))
+                        + 1j * jax.random.normal(jax.random.PRNGKey(4), (4, 3, 3)))
+    w = su3.project_su3(noisy)
+    assert np.allclose(np.asarray(su3.mat_mul(w, su3.dagger(w))),
+                       np.broadcast_to(np.eye(3), (4, 3, 3)), atol=1e-10)
+    assert np.allclose(np.asarray(jnp.linalg.det(w)), 1.0, atol=1e-10)
+
+
+def test_even_odd_roundtrip(key):
+    psi = ColorSpinorField.gaussian(key, GEOM)
+    e, o = even_odd_split(psi.data, GEOM)
+    back = even_odd_join(e, o, GEOM)
+    assert np.array_equal(np.asarray(back), np.asarray(psi.data))
+
+
+def test_even_odd_parity_content(key):
+    """Even half-field must contain exactly the sites with (x+y+z+t)%2==0."""
+    T, Z, Y, X = GEOM.lattice_shape
+    t, z, y, x = np.meshgrid(np.arange(T), np.arange(Z), np.arange(Y),
+                             np.arange(X), indexing="ij")
+    par = (x + y + z + t) % 2
+    full = jnp.asarray(par).astype(jnp.complex128)[..., None, None]
+    full = jnp.broadcast_to(full, GEOM.spinor_shape()).copy()
+    e, o = even_odd_split(full, GEOM)
+    assert np.allclose(np.asarray(e), 0.0)
+    assert np.allclose(np.asarray(o), 1.0)
+
+
+@pytest.mark.parametrize("mu", [0, 1, 2, 3])
+@pytest.mark.parametrize("sign", [+1, -1])
+def test_shift_full_matches_indexing(mu, sign, key):
+    psi = jax.random.normal(key, GEOM.lattice_shape)
+    s = shift(psi, mu, sign)
+    ref = np.roll(np.asarray(psi), -sign, axis=3 - mu)
+    assert np.array_equal(np.asarray(s), ref)
+
+
+@pytest.mark.parametrize("mu", [0, 1, 2, 3])
+@pytest.mark.parametrize("sign", [+1, -1])
+@pytest.mark.parametrize("parity", [EVEN, ODD])
+@pytest.mark.parametrize("nhop", [1, 2, 3])
+def test_shift_eo_matches_full(mu, sign, parity, nhop, key):
+    """shift_eo on half-fields == split(shift(full)) on the target parity."""
+    psi = ColorSpinorField.gaussian(key, GEOM).data
+    e, o = even_odd_split(psi, GEOM)
+    full_shifted = shift(psi, mu, sign, nhop)
+    se, so = even_odd_split(full_shifted, GEOM)
+    want = se if parity == EVEN else so
+    src = (e, o)[parity] if nhop % 2 == 0 else (e, o)[1 - parity]
+    got = shift_eo(src, GEOM, mu, sign, parity, nhop)
+    assert np.allclose(np.asarray(got), np.asarray(want))
+
+
+def test_gauge_split_roundtrip(key):
+    gf = GaugeField.random(key, GEOM)
+    from quda_tpu.ops.wilson import split_gauge_eo
+    ge, go = split_gauge_eo(gf.data, GEOM)
+    for mu in range(4):
+        back = even_odd_join(ge[mu], go[mu], GEOM)
+        assert np.array_equal(np.asarray(back), np.asarray(gf.data[mu]))
