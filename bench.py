@@ -1,0 +1,89 @@
+"""Headline benchmark: Wilson dslash GFLOPS on one chip.
+
+Prints ONE JSON line:
+  {"metric": "wilson_dslash_gflops_chip", "value": N, "unit": "GFLOPS",
+   "vs_baseline": N}
+
+Baseline: 1400 GFLOPS — the order of public A100 single-precision Wilson
+dslash results (BASELINE.md: target is "within 2x of A100", so
+vs_baseline >= 0.5 meets the target).
+
+Flop model: 1320 flops/site (Dslash::flops(), reference include/dslash.h:475).
+Runs complex64 (TPU has no f64); the dslash is HBM-bandwidth bound so c64 is
+the honest precision to compare against single-precision GPU numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main():
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("QUDA_TPU_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    try:
+        devs = jax.devices()
+        platform = devs[0].platform
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        platform = "cpu"
+
+    from quda_tpu.fields.geometry import LatticeGeometry
+    from quda_tpu.fields.gauge import GaugeField
+    from quda_tpu.fields.spinor import ColorSpinorField
+    from quda_tpu.ops import wilson as wops
+    from quda_tpu.ops.boundary import apply_t_boundary
+
+    # 24^4: ~64 MB spinor + 96 MB gauge at c64 — big enough to be
+    # bandwidth-bound, small enough to compile fast over the tunnel.
+    L = 24 if platform != "cpu" else 8
+    geom = LatticeGeometry((L, L, L, L))
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    gauge = apply_t_boundary(
+        GaugeField.random(k1, geom, dtype=jnp.complex64).data, geom, -1)
+    psi = ColorSpinorField.gaussian(k2, geom, dtype=jnp.complex64).data
+
+    # steady-state form: chain dslash applications so timing covers the
+    # fused stencil, not dispatch
+    CHAIN = 10
+
+    @jax.jit
+    def apply_chain(g, p):
+        def body(v, _):
+            return wops.dslash_full(g, v), None
+        out, _ = jax.lax.scan(body, p, None, length=CHAIN)
+        return out
+
+    out = apply_chain(gauge, psi)
+    out.block_until_ready()  # compile + warmup
+
+    reps = 5
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = apply_chain(gauge, psi)
+        out.block_until_ready()
+        best = min(best, (time.perf_counter() - t0) / CHAIN)
+
+    flops = 1320 * geom.volume
+    gflops = flops / best / 1e9
+    baseline = 1400.0
+    print(json.dumps({
+        "metric": "wilson_dslash_gflops_chip",
+        "value": round(gflops, 1),
+        "unit": "GFLOPS",
+        "vs_baseline": round(gflops / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,18 @@ class DiracWilsonPC(DiracPC):
         g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
         self.gauge_eo = wops.split_gauge_eo(g, geom)
 
+    @classmethod
+    def from_eo(cls, gauge_eo, geom: LatticeGeometry, kappa: float,
+                matpc: int = MATPC_EVEN_EVEN):
+        """Construct from pre-split (even,odd) link storage (e.g. sharded
+        arrays passed through a jit boundary)."""
+        self = object.__new__(cls)
+        self.geom = geom
+        self.kappa = kappa
+        self.matpc = matpc
+        self.gauge_eo = gauge_eo
+        return self
+
     def D_to(self, psi, target_parity):
         """Hop from parity (1-target) into target parity."""
         return wops.dslash_eo(self.gauge_eo, psi, self.geom, target_parity)

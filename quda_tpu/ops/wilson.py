@@ -44,28 +44,31 @@ def _spin_mul(m, psi):
     return jnp.einsum("st,...tc->...sc", m, psi)
 
 
-def dslash_full(gauge: jnp.ndarray, psi: jnp.ndarray) -> jnp.ndarray:
+def dslash_full(gauge: jnp.ndarray, psi: jnp.ndarray,
+                shift_fn=shift) -> jnp.ndarray:
     """Full-lattice Wilson hop term D psi.
 
     gauge: (4,T,Z,Y,X,3,3) links (boundary phases pre-folded);
-    psi: (T,Z,Y,X,4,3).
+    psi: (T,Z,Y,X,4,3).  ``shift_fn`` swaps the neighbour-gather
+    implementation: global jnp.roll (default, GSPMD path) or the explicit
+    ppermute halo shift from parallel/halo.py (shard_map path).
     """
     pm, pp = _proj_consts(psi.dtype)
     out = jnp.zeros_like(psi)
     for mu in range(4):
         u = gauge[mu]
-        fwd = _color_mul(u, shift(psi, mu, +1))
+        fwd = _color_mul(u, shift_fn(psi, mu, +1))
         out = out + _spin_mul(pm[mu], fwd)
-        ub = shift(dagger(u), mu, -1)
-        bwd = _color_mul(ub, shift(psi, mu, -1))
+        ub = shift_fn(dagger(u), mu, -1)
+        bwd = _color_mul(ub, shift_fn(psi, mu, -1))
         out = out + _spin_mul(pp[mu], bwd)
     return out
 
 
-def matvec_full(gauge: jnp.ndarray, psi: jnp.ndarray,
-                kappa: float) -> jnp.ndarray:
+def matvec_full(gauge: jnp.ndarray, psi: jnp.ndarray, kappa: float,
+                shift_fn=shift) -> jnp.ndarray:
     """M psi = psi - kappa * D psi (DiracWilson::M)."""
-    return psi - kappa * dslash_full(gauge, psi)
+    return psi - kappa * dslash_full(gauge, psi, shift_fn)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,82 @@
+"""Explicit halo-exchange shifts under shard_map (the "manual policy").
+
+QUDA needs 2068 lines of policy engine (lib/dslash_policy.hpp) plus pack
+kernels (lib/dslash_pack2.cu) to overlap halo exchange with interior
+compute.  On TPU there are two policies:
+
+1. **GSPMD (default)**: run the plain jnp stencil under jit with sharded
+   inputs; XLA partitions `jnp.roll` into CollectivePermute + local slices
+   and its latency-hiding scheduler overlaps the permute with interior
+   fusion.  No code in this file is involved.
+2. **Manual (this file)**: `shard_map` with explicit `lax.ppermute` of the
+   face slices — the seam where a Pallas kernel with async remote copies
+   (NVSHMEM analog, include/dslash_shmem.h) plugs in later.
+
+`make_sharded_shift` returns a drop-in replacement for ops.shift.shift that
+is correct *inside* shard_map: local roll + boundary-face ppermute.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..fields.geometry import axis_of_mu
+from .mesh import AXES
+
+
+def _permute_slice(face, axis_name: str, towards_lower: bool, n: int):
+    """Send `face` to the neighbouring shard along axis_name.
+
+    towards_lower: shard i sends to shard i-1 (receives from i+1).
+    """
+    if towards_lower:
+        perm = [(i, (i - 1) % n) for i in range(n)]
+    else:
+        perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(face, axis_name, perm=perm)
+
+
+def make_sharded_shift(mesh) -> Callable:
+    """Build shift(arr, mu, sign, nhop=1) valid inside shard_map(mesh).
+
+    Semantics match ops.shift.shift on the GLOBAL array: result[x] =
+    arr[x + sign*nhop*mu_hat], periodic globally (the wrap rides ppermute's
+    ring).  nhop <= local extent is required (true for nFace<=3 stencils on
+    any practical shard size).
+    """
+    sizes = {name: mesh.shape[name] for name in AXES}
+
+    def shift(arr, mu: int, sign: int, nhop: int = 1):
+        ax = axis_of_mu(mu)
+        name = AXES[ax]
+        n = sizes[name]
+        rolled = jnp.roll(arr, -sign * nhop, axis=ax)
+        if n == 1:
+            return rolled
+        L = arr.shape[ax]
+        if sign > 0:
+            # need arr[x+nhop]: last nhop local slots come from next shard's
+            # first nhop slots
+            face = lax.slice_in_dim(arr, 0, nhop, axis=ax)
+            recv = _permute_slice(face, name, towards_lower=True, n=n)
+            return lax.dynamic_update_slice_in_dim(rolled, recv, L - nhop, ax)
+        else:
+            face = lax.slice_in_dim(arr, L - nhop, L, axis=ax)
+            recv = _permute_slice(face, name, towards_lower=False, n=n)
+            return lax.dynamic_update_slice_in_dim(rolled, recv, 0, ax)
+
+    return shift
+
+
+def psum_scalar(x, mesh):
+    """Global sum inside shard_map over all lattice axes (comm_allreduce).
+
+    psum over every lattice axis unconditionally — a size-1 axis is a
+    runtime no-op but is required for shard_map's static replication check.
+    """
+    return lax.psum(x, AXES)
