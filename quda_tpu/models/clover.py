@@ -1,0 +1,99 @@
+"""Wilson-clover Dirac operator (full and even/odd preconditioned).
+
+Reference behavior: lib/dirac_clover.cpp (DiracClover::M applies
+A psi - kappa D psi; DiracCloverPC uses the asymmetric Schur complement
+with the odd-block clover inverse).  Conventions:
+
+    A(x) = 1 + (kappa * csw / 2) * sum_{mu<nu} sigma_{mu nu} F_{mu nu}(x)
+    M = A - kappa * D
+
+so csw=0 reduces exactly to Wilson.  PC operator on parity p:
+
+    M_pc x = A_p x - kappa^2 D_{p q} A_q^{-1} D_{q p} x     (q = 1-p)
+    prepare:      b_pc = b_p + kappa * D_{p q} A_q^{-1} b_q
+    reconstruct:  x_q  = A_q^{-1} (b_q + kappa * D_{q p} x_p)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..fields.geometry import EVEN, LatticeGeometry
+from ..fields.spinor import even_odd_split
+from ..ops import wilson as wops
+from ..ops.boundary import apply_t_boundary
+from ..ops.clover import apply_clover, clover_blocks, invert_clover
+from .dirac import Dirac, DiracPC, MATPC_EVEN_EVEN
+
+
+class DiracClover(Dirac):
+    """Full-lattice Wilson-clover operator M = A - kappa D."""
+
+    def __init__(self, gauge: jnp.ndarray, geom: LatticeGeometry,
+                 kappa: float, csw: float, antiperiodic_t: bool = True):
+        self.geom = geom
+        self.kappa = kappa
+        self.csw = csw
+        self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        # F_munu leaves use the PHYSICAL links (no BC phase): QUDA computes
+        # the clover term before applying fermion boundary conditions.
+        self.clover = clover_blocks(gauge, kappa * csw / 2.0)
+
+    def D(self, psi):
+        return wops.dslash_full(self.gauge, psi)
+
+    def A(self, psi):
+        return apply_clover(self.clover, psi)
+
+    def M(self, psi):
+        return self.A(psi) - self.kappa * self.D(psi)
+
+    def flops_per_site_M(self) -> int:
+        return 1320 + 504 + 48  # dslash + clover (2x 6x6 matvec) + axpy
+
+
+class DiracCloverPC(DiracPC):
+    """Asymmetric even/odd preconditioned clover operator."""
+
+    def __init__(self, gauge: jnp.ndarray, geom: LatticeGeometry,
+                 kappa: float, csw: float, antiperiodic_t: bool = True,
+                 matpc: int = MATPC_EVEN_EVEN):
+        self.geom = geom
+        self.kappa = kappa
+        self.csw = csw
+        self.matpc = matpc
+        g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.gauge_eo = wops.split_gauge_eo(g, geom)
+        blocks = clover_blocks(gauge, kappa * csw / 2.0)
+        a_e, a_o = even_odd_split(blocks, geom)
+        self.clover = (a_e, a_o)
+        q = 1 - matpc
+        self.clover_inv_q = invert_clover(self.clover[q])
+
+    def D_to(self, psi, target_parity):
+        return wops.dslash_eo(self.gauge_eo, psi, self.geom, target_parity)
+
+    def A_p(self, x):
+        return apply_clover(self.clover[self.matpc], x)
+
+    def Ainv_q(self, x):
+        return apply_clover(self.clover_inv_q, x)
+
+    def M(self, x_p):
+        p = self.matpc
+        tmp = self.Ainv_q(self.D_to(x_p, 1 - p))
+        return self.A_p(x_p) - (self.kappa ** 2) * self.D_to(tmp, p)
+
+    def prepare(self, b_even, b_odd):
+        p = self.matpc
+        b_p, b_q = (b_even, b_odd) if p == EVEN else (b_odd, b_even)
+        return b_p + self.kappa * self.D_to(self.Ainv_q(b_q), p)
+
+    def reconstruct(self, x_p, b_even, b_odd):
+        p = self.matpc
+        b_q = b_odd if p == EVEN else b_even
+        x_q = self.Ainv_q(b_q + self.kappa * self.D_to(x_p, 1 - p))
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+    def flops_per_site_M(self) -> int:
+        return 2 * 1320 + 2 * 504 + 48

@@ -1,0 +1,118 @@
+"""Mixed-precision solving: reliable updates and iterative refinement.
+
+QUDA threads sloppy/precise operator pairs through every solver
+(include/invert_quda.h; reliable update logic include/reliable_updates.h:33-54
+and lib/inv_cg_quda.cpp).  The TPU precision ladder differs from CUDA's
+{double,single,half,quarter}: the compute dtypes are
+{float64 (CPU only), float32/complex64, bfloat16-pair} — see
+utils/precision.py.  Two strategies are provided:
+
+* ``cg_reliable``: QUDA-style in-loop reliable updates — iterate entirely in
+  the sloppy precision inside one lax.while_loop; when the sloppy residual
+  falls below ``delta`` * (max residual since the last update), recompute the
+  true residual with the precise operator and re-inject it (lax.cond keeps
+  this branch-free for XLA).  The whole solve is ONE compiled computation.
+
+* ``solve_refined``: outer defect-correction (iterative refinement) driving
+  any inner solver — the pattern QUDA calls refinement in multi-shift
+  (lib/inv_multi_cg_quda.cpp final refinement phase).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import blas
+from .cg import SolverResult, cg
+
+
+def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
+                sloppy_dtype, tol: float = 1e-10, maxiter: int = 2000,
+                delta: float = 0.1) -> SolverResult:
+    """Mixed-precision CG with reliable updates.
+
+    matvec_hi acts at b.dtype; matvec_lo at sloppy_dtype.  Convergence is
+    judged on the TRUE residual norm maintained through reliable updates,
+    so the returned r2 is trustworthy at the precise level.
+    """
+    b2 = blas.norm2(b)
+    stop = (tol ** 2) * b2
+
+    x = jnp.zeros_like(b)          # precise accumulated solution
+    r = b                          # precise residual
+    r2 = b2
+    r_lo = r.astype(sloppy_dtype)
+    p = r_lo
+    x_lo = jnp.zeros_like(r_lo)    # sloppy partial solution since last update
+    rdt = jnp.zeros((), b.dtype).real.dtype
+
+    def cond(c):
+        return jnp.logical_and(c["r2"] > stop, c["k"] < maxiter)
+
+    def body(c):
+        Ap = matvec_lo(c["p"])
+        pAp = blas.redot(c["p"], Ap).astype(rdt)
+        alpha = c["r2_lo"] / jnp.maximum(pAp, jnp.finfo(rdt).tiny)
+        x_lo = c["x_lo"] + alpha.astype(c["p"].dtype) * c["p"]
+        r_lo = c["r_lo"] - alpha.astype(c["p"].dtype) * Ap
+        r2_new = blas.norm2(r_lo).astype(rdt)
+        beta = r2_new / c["r2_lo"]
+        p = r_lo + beta.astype(c["p"].dtype) * c["p"]
+        r2max = jnp.maximum(c["r2max"], r2_new)
+
+        do_reliable = jnp.logical_or(r2_new < (delta ** 2) * r2max,
+                                     r2_new < stop)
+
+        def reliable(_):
+            x_new = c["x"] + x_lo.astype(c["x"].dtype)
+            r_true = c["b"] - matvec_hi(x_new)
+            r2_true = blas.norm2(r_true).astype(rdt)
+            return dict(
+                c, x=x_new, r=r_true, r2=r2_true,
+                r_lo=r_true.astype(sloppy_dtype),
+                # restart the direction at the true residual (QUDA resets
+                # beta using the new residual after a reliable update)
+                p=r_true.astype(sloppy_dtype),
+                x_lo=jnp.zeros_like(x_lo),
+                r2_lo=r2_true, r2max=r2_true, k=c["k"] + 1)
+
+        def keep(_):
+            return dict(c, p=p, r_lo=r_lo, x_lo=x_lo, r2_lo=r2_new,
+                        r2=r2_new.astype(rdt), r2max=r2max, k=c["k"] + 1)
+
+        return jax.lax.cond(do_reliable, reliable, keep, None)
+
+    init = dict(b=b, x=x, r=r, r2=r2.astype(rdt), r_lo=r_lo, p=p, x_lo=x_lo,
+                r2_lo=r2.astype(rdt), r2max=r2.astype(rdt), k=jnp.int32(0))
+    out = jax.lax.while_loop(cond, body, init)
+    # final fold of any un-injected sloppy contribution
+    x_fin = out["x"] + out["x_lo"].astype(out["x"].dtype)
+    r_fin = b - matvec_hi(x_fin)
+    r2_fin = blas.norm2(r_fin)
+    return SolverResult(x_fin, out["k"], r2_fin, r2_fin <= stop)
+
+
+def solve_refined(matvec_hi: Callable, inner_solve: Callable, b: jnp.ndarray,
+                  sloppy_dtype, tol: float = 1e-10, max_cycles: int = 10):
+    """Defect-correction refinement: repeat { r = b - A x ;  x += solve(r) }.
+
+    ``inner_solve(rhs) -> x`` runs at sloppy_dtype (any solver).  Host-side
+    outer loop (few cycles), jitted inner — QUDA's refinement phase pattern.
+    """
+    b2 = float(blas.norm2(b))
+    stop = (tol ** 2) * b2
+    x = jnp.zeros_like(b)
+    r = b
+    cycles = 0
+    for _ in range(max_cycles):
+        y = inner_solve(r.astype(sloppy_dtype))
+        x = x + y.astype(x.dtype)
+        r = b - matvec_hi(x)
+        cycles += 1
+        if float(blas.norm2(r)) <= stop:
+            break
+    r2 = blas.norm2(r)
+    return SolverResult(x, jnp.int32(cycles), r2, r2 <= stop)

@@ -1,0 +1,51 @@
+"""Precision ladder for TPU (QudaPrecision analog).
+
+QUDA's ladder {double, single, half, quarter} (include/enum_quda.h) maps to
+TPU-native dtypes:
+
+| QUDA     | storage                    | compute       | where           |
+|----------|----------------------------|---------------|-----------------|
+| double   | complex128                 | f64           | CPU only (tests, scalars) |
+| single   | complex64                  | f32           | everywhere      |
+| half     | bf16 pair (+ site norm)    | f32 on MXU    | sloppy fields   |
+| quarter  | int8 block-float (+ norm)  | f32           | planned         |
+
+TPU has no native f64; QUDA's half (fp16 + per-site norm,
+include/color_spinor_field_order.h block-float accessors) becomes bf16 —
+bf16 has fp32's exponent range so the per-site norm array is unnecessary,
+which removes an entire accessor layer.  int8 block-float (quarter) keeps
+the norm concept; see ops/blockfloat.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+DOUBLE = "double"
+SINGLE = "single"
+HALF = "half"
+QUARTER = "quarter"
+
+COMPLEX_DTYPE = {
+    DOUBLE: jnp.complex128,
+    SINGLE: jnp.complex64,
+    # half/quarter are storage codecs, not complex dtypes; compute at c64
+    HALF: jnp.complex64,
+    QUARTER: jnp.complex64,
+}
+
+REAL_DTYPE = {
+    DOUBLE: jnp.float64,
+    SINGLE: jnp.float32,
+    HALF: jnp.bfloat16,
+    QUARTER: jnp.int8,
+}
+
+
+def complex_dtype(prec: str):
+    return COMPLEX_DTYPE[prec]
+
+
+def sloppy_pair(precise: str) -> str:
+    """Default sloppy precision for a given precise precision."""
+    return {DOUBLE: SINGLE, SINGLE: HALF, HALF: HALF, QUARTER: QUARTER}[precise]

@@ -1,0 +1,73 @@
+"""Mixed-precision solver tests: reliable updates + iterative refinement.
+
+Sloppy = complex64, precise = complex128 (the CPU analog of the TPU's
+f32-precise / bf16-sloppy pairing).  Plain single-precision CG stalls well
+above 1e-10; the mixed schemes must reach it.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import EVEN, LatticeGeometry
+from quda_tpu.fields.spinor import ColorSpinorField, even_odd_split
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.models.wilson import DiracWilsonPC
+from quda_tpu.ops import blas
+from quda_tpu.solvers.cg import cg
+from quda_tpu.solvers.mixed import cg_reliable, solve_refined
+
+GEOM = LatticeGeometry((8, 8, 8, 8))
+KAPPA = 0.125
+TOL = 1e-10
+
+
+@pytest.fixture(scope="module")
+def problem():
+    key = jax.random.PRNGKey(21)
+    k1, k2 = jax.random.split(key)
+    gauge = GaugeField.random(k1, GEOM).data
+    b_full = ColorSpinorField.gaussian(k2, GEOM).data
+    dpc = DiracWilsonPC(gauge, GEOM, KAPPA)
+    be, bo = even_odd_split(b_full, GEOM)
+    rhs = dpc.Mdag(dpc.prepare(be, bo))
+    dpc_lo = DiracWilsonPC(gauge.astype(jnp.complex64), GEOM, KAPPA)
+    return dpc, dpc_lo, rhs
+
+
+def test_pure_single_stalls(problem):
+    """Sanity: single-precision CG cannot reach a TRUE residual of 1e-10
+    (its recursive residual under-reports) — motivates mixing."""
+    dpc, dpc_lo, rhs = problem
+    res = cg(dpc_lo.MdagM, rhs.astype(jnp.complex64), tol=TOL, maxiter=500)
+    true_r2 = blas.norm2(rhs - dpc.MdagM(res.x.astype(jnp.complex128)))
+    assert float(jnp.sqrt(true_r2 / blas.norm2(rhs))) > 10 * TOL
+
+
+def test_cg_reliable_reaches_double_tol(problem):
+    dpc, dpc_lo, rhs = problem
+    res = jax.jit(lambda b: cg_reliable(
+        dpc.MdagM, dpc_lo.MdagM, b, jnp.complex64, tol=TOL,
+        maxiter=2000))(rhs)
+    assert bool(res.converged)
+    r2 = blas.norm2(rhs - dpc.MdagM(res.x))
+    assert float(jnp.sqrt(r2 / blas.norm2(rhs))) < 2 * TOL
+
+
+def test_refinement_reaches_double_tol(problem):
+    dpc, dpc_lo, rhs = problem
+    inner = jax.jit(lambda r: cg(dpc_lo.MdagM, r, tol=1e-5, maxiter=500).x)
+    res = solve_refined(dpc.MdagM, inner, rhs, jnp.complex64, tol=TOL)
+    assert bool(res.converged)
+    r2 = blas.norm2(rhs - dpc.MdagM(res.x))
+    assert float(jnp.sqrt(r2 / blas.norm2(rhs))) < 2 * TOL
+
+
+def test_reliable_iters_comparable_to_pure_double(problem):
+    """Reliable-update CG shouldn't need dramatically more iterations."""
+    dpc, dpc_lo, rhs = problem
+    res_d = cg(dpc.MdagM, rhs, tol=TOL, maxiter=2000)
+    res_m = cg_reliable(dpc.MdagM, dpc_lo.MdagM, rhs, jnp.complex64,
+                        tol=TOL, maxiter=2000)
+    assert int(res_m.iters) < 3 * int(res_d.iters)
