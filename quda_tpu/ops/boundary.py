@@ -15,16 +15,19 @@ from ..fields.geometry import LatticeGeometry
 
 
 def apply_t_boundary(gauge: jnp.ndarray, geom: LatticeGeometry,
-                     sign: int = -1) -> jnp.ndarray:
-    """Multiply the t-links on the last time slice by ``sign``.
+                     sign: int = -1, depth: int = 1) -> jnp.ndarray:
+    """Multiply the t-links on the last ``depth`` time slices by ``sign``.
 
     With periodic shifts this implements (anti)periodic fermion BCs.
+    ``depth`` is the hop length the link field is used with: 1 for ordinary
+    links, 3 for the staggered long (Naik) links — a 3-hop starting at
+    t in {T-3, T-2, T-1} crosses the boundary exactly once.
     gauge: (4, T, Z, Y, X, 3, 3).
     """
     if sign == 1:
         return gauge
     t_links = gauge[3]
-    t_links = t_links.at[geom.T - 1].multiply(sign)
+    t_links = t_links.at[geom.T - depth:].multiply(sign)
     return gauge.at[3].set(t_links)
 
 
@@ -50,10 +53,15 @@ def staggered_phases_milc(geom: LatticeGeometry) -> np.ndarray:
 
 
 def apply_staggered_phases(gauge: jnp.ndarray, geom: LatticeGeometry,
-                           antiperiodic_t: bool = True) -> jnp.ndarray:
-    """Fold MILC staggered phases (and optional antiperiodic-t) into links."""
+                           antiperiodic_t: bool = True,
+                           nhop: int = 1) -> jnp.ndarray:
+    """Fold MILC staggered phases (and optional antiperiodic-t) into links.
+
+    eta_mu(x) never depends on x_mu itself, so the same site phase is
+    correct for the nhop=3 long links; only the boundary depth differs.
+    """
     eta = jnp.asarray(staggered_phases_milc(geom))
     out = gauge * eta[..., None, None].astype(gauge.dtype)
     if antiperiodic_t:
-        out = apply_t_boundary(out, geom, -1)
+        out = apply_t_boundary(out, geom, -1, depth=nhop)
     return out

@@ -39,15 +39,20 @@ def shift(arr: jnp.ndarray, mu: int, sign: int, nhop: int = 1) -> jnp.ndarray:
 @lru_cache(maxsize=None)
 def _slot_mask(geom: LatticeGeometry, parity: int, n_internal: int):
     """Boolean mask over (T,Z,Y,1,[1]*n_internal): True where the parity-p
-    half-site at (t,z,y,xh) occupies the even x slot (r == 0)."""
+    half-site at (t,z,y,xh) occupies the even x slot (r == 0).
+
+    Returns a NUMPY array on purpose: a cached jnp array created inside one
+    jit trace would leak that trace's constant-tracer into later traces
+    (JAX >= 0.8 wraps in-trace constants).  np constants are safe to close
+    over from any trace.
+    """
     T, Z, Y, _ = geom.lattice_shape
     t = np.arange(T)[:, None, None]
     z = np.arange(Z)[None, :, None]
     y = np.arange(Y)[None, None, :]
     r = (t + z + y + parity) % 2
     mask = (r == 0)[..., None]
-    mask = mask.reshape(mask.shape + (1,) * n_internal)
-    return jnp.asarray(mask)
+    return mask.reshape(mask.shape + (1,) * n_internal)
 
 
 def shift_eo(arr: jnp.ndarray, geom: LatticeGeometry, mu: int, sign: int,

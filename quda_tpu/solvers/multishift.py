@@ -1,0 +1,105 @@
+"""Multi-shift CG: solve (A + sigma_i) x_i = b for all shifts at once.
+
+Reference behavior: lib/inv_multi_cg_quda.cpp (493 LoC) — the RHMC
+rational-approximation solver for staggered/HISQ.  One Krylov space serves
+every shift via the shifted-CG zeta recurrences (a single matvec per
+iteration); per-shift convergence is tracked through the analytically known
+shifted residual |r_s| = zeta_s |r|.
+
+The shift vector is a static (Python) tuple; the shifted iterates are a
+stacked leading axis so the per-shift axpys are one fused broadcast —
+QUDA's hand-written multi-shift update kernels (multi_blas) fall out of XLA
+fusion for free.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import blas
+
+
+class MultiShiftResult(NamedTuple):
+    x: jnp.ndarray          # (n_shifts, ...) solutions
+    iters: jnp.ndarray
+    r2: jnp.ndarray         # base-system final |r|^2
+    converged: jnp.ndarray  # (n_shifts,) bool
+
+
+def multishift_cg(matvec: Callable, b: jnp.ndarray,
+                  shifts: Sequence[float], tol: float = 1e-10,
+                  maxiter: int = 2000) -> MultiShiftResult:
+    """Solve (matvec + shift_i) x_i = b, matvec Hermitian positive
+    semi-definite and every shift >= 0 (the RHMC setting).
+
+    Shifts are offset so the BASE system includes the smallest shift (QUDA
+    orders shifts ascending and iterates the zeroth); convergence of shift i
+    is |r_i|^2 = zeta_i^2 |r|^2 <= tol^2 |b|^2.
+    """
+    shifts = tuple(float(s) for s in shifts)
+    ns = len(shifts)
+    s0 = min(shifts)
+    sig = jnp.asarray([s - s0 for s in shifts], b.real.dtype)  # >= 0
+    base = lambda v: matvec(v) + (s0 * v if s0 != 0.0 else 0.0 * v)
+
+    b2 = blas.norm2(b)
+    stop = (tol ** 2) * b2
+    rdt = b2.dtype
+
+    def expand(a):
+        """(ns,) scalars -> broadcastable over stacked fields."""
+        return a.reshape((ns,) + (1,) * b.ndim)
+
+    state = dict(
+        x=jnp.zeros((ns,) + b.shape, b.dtype),
+        p=jnp.broadcast_to(b, (ns,) + b.shape).astype(b.dtype),
+        r=b,
+        r2=b2,
+        zeta=jnp.ones((ns,), rdt),
+        zeta_old=jnp.ones((ns,), rdt),
+        alpha_old=jnp.ones((), rdt),
+        beta_old=jnp.zeros((), rdt),
+        k=jnp.int32(0),
+    )
+
+    def shift_r2(c):
+        return (c["zeta"] ** 2) * c["r2"]
+
+    def cond(c):
+        return jnp.logical_and(jnp.max(shift_r2(c)) > stop, c["k"] < maxiter)
+
+    def body(c):
+        p0 = c["p"][0]
+        Ap = base(p0)
+        pAp = blas.redot(p0, Ap).astype(rdt)
+        alpha = c["r2"] / pAp
+
+        # zeta recurrence (Frommer/van der Vorst shifted CG)
+        zn = c["zeta"] * c["zeta_old"] * c["alpha_old"]
+        zd = (alpha * c["beta_old"] * (c["zeta_old"] - c["zeta"])
+              + c["zeta_old"] * c["alpha_old"] * (1.0 + sig * alpha))
+        zeta_new = jnp.where(zd != 0, zn / jnp.where(zd != 0, zd, 1.0), 0.0)
+        alpha_s = alpha * jnp.where(c["zeta"] != 0,
+                                    zeta_new / jnp.where(c["zeta"] != 0,
+                                                         c["zeta"], 1.0), 0.0)
+
+        x = c["x"] + expand(alpha_s).astype(b.dtype) * c["p"]
+        r = c["r"] - alpha.astype(b.dtype) * Ap
+        r2_new = blas.norm2(r).astype(rdt)
+        beta = r2_new / c["r2"]
+        beta_s = beta * jnp.where(
+            c["zeta"] != 0,
+            (zeta_new / jnp.where(c["zeta"] != 0, c["zeta"], 1.0)) ** 2, 0.0)
+        p = (expand(zeta_new).astype(b.dtype) * r[None]
+             + expand(beta_s).astype(b.dtype) * c["p"])
+
+        return dict(x=x, p=p, r=r, r2=r2_new, zeta=zeta_new,
+                    zeta_old=c["zeta"], alpha_old=alpha, beta_old=beta,
+                    k=c["k"] + 1)
+
+    out = jax.lax.while_loop(cond, body, state)
+    conv = shift_r2(out) <= stop
+    return MultiShiftResult(out["x"], out["k"], out["r2"], conv)
