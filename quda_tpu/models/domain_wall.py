@@ -1,0 +1,142 @@
+"""Domain-wall (Shamir) and Möbius Dirac operators, full and 4d-even/odd
+preconditioned.
+
+Reference behavior: lib/dirac_domain_wall.cpp, lib/dirac_domain_wall_4d.cpp,
+lib/dirac_mobius.cpp (740 LoC) and the m5 kernel family (see ops/dwf.py).
+
+Formulation (b5, c5 Möbius parameters; Shamir is b5=1, c5=0):
+
+    M psi = D_W (b5 psi + c5 chi) + psi - chi
+          = M5 psi - 1/2 hop( M5' psi )
+
+with chi(s) the P-+ s-hop with -mf boundary (ops/dwf.py), D_W the 4-d
+Wilson operator at mass -M5 (diagonal 4 - M5 folded in), and
+
+    M5  = [alpha = b5 (4 - M5) + 1,  beta = c5 (4 - M5) - 1]
+    M5' = [alpha = b5,               beta = c5]
+
+4d-PC (symmetric) Schur system on parity p (QUDA's QUDA_MATPC_EVEN_EVEN
+with symmetric preconditioning for Möbius):
+
+    M_pc = 1 - 1/4 M5i hop_pq M5" hop_qp M5"        (M5" = M5' M5^{-1})
+    prepare:      b' = M5i b_p + 1/2 M5i hop_pq M5i b_q
+    reconstruct:  x_q = M5i (b_q + 1/2 hop_qp M5' x_p)
+
+where all s-operators are dense (Ls,Ls) chirality blocks (ops/dwf.py) and
+hop is the parity-changing 4-d Wilson hop applied per s-slice.
+
+Dagger: adjoints of the s-operators are explicit conj-transposes and
+hop^dag = gamma5 hop gamma5, composed in reverse — no separate dagger
+kernels needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..fields.geometry import EVEN, LatticeGeometry
+from ..ops import wilson as wops
+from ..ops.boundary import apply_t_boundary
+from ..ops.dwf import SOp, apply_sop, identity_sop, m5_sop
+from .dirac import Dirac, DiracPC, MATPC_EVEN_EVEN, apply_gamma5
+
+
+class DiracMobius(Dirac):
+    """Full (unpreconditioned) Möbius operator on (Ls,T,Z,Y,X,4,3) fields."""
+
+    g5_hermitian = False  # uses Gamma5 = gamma5 * R (s-reflection) instead
+
+    def __init__(self, gauge: jnp.ndarray, geom: LatticeGeometry, ls: int,
+                 m5: float, mf: float, b5: float = 1.0, c5: float = 0.0,
+                 antiperiodic_t: bool = True):
+        self.geom = geom
+        self.ls = ls
+        self.m5 = m5
+        self.mf = mf
+        self.b5 = b5
+        self.c5 = c5
+        self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        dw_diag = 4.0 - m5
+        self.s_m5 = m5_sop(ls, b5 * dw_diag + 1.0, c5 * dw_diag - 1.0, mf)
+        self.s_m5p = m5_sop(ls, b5, c5, mf)
+
+    def _hop(self, psi):
+        """4-d Wilson hop applied to every s-slice (vmapped over s)."""
+        return jax.vmap(lambda v: wops.dslash_full(self.gauge, v))(psi)
+
+    def M(self, psi):
+        return apply_sop(self.s_m5, psi) - 0.5 * self._hop(
+            apply_sop(self.s_m5p, psi))
+
+    def Mdag(self, psi):
+        # M^dag = M5^dag - 1/2 M5'^dag hop^dag;  hop^dag = g5 hop g5
+        hop_dag = apply_gamma5(self._hop(apply_gamma5(psi)))
+        return (apply_sop(self.s_m5.adj(), psi)
+                - 0.5 * apply_sop(self.s_m5p.adj(), hop_dag))
+
+
+class DiracDomainWall(DiracMobius):
+    """Shamir domain wall: Möbius with b5=1, c5=0
+    (lib/dirac_domain_wall.cpp)."""
+
+    def __init__(self, gauge, geom, ls, m5, mf, antiperiodic_t=True):
+        super().__init__(gauge, geom, ls, m5, mf, 1.0, 0.0, antiperiodic_t)
+
+
+class DiracMobiusPC(DiracPC):
+    """Symmetric 4d-even/odd preconditioned Möbius operator."""
+
+    g5_hermitian = False
+
+    def __init__(self, gauge: jnp.ndarray, geom: LatticeGeometry, ls: int,
+                 m5: float, mf: float, b5: float = 1.0, c5: float = 0.0,
+                 antiperiodic_t: bool = True, matpc: int = MATPC_EVEN_EVEN):
+        self.geom = geom
+        self.ls = ls
+        self.mf = mf
+        self.matpc = matpc
+        g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.gauge_eo = wops.split_gauge_eo(g, geom)
+        dw_diag = 4.0 - m5
+        self.s_m5 = m5_sop(ls, b5 * dw_diag + 1.0, c5 * dw_diag - 1.0, mf)
+        self.s_m5p = m5_sop(ls, b5, c5, mf)
+        self.s_m5i = self.s_m5.inv()
+        self.s_mix = self.s_m5p @ self.s_m5i   # M5" = M5' M5^{-1} (commute)
+
+    def _hop_to(self, psi, target_parity):
+        return jax.vmap(
+            lambda v: wops.dslash_eo(self.gauge_eo, v, self.geom,
+                                     target_parity))(psi)
+
+    def _hop_to_dag(self, psi, target_parity):
+        """Adjoint hop: (hop_to(., 1-q))^dag maps (1-q)-parity fields back to
+        q = gamma5 hop_to(gamma5 ., q)."""
+        return apply_gamma5(self._hop_to(apply_gamma5(psi), target_parity))
+
+    # M_pc = 1 - 1/4 M5i . hop_to(.,p) . M5" . hop_to(.,1-p) . M5'
+    def M(self, x_p):
+        p = self.matpc
+        t = self._hop_to(apply_sop(self.s_m5p, x_p), 1 - p)
+        t = self._hop_to(apply_sop(self.s_mix, t), p)
+        return x_p - 0.25 * apply_sop(self.s_m5i, t)
+
+    def Mdag(self, x_p):
+        p = self.matpc
+        t = apply_sop(self.s_m5i.adj(), x_p)
+        t = apply_sop(self.s_mix.adj(), self._hop_to_dag(t, 1 - p))
+        t = apply_sop(self.s_m5p.adj(), self._hop_to_dag(t, p))
+        return x_p - 0.25 * t
+
+    def prepare(self, b_even, b_odd):
+        p = self.matpc
+        b_p, b_q = (b_even, b_odd) if p == EVEN else (b_odd, b_even)
+        t = self._hop_to(apply_sop(self.s_mix, b_q), p)
+        return apply_sop(self.s_m5i, b_p + 0.5 * t)
+
+    def reconstruct(self, x_p, b_even, b_odd):
+        p = self.matpc
+        b_q = b_odd if p == EVEN else b_even
+        t = self._hop_to(apply_sop(self.s_m5p, x_p), 1 - p)
+        x_q = apply_sop(self.s_m5i, b_q + 0.5 * t)
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)

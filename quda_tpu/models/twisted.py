@@ -1,0 +1,256 @@
+"""Twisted-mass and twisted-clover Dirac operators (degenerate and
+non-degenerate doublet).
+
+Reference behavior: lib/dirac_twisted_mass.cpp, lib/dirac_twisted_clover.cpp
+(+ the ndeg variants).  Kappa normalisation with the twist folded into the
+diagonal:
+
+    degenerate:      M = (1 + i a gamma5) - kappa D,    a = 2 kappa mu
+    non-degenerate:  M = (1 + i a gamma5 tau3 - b tau1) - kappa D,
+                     a = 2 kappa mu, b = 2 kappa epsilon   (flavor doublet)
+    twisted clover:  M = (A + i a gamma5) - kappa D       (A = clover term)
+
+gamma5 is diag(+1,+1,-1,-1) in the DeGrand-Rossi basis, so the twist is a
+per-chirality complex scale — on TPU it fuses into the surrounding
+elementwise chain; the clover+twist diagonal stays two 6x6 blocks with
++-i*a added to the diagonal.
+
+The twisted operators obey gamma5 M(mu) gamma5 = M(-mu)^dag, so MdagM for
+CG uses the explicit Mdag (twist sign flip) rather than the g5 trick.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..fields.geometry import EVEN, LatticeGeometry
+from ..fields.spinor import even_odd_split
+from ..ops import wilson as wops
+from ..ops.boundary import apply_t_boundary
+from ..ops.clover import apply_clover, clover_blocks, invert_clover
+from .dirac import Dirac, DiracPC, MATPC_EVEN_EVEN, apply_gamma5
+
+
+def _twist_apply(psi, a: float, sign: int = +1):
+    """(1 + i sign a gamma5) psi."""
+    return psi + (1j * sign * a) * apply_gamma5(psi)
+
+
+def _twist_inv(psi, a: float, sign: int = +1):
+    """(1 + i sign a gamma5)^{-1} psi = (1 - i sign a gamma5)/(1+a^2) psi."""
+    return (psi - (1j * sign * a) * apply_gamma5(psi)) / (1.0 + a * a)
+
+
+class DiracTwistedMass(Dirac):
+    """Degenerate twisted-mass operator on full lattice."""
+
+    g5_hermitian = False
+
+    def __init__(self, gauge: jnp.ndarray, geom: LatticeGeometry,
+                 kappa: float, mu: float, antiperiodic_t: bool = True):
+        self.geom = geom
+        self.kappa = kappa
+        self.mu = mu
+        self.a = 2.0 * kappa * mu
+        self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+
+    def D(self, psi):
+        return wops.dslash_full(self.gauge, psi)
+
+    def M(self, psi):
+        return _twist_apply(psi, self.a) - self.kappa * self.D(psi)
+
+    def Mdag(self, psi):
+        # gamma5 M(mu) gamma5 = M(-mu)^dag  =>  Mdag = g5 M(-mu) g5
+        out = _twist_apply(psi, self.a, -1) - self.kappa * apply_gamma5(
+            self.D(apply_gamma5(psi)))
+        return out
+
+
+class DiracTwistedMassPC(DiracPC):
+    """Even/odd preconditioned degenerate twisted mass.
+
+    M_pc x = (1 + i a g5) x - kappa^2 D (1 + i a g5)^{-1} D x
+    """
+
+    g5_hermitian = False
+
+    def __init__(self, gauge: jnp.ndarray, geom: LatticeGeometry,
+                 kappa: float, mu: float, antiperiodic_t: bool = True,
+                 matpc: int = MATPC_EVEN_EVEN):
+        self.geom = geom
+        self.kappa = kappa
+        self.mu = mu
+        self.a = 2.0 * kappa * mu
+        self.matpc = matpc
+        g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.gauge_eo = wops.split_gauge_eo(g, geom)
+
+    def D_to(self, psi, target_parity):
+        return wops.dslash_eo(self.gauge_eo, psi, self.geom, target_parity)
+
+    def _M_sign(self, x_p, sign):
+        p = self.matpc
+        tmp = _twist_inv(self.D_to(x_p, 1 - p), self.a, sign)
+        return (_twist_apply(x_p, self.a, sign)
+                - (self.kappa ** 2) * self.D_to(tmp, p))
+
+    def M(self, x_p):
+        return self._M_sign(x_p, +1)
+
+    def Mdag(self, x_p):
+        return apply_gamma5(self._M_sign(apply_gamma5(x_p), -1))
+
+    def prepare(self, b_even, b_odd):
+        p = self.matpc
+        b_p, b_q = (b_even, b_odd) if p == EVEN else (b_odd, b_even)
+        return b_p + self.kappa * self.D_to(_twist_inv(b_q, self.a), p)
+
+    def reconstruct(self, x_p, b_even, b_odd):
+        p = self.matpc
+        b_q = b_odd if p == EVEN else b_even
+        x_q = _twist_inv(b_q + self.kappa * self.D_to(x_p, 1 - p), self.a)
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+
+class DiracNdegTwistedMass(Dirac):
+    """Non-degenerate twisted doublet; fields carry a flavor axis:
+    (T,Z,Y,X, flavor=2, 4, 3).
+
+    M = (1 + i a g5 tau3 - b tau1) - kappa D   (D flavor-diagonal).
+    """
+
+    g5_hermitian = False
+
+    def __init__(self, gauge: jnp.ndarray, geom: LatticeGeometry,
+                 kappa: float, mu: float, epsilon: float,
+                 antiperiodic_t: bool = True):
+        self.geom = geom
+        self.kappa = kappa
+        self.a = 2.0 * kappa * mu
+        self.b = 2.0 * kappa * epsilon
+        self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+
+    def D(self, psi):
+        # vmap over the flavor axis (axis -3)
+        lat = psi.shape[:4]
+        merged = jnp.moveaxis(psi, 4, 0)  # (2, T,Z,Y,X,4,3)
+        out = jnp.stack([wops.dslash_full(self.gauge, merged[f])
+                         for f in range(2)])
+        return jnp.moveaxis(out, 0, 4)
+
+    def _diag(self, psi, sign=+1):
+        up = psi[..., 0, :, :]
+        dn = psi[..., 1, :, :]
+        up_out = up + (1j * sign * self.a) * apply_gamma5(up) - self.b * dn
+        dn_out = dn - (1j * sign * self.a) * apply_gamma5(dn) - self.b * up
+        return jnp.stack([up_out, dn_out], axis=-3)
+
+    def M(self, psi):
+        return self._diag(psi) - self.kappa * self.D(psi)
+
+    def Mdag(self, psi):
+        d5 = apply_gamma5(self.D(apply_gamma5(psi)))
+        return self._diag(psi, -1) - self.kappa * d5
+
+
+class DiracTwistedClover(Dirac):
+    """Twisted clover: M = (A + i a gamma5) - kappa D."""
+
+    g5_hermitian = False
+
+    def __init__(self, gauge: jnp.ndarray, geom: LatticeGeometry,
+                 kappa: float, mu: float, csw: float,
+                 antiperiodic_t: bool = True):
+        self.geom = geom
+        self.kappa = kappa
+        self.a = 2.0 * kappa * mu
+        self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.clover = clover_blocks(gauge, kappa * csw / 2.0)
+
+    def D(self, psi):
+        return wops.dslash_full(self.gauge, psi)
+
+    def _A_tw(self, psi, sign=+1):
+        return apply_clover(self.clover, psi) + (
+            1j * sign * self.a) * apply_gamma5(psi)
+
+    def M(self, psi):
+        return self._A_tw(psi) - self.kappa * self.D(psi)
+
+    def Mdag(self, psi):
+        return self._A_tw(psi, -1) - self.kappa * apply_gamma5(
+            self.D(apply_gamma5(psi)))
+
+
+def twisted_clover_blocks(clover, a: float, sign: int = +1):
+    """Chiral blocks of A + i sign a gamma5: gamma5 = +-1 per chirality."""
+    eye = jnp.eye(6, dtype=clover.dtype)
+    up = clover[..., 0, :, :] + (1j * sign * a) * eye
+    dn = clover[..., 1, :, :] - (1j * sign * a) * eye
+    return jnp.stack([up, dn], axis=-3)
+
+
+class DiracTwistedCloverPC(DiracPC):
+    """Even/odd preconditioned twisted clover (asymmetric):
+    M_pc = (A_p + i a g5) - kappa^2 D (A_q + i a g5)^{-1} D.
+
+    The twisted diagonal is NOT Hermitian, so its inverse uses the general
+    6x6 solve rather than Cholesky (QUDA inverts the twisted clover with
+    the same Cholesky trick on A^dag A; a direct batched inverse is simpler
+    and XLA-batched).
+    """
+
+    g5_hermitian = False
+
+    def __init__(self, gauge: jnp.ndarray, geom: LatticeGeometry,
+                 kappa: float, mu: float, csw: float,
+                 antiperiodic_t: bool = True, matpc: int = MATPC_EVEN_EVEN):
+        self.geom = geom
+        self.kappa = kappa
+        self.a = 2.0 * kappa * mu
+        self.matpc = matpc
+        g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.gauge_eo = wops.split_gauge_eo(g, geom)
+        blocks = clover_blocks(gauge, kappa * csw / 2.0)
+        a_e, a_o = even_odd_split(blocks, geom)
+        self.clover = (a_e, a_o)
+        q = 1 - matpc
+        self.tw_inv_q = {
+            +1: jnp.linalg.inv(twisted_clover_blocks(self.clover[q],
+                                                     self.a, +1)),
+            -1: jnp.linalg.inv(twisted_clover_blocks(self.clover[q],
+                                                     self.a, -1)),
+        }
+
+    def D_to(self, psi, target_parity):
+        return wops.dslash_eo(self.gauge_eo, psi, self.geom, target_parity)
+
+    def _A_p(self, x, sign=+1):
+        return apply_clover(self.clover[self.matpc], x) + (
+            1j * sign * self.a) * apply_gamma5(x)
+
+    def _Ainv_q(self, x, sign=+1):
+        return apply_clover(self.tw_inv_q[sign], x)
+
+    def _M_sign(self, x_p, sign):
+        p = self.matpc
+        tmp = self._Ainv_q(self.D_to(x_p, 1 - p), sign)
+        return self._A_p(x_p, sign) - (self.kappa ** 2) * self.D_to(tmp, p)
+
+    def M(self, x_p):
+        return self._M_sign(x_p, +1)
+
+    def Mdag(self, x_p):
+        return apply_gamma5(self._M_sign(apply_gamma5(x_p), -1))
+
+    def prepare(self, b_even, b_odd):
+        p = self.matpc
+        b_p, b_q = (b_even, b_odd) if p == EVEN else (b_odd, b_even)
+        return b_p + self.kappa * self.D_to(self._Ainv_q(b_q), p)
+
+    def reconstruct(self, x_p, b_even, b_odd):
+        p = self.matpc
+        b_q = b_odd if p == EVEN else b_even
+        x_q = self._Ainv_q(b_q + self.kappa * self.D_to(x_p, 1 - p))
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)

@@ -1,0 +1,100 @@
+"""Domain-wall / Möbius operator tests vs host reference + PC consistency."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import EVEN, ODD, LatticeGeometry
+from quda_tpu.fields.spinor import ColorSpinorField, even_odd_join, even_odd_split
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.models.domain_wall import (DiracDomainWall, DiracMobius,
+                                         DiracMobiusPC)
+from quda_tpu.ops import blas
+from quda_tpu.ops.dwf import apply_sop, identity_sop, m5_sop
+from quda_tpu.solvers.cg import cg
+
+from tests.host_reference.dwf_ref import mobius_mat_ref
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+LS = 6
+M5, MF = 1.4, 0.04
+B5, C5 = 1.5, 0.5
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    key = jax.random.PRNGKey(55)
+    k1, k2 = jax.random.split(key)
+    gauge = GaugeField.random(k1, GEOM).data
+    psi = jnp.stack([
+        ColorSpinorField.gaussian(jax.random.fold_in(k2, s), GEOM).data
+        for s in range(LS)])
+    return gauge, psi
+
+
+@pytest.mark.parametrize("b5,c5", [(1.0, 0.0), (B5, C5)])
+def test_mobius_matches_host(cfg, b5, c5):
+    gauge, psi = cfg
+    d = DiracMobius(gauge, GEOM, LS, M5, MF, b5, c5)
+    got = np.asarray(d.M(psi))
+    want = mobius_mat_ref(np.asarray(gauge), np.asarray(psi), M5, MF, b5, c5)
+    assert np.allclose(got, want, atol=1e-11)
+
+
+def test_m5_inverse(cfg):
+    sop = m5_sop(LS, 3.7, -1.0, MF)
+    _, psi = cfg
+    back = apply_sop(sop.inv(), apply_sop(sop, psi))
+    assert np.allclose(np.asarray(back), np.asarray(psi), atol=1e-12)
+
+
+def test_mdag_adjointness(cfg):
+    gauge, psi = cfg
+    d = DiracMobius(gauge, GEOM, LS, M5, MF, B5, C5)
+    key = jax.random.PRNGKey(66)
+    chi = jnp.stack([
+        ColorSpinorField.gaussian(jax.random.fold_in(key, s), GEOM).data
+        for s in range(LS)])
+    lhs = blas.cdot(chi, d.M(psi))
+    rhs = jnp.conjugate(blas.cdot(psi, d.Mdag(chi)))
+    assert np.allclose(complex(lhs), complex(rhs), atol=1e-10)
+
+
+def test_pc_mdag_adjointness(cfg):
+    gauge, psi = cfg
+    dpc = DiracMobiusPC(gauge, GEOM, LS, M5, MF, B5, C5)
+    pe = jax.vmap(lambda v: even_odd_split(v, GEOM)[0])(psi)
+    key = jax.random.PRNGKey(67)
+    chi = jnp.stack([
+        ColorSpinorField.gaussian(jax.random.fold_in(key, s), GEOM).data
+        for s in range(LS)])
+    ce = jax.vmap(lambda v: even_odd_split(v, GEOM)[0])(chi)
+    lhs = blas.cdot(ce, dpc.M(pe))
+    rhs = jnp.conjugate(blas.cdot(pe, dpc.Mdag(ce)))
+    assert np.allclose(complex(lhs), complex(rhs), atol=1e-10)
+
+
+@pytest.mark.parametrize("b5,c5", [(1.0, 0.0), (B5, C5)])
+@pytest.mark.parametrize("matpc", [EVEN, ODD])
+def test_pc_solve_matches_full(cfg, b5, c5, matpc):
+    gauge, psi = cfg
+    d = DiracMobius(gauge, GEOM, LS, M5, MF, b5, c5)
+    dpc = DiracMobiusPC(gauge, GEOM, LS, M5, MF, b5, c5, matpc=matpc)
+    be = jax.vmap(lambda v: even_odd_split(v, GEOM)[0])(psi)
+    bo = jax.vmap(lambda v: even_odd_split(v, GEOM)[1])(psi)
+    b_pc = dpc.prepare(be, bo)
+    res = cg(lambda v: dpc.Mdag(dpc.M(v)), dpc.Mdag(b_pc), tol=1e-11,
+             maxiter=4000)
+    assert bool(res.converged)
+    xe, xo = dpc.reconstruct(res.x, be, bo)
+    x = jax.vmap(lambda e, o: even_odd_join(e, o, GEOM))(xe, xo)
+    rel = float(jnp.sqrt(blas.norm2(psi - d.M(x)) / blas.norm2(psi)))
+    assert rel < 1e-8
+
+
+def test_shamir_class(cfg):
+    gauge, psi = cfg
+    d1 = DiracDomainWall(gauge, GEOM, LS, M5, MF)
+    d2 = DiracMobius(gauge, GEOM, LS, M5, MF, 1.0, 0.0)
+    assert np.allclose(np.asarray(d1.M(psi)), np.asarray(d2.M(psi)))
