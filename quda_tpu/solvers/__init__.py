@@ -1,0 +1,39 @@
+"""Krylov solver suite (Solver::create analog, lib/solver.cpp:59-155).
+
+All solvers are pure functions over a ``matvec`` closure; ``create``
+resolves QUDA's QudaInverterType names onto them.
+"""
+
+from .cg import cg, cg_fixed_iters, SolverResult  # noqa: F401
+from .cg3 import cg3, cgne, cgnr  # noqa: F401
+from .bicgstab import bicgstab, bicgstab_l  # noqa: F401
+from .gcr import gcr, mr, mr_fixed, sd  # noqa: F401
+from .ca import ca_cg, ca_gcr  # noqa: F401
+from .multishift import multishift_cg  # noqa: F401
+from .mixed import cg_reliable, solve_refined  # noqa: F401
+from .chrono import ChronoStore, mre_guess  # noqa: F401
+
+_REGISTRY = {
+    "cg": cg,
+    "cg3": cg3,
+    "cgne": cgne,
+    "cgnr": cgnr,
+    "pcg": cg,            # preconditioner passed via precond=
+    "bicgstab": bicgstab,
+    "bicgstab-l": bicgstab_l,
+    "gcr": gcr,
+    "mr": mr,
+    "sd": sd,
+    "ca-cg": ca_cg,
+    "ca-gcr": ca_gcr,
+    "multi-shift-cg": multishift_cg,
+}
+
+
+def create(name: str):
+    """Look up a solver by (QUDA-style) name."""
+    key = name.lower().replace("_", "-")
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"unknown solver '{name}'; available: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]

@@ -1,0 +1,123 @@
+"""Communication-avoiding (s-step) solvers: CA-CG and CA-GCR.
+
+Reference behavior: lib/inv_ca_cg.cpp (578 LoC), lib/inv_ca_gcr.cpp (398),
+QudaCABasis power/Chebyshev basis.
+
+Each outer step builds an s-deep Krylov basis V = [v, A v, ..., A^{s-1} v]
+with ONE reduction phase: all Gram-matrix entries are computed as a single
+batched einsum (the whole point of CA solvers — QUDA needs one fused
+multi-reduce kernel; XLA emits one fused reduction over the stacked basis,
+and on a mesh it is one psum instead of s of them).
+
+* ca_gcr: minimises ||r - A V c||_2 each cycle (least squares via the
+  normal matrix of the A V basis) — matches QUDA's CA-GCR exactly.
+* ca_cg: minimises the A-norm error over span{V, p_prev} (the previous
+  outer direction augments the basis, restoring CG-like global convergence).
+
+Chebyshev basis: vectors generated with the shifted-scaled recurrence to
+keep the power basis well-conditioned (QUDA QUDA_CHEBYSHEV_BASIS); enabled
+via basis="chebyshev" with (lambda_min, lambda_max) estimates.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import blas
+from .cg import SolverResult
+
+
+def _build_basis(matvec, v, s, basis, lam):
+    """V = [v, ...] s vectors; power or Chebyshev recurrence."""
+    vs = [v]
+    if basis == "power":
+        for _ in range(s - 1):
+            vs.append(matvec(vs[-1]))
+    else:
+        lo, hi = lam
+        a = 2.0 / (hi - lo)
+        bshift = -(hi + lo) / (hi - lo)
+        # T_0 = v, T_1 = (a A + b) v, T_k = 2 (a A + b) T_{k-1} - T_{k-2}
+        def op(u):
+            return a * matvec(u) + bshift * u
+        if s > 1:
+            vs.append(op(v))
+        for _ in range(s - 2):
+            vs.append(2.0 * op(vs[-1]) - vs[-2])
+    return jnp.stack(vs)
+
+
+def ca_gcr(matvec: Callable, b: jnp.ndarray, s: int = 8,
+           x0: Optional[jnp.ndarray] = None, tol: float = 1e-10,
+           max_cycles: int = 100, basis: str = "power",
+           lam: Tuple[float, float] = (0.0, 2.0)) -> SolverResult:
+    b2 = blas.norm2(b)
+    stop = float((tol ** 2) * b2)
+
+    @jax.jit
+    def cycle(x, r):
+        V = _build_basis(matvec, r, s, basis, lam)
+        AV = jax.vmap(matvec)(V)
+        # one reduction phase: Gram of AV and projections of r
+        G = jnp.einsum("i...,j...->ij", jnp.conjugate(AV), AV)
+        rhs = jnp.einsum("i...,...->i", jnp.conjugate(AV), r)
+        c = jnp.linalg.solve(G, rhs)
+        x = x + jnp.einsum("i,i...->...", c, V)
+        r = r - jnp.einsum("i,i...->...", c, AV)
+        return x, r, blas.norm2(r)
+
+    x = jnp.zeros_like(b) if x0 is None else x0
+    r = b if x0 is None else b - matvec(x)
+    r2 = blas.norm2(r)
+    it = 0
+    for _ in range(max_cycles):
+        if float(r2) <= stop:
+            break
+        x, r, r2 = cycle(x, r)
+        it += s
+    return SolverResult(x, jnp.int32(it), r2, r2 <= stop)
+
+
+def ca_cg(matvec: Callable, b: jnp.ndarray, s: int = 8,
+          x0: Optional[jnp.ndarray] = None, tol: float = 1e-10,
+          max_cycles: int = 100, basis: str = "power",
+          lam: Tuple[float, float] = (0.0, 2.0)) -> SolverResult:
+    """Hermitian positive definite systems; A-norm minimisation per cycle
+    over the s-Krylov basis augmented with the previous step direction."""
+    b2 = blas.norm2(b)
+    stop = float((tol ** 2) * b2)
+
+    @jax.jit
+    def cycle(x, r, p_prev, have_prev):
+        V = _build_basis(matvec, r, s, basis, lam)
+        V = jnp.concatenate([V, p_prev[None]], axis=0)      # (s+1, ...)
+        AV = jax.vmap(matvec)(V)
+        G = jnp.einsum("i...,j...->ij", jnp.conjugate(V), AV)   # <v_i, A v_j>
+        rhs = jnp.einsum("i...,...->i", jnp.conjugate(V), r)
+        # mask the augmentation direction on the first cycle
+        n = s + 1
+        mask = jnp.concatenate([jnp.ones(s), have_prev[None]])
+        Gm = G * mask[:, None] * mask[None, :] \
+            + jnp.diag(1.0 - mask).astype(G.dtype)
+        cvec = jnp.linalg.solve(Gm, rhs * mask.astype(rhs.dtype))
+        step = jnp.einsum("i,i...->...", cvec, V)
+        x = x + step
+        r = r - jnp.einsum("i,i...->...", cvec, AV)
+        return x, r, blas.norm2(r), step
+
+    x = jnp.zeros_like(b) if x0 is None else x0
+    r = b if x0 is None else b - matvec(x)
+    r2 = blas.norm2(r)
+    p_prev = jnp.zeros_like(b)
+    have = jnp.zeros(())
+    it = 0
+    for _ in range(max_cycles):
+        if float(r2) <= stop:
+            break
+        x, r, r2, p_prev = cycle(x, r, p_prev, have)
+        have = jnp.ones(())
+        it += s
+    return SolverResult(x, jnp.int32(it), r2, r2 <= stop)

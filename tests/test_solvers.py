@@ -1,0 +1,151 @@
+"""Solver suite integration tests: every solver reaches the requested true
+residual on the Wilson-clover PC system (the invert_test matrix of
+--inv-type values, SURVEY.md §4.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import LatticeGeometry
+from quda_tpu.fields.spinor import ColorSpinorField, even_odd_split
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.models.clover import DiracCloverPC
+from quda_tpu.models.wilson import DiracWilsonPC
+from quda_tpu.ops import blas
+from quda_tpu import solvers
+from quda_tpu.solvers import (bicgstab, bicgstab_l, ca_cg, ca_gcr, cg, cg3,
+                              cgne, cgnr, gcr, mr, sd)
+from quda_tpu.solvers.chrono import ChronoStore
+
+GEOM = LatticeGeometry((6, 6, 6, 6))
+KAPPA, CSW = 0.11, 1.0
+TOL = 1e-9
+
+
+@pytest.fixture(scope="module")
+def problem():
+    key = jax.random.PRNGKey(91)
+    k1, k2 = jax.random.split(key)
+    gauge = GaugeField.random(k1, GEOM).data
+    b_full = ColorSpinorField.gaussian(k2, GEOM).data
+    dpc = DiracCloverPC(gauge, GEOM, KAPPA, CSW)
+    be, bo = even_odd_split(b_full, GEOM)
+    b = dpc.prepare(be, bo)
+    return dpc, b
+
+
+def true_rel(matvec, x, b):
+    return float(jnp.sqrt(blas.norm2(b - matvec(x)) / blas.norm2(b)))
+
+
+def test_bicgstab(problem):
+    dpc, b = problem
+    res = jax.jit(lambda v: bicgstab(dpc.M, v, tol=TOL, maxiter=4000))(b)
+    assert bool(res.converged)
+    assert true_rel(dpc.M, res.x, b) < 5 * TOL
+
+
+@pytest.mark.parametrize("L", [2, 4])
+def test_bicgstab_l(problem, L):
+    dpc, b = problem
+    res = jax.jit(lambda v: bicgstab_l(dpc.M, v, L=L, tol=TOL,
+                                       maxiter=6000))(b)
+    assert bool(res.converged)
+    assert true_rel(dpc.M, res.x, b) < 5 * TOL
+
+
+def test_gcr(problem):
+    dpc, b = problem
+    res = gcr(dpc.M, b, tol=TOL, nkrylov=16, max_restarts=100)
+    assert bool(res.converged)
+    assert true_rel(dpc.M, res.x, b) < 5 * TOL
+
+
+def test_gcr_preconditioned(problem):
+    """Flexible GCR with an MR inner preconditioner (MG-style nesting)."""
+    dpc, b = problem
+    from quda_tpu.solvers import mr_fixed
+    K = lambda r: mr_fixed(dpc.M, r, 4, omega=0.8)
+    res = gcr(dpc.M, b, precond=K, tol=TOL, nkrylov=16, max_restarts=100)
+    assert bool(res.converged)
+    assert true_rel(dpc.M, res.x, b) < 5 * TOL
+
+
+def test_cg3_matches_cg(problem):
+    dpc, b = problem
+    mdagm = lambda v: dpc.Mdag(dpc.M(v))
+    rhs = dpc.Mdag(b)
+    r_cg = cg(mdagm, rhs, tol=TOL, maxiter=4000)
+    r_cg3 = jax.jit(lambda v: cg3(mdagm, v, tol=TOL, maxiter=4000))(rhs)
+    assert bool(r_cg3.converged)
+    assert true_rel(mdagm, r_cg3.x, rhs) < 5 * TOL
+    # same Krylov space -> comparable iteration counts
+    assert abs(int(r_cg3.iters) - int(r_cg.iters)) <= 10
+
+
+def test_cgnr_cgne(problem):
+    dpc, b = problem
+    r1 = cgnr(dpc.M, dpc.Mdag, b, tol=TOL, maxiter=4000)
+    assert bool(r1.converged)
+    assert true_rel(dpc.M, r1.x, b) < 1e-6
+    r2 = cgne(dpc.M, dpc.Mdag, b, tol=TOL, maxiter=4000)
+    assert bool(r2.converged)
+    assert true_rel(dpc.M, r2.x, b) < 1e-6
+
+
+def test_mr_reduces_residual(problem):
+    dpc, b = problem
+    res = mr(dpc.M, b, tol=1e-4, maxiter=200)
+    assert true_rel(dpc.M, res.x, b) < 0.5  # smoother, not a full solver
+
+
+def test_sd(problem):
+    dpc, b = problem
+    mdagm = lambda v: dpc.Mdag(dpc.M(v))
+    rhs = dpc.Mdag(b)
+    res = sd(mdagm, rhs, tol=1e-3, maxiter=2000)
+    assert true_rel(mdagm, res.x, rhs) < 2e-3
+
+
+@pytest.mark.parametrize("basis", ["power", "chebyshev"])
+def test_ca_cg(problem, basis):
+    dpc, b = problem
+    mdagm = lambda v: dpc.Mdag(dpc.M(v))
+    rhs = dpc.Mdag(b)
+    res = ca_cg(mdagm, rhs, s=6, tol=TOL, max_cycles=400, basis=basis,
+                lam=(0.05, 3.0))
+    assert bool(res.converged)
+    assert true_rel(mdagm, res.x, rhs) < 5 * TOL
+
+
+def test_ca_gcr(problem):
+    dpc, b = problem
+    res = ca_gcr(dpc.M, b, s=6, tol=TOL, max_cycles=500)
+    assert bool(res.converged)
+    assert true_rel(dpc.M, res.x, b) < 5 * TOL
+
+
+def test_chrono_mre_reduces_iters(problem):
+    """Forecasting from past solutions must cut the iteration count
+    (lib/inv_mre.cpp behavior)."""
+    dpc, b = problem
+    mdagm = lambda v: dpc.Mdag(dpc.M(v))
+    store = ChronoStore(4)
+    rhs1 = dpc.Mdag(b)
+    res1 = cg(mdagm, rhs1, tol=TOL, maxiter=4000)
+    store.add(res1.x)
+    # slightly perturbed rhs (HMC trajectory analog)
+    rhs2 = rhs1 + 0.01 * dpc.Mdag(0.5 * b)
+    cold = cg(mdagm, rhs2, tol=TOL, maxiter=4000)
+    x0 = store.guess(mdagm, rhs2)
+    warm = cg(mdagm, rhs2, x0=x0, tol=TOL, maxiter=4000)
+    assert int(warm.iters) < int(cold.iters)
+    assert true_rel(mdagm, warm.x, rhs2) < 5 * TOL
+
+
+def test_factory():
+    assert solvers.create("BiCGStab-L") is bicgstab_l
+    assert solvers.create("ca_cg") is ca_cg
+    with pytest.raises(ValueError):
+        solvers.create("nope")
