@@ -1,0 +1,39 @@
+"""Eigenvector deflation for solver acceleration.
+
+Reference behavior: lib/deflation.cpp (320 LoC), the deflation hooks in the
+Solver base (include/invert_quda.h deflate()/Solver::extendSVDDeflationSpace)
+— project the known low-mode subspace out of the right-hand side so the
+Krylov solver only works on the high-mode remainder.
+
+For a Hermitian operator with eigenpairs (lambda_i, v_i):
+    x0 = sum_i v_i <v_i, b> / lambda_i        (spectral solve on the space)
+then solve A dx = b - A x0 and return x0 + dx.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import blas
+
+
+class DeflationSpace(NamedTuple):
+    evecs: jnp.ndarray   # (n, ...) orthonormal
+    evals: jnp.ndarray   # (n,)
+
+
+def deflated_guess(space: DeflationSpace, b: jnp.ndarray) -> jnp.ndarray:
+    """x0 = V diag(1/lambda) V^dag b."""
+    coef = jnp.einsum("i...,...->i", jnp.conjugate(space.evecs), b)
+    coef = coef / jnp.asarray(space.evals, coef.dtype)
+    return jnp.einsum("i,i...->...", coef, space.evecs)
+
+
+def deflated_solve(solver: Callable, matvec: Callable,
+                   space: DeflationSpace, b: jnp.ndarray, **kw):
+    """Run `solver(matvec, rhs, x0=...)` with the deflated initial guess."""
+    x0 = deflated_guess(space, b)
+    return solver(matvec, b, x0=x0, **kw)

@@ -1,0 +1,124 @@
+"""Eigensolver tests: TRLM/IRAM vs dense/ARPACK references, deflation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse.linalg as ssl
+
+from quda_tpu.fields.geometry import EVEN, LatticeGeometry
+from quda_tpu.fields.spinor import ColorSpinorField, even_odd_split
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.models.dirac import apply_gamma5
+from quda_tpu.models.wilson import DiracWilson, DiracWilsonPC
+from quda_tpu.ops import blas
+from quda_tpu.eig.deflation import DeflationSpace, deflated_guess
+from quda_tpu.eig.iram import iram
+from quda_tpu.eig.lanczos import EigParam, chebyshev_op, trlm
+from quda_tpu.solvers.cg import cg
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+KAPPA = 0.125
+
+
+@pytest.fixture(scope="module")
+def setup():
+    key = jax.random.PRNGKey(101)
+    gauge = GaugeField.random(key, GEOM).data
+    dpc = DiracWilsonPC(gauge, GEOM, KAPPA)
+    example = even_odd_split(
+        ColorSpinorField.zeros(GEOM).data, GEOM)[0]
+    shape = example.shape
+    dim = int(np.prod(shape))
+
+    def to_flat(v):
+        return np.asarray(v).reshape(dim)
+
+    def from_flat(a):
+        return jnp.asarray(a.reshape(shape))
+
+    mv = jax.jit(dpc.MdagM)
+    linop = ssl.LinearOperator(
+        (dim, dim),
+        matvec=lambda a: to_flat(mv(from_flat(a.astype(np.complex128)))),
+        dtype=np.complex128)
+    return dpc, example, linop, from_flat
+
+
+def test_trlm_smallest_vs_arpack(setup):
+    dpc, example, linop, _ = setup
+    k = 6
+    want = np.sort(ssl.eigsh(linop, k=k, which="SA",
+                             return_eigenvectors=False))
+    param = EigParam(n_ev=k, n_kr=32, tol=1e-8, max_restarts=200)
+    res = trlm(dpc.MdagM, example, param)
+    assert res.converged
+    assert np.allclose(res.evals[:k], want, rtol=1e-6)
+    assert np.all(res.residua < 1e-6)
+
+
+def test_trlm_chebyshev_accelerated(setup):
+    dpc, example, linop, _ = setup
+    k = 4
+    want = np.sort(ssl.eigsh(linop, k=k, which="SA",
+                             return_eigenvectors=False))
+    # spectrum upper edge estimate for the filter window
+    lmax = float(ssl.eigsh(linop, k=1, which="LA",
+                           return_eigenvectors=False)[0])
+    param = EigParam(n_ev=k, n_kr=24, tol=1e-8, max_restarts=100,
+                     use_poly_acc=True, poly_deg=12,
+                     a_min=float(want[-1]) * 2.0, a_max=1.05 * lmax)
+    res = trlm(dpc.MdagM, example, param)
+    assert res.converged
+    assert np.allclose(res.evals[:k], want, rtol=1e-6)
+
+
+def test_chebyshev_op_amplifies_low_modes(setup):
+    dpc, example, _, _ = setup
+    op = chebyshev_op(dpc.MdagM, 10, 1.0, 4.0)
+    v = ColorSpinorField.gaussian(jax.random.PRNGKey(3), GEOM).data
+    ve, _ = even_odd_split(v, GEOM)
+    out = op(ve)
+    assert np.isfinite(float(blas.norm2(out)))
+
+
+def test_iram_nonhermitian(setup):
+    """Restarted Arnoldi on the non-Hermitian PC Wilson operator: the
+    largest-real-part eigenvalues (complex-conjugate pairs) must match
+    ARPACK."""
+    dpc, example, _, from_flat = setup
+    shape = example.shape
+    dim = int(np.prod(shape))
+    mv = jax.jit(dpc.M)
+    linop = ssl.LinearOperator(
+        (dim, dim),
+        matvec=lambda a: np.asarray(
+            mv(jnp.asarray(a.astype(np.complex128).reshape(shape)))
+        ).reshape(dim),
+        dtype=np.complex128)
+    k = 4
+    want = ssl.eigs(linop, k=k, which="LR", return_eigenvectors=False)
+    want = np.sort(want.real)[::-1]
+    param = EigParam(n_ev=k, n_kr=30, tol=1e-7, max_restarts=300,
+                     spectrum="LR")
+    res = iram(dpc.M, example, param)
+    assert res.converged
+    got = np.sort(np.asarray(res.evals).real)[::-1]
+    assert np.allclose(got, want, rtol=1e-6)
+    assert np.all(res.residua < 1e-5)
+
+
+def test_deflation_cuts_iterations(setup):
+    dpc, example, _, _ = setup
+    param = EigParam(n_ev=8, n_kr=32, tol=1e-10, max_restarts=200)
+    res = trlm(dpc.MdagM, example, param)
+    assert res.converged
+    b = even_odd_split(
+        ColorSpinorField.gaussian(jax.random.PRNGKey(5), GEOM).data, GEOM)[0]
+    space = DeflationSpace(res.evecs, jnp.asarray(res.evals))
+    cold = cg(dpc.MdagM, b, tol=1e-10, maxiter=2000)
+    x0 = deflated_guess(space, b)
+    warm = cg(dpc.MdagM, b, x0=x0, tol=1e-10, maxiter=2000)
+    assert int(warm.iters) < int(cold.iters)
+    r2 = blas.norm2(b - dpc.MdagM(warm.x))
+    assert float(jnp.sqrt(r2 / blas.norm2(b))) < 2e-10
