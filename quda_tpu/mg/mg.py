@@ -1,0 +1,173 @@
+"""Adaptive multigrid driver: setup (null vectors, transfer, coarse op),
+recursive V-cycle, and the MG-preconditioned outer solve.
+
+Reference behavior: lib/multigrid.cpp (MG::reset :91, createSmoother :289,
+createCoarseDirac :358, createCoarseSolver :581, operator() :1145,
+generateNullVectors :1249) and the newMultigridQuda/invertQuda wiring in
+lib/interface_quda.cpp.
+
+Setup per level:
+  1. generate n_vec near-null vectors of the level operator (loose inverse
+     iterations: solve M^dag M v = r_random to low accuracy),
+  2. block-orthonormalise them into a Transfer (batched QR),
+  3. probe the Galerkin coarse stencil (mg/coarse.py),
+  4. recurse until `n_levels`.
+
+Apply (the preconditioner for an outer flexible solver, GCR):
+  V-cycle: pre-smooth (fixed-iteration MR) -> restrict residual -> coarse
+  solve (recursive V-cycle, or GCR at the bottom) -> prolong-correct ->
+  post-smooth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import blas
+from ..solvers.cg import cg_fixed_iters
+from ..solvers.gcr import gcr, gcr_fixed, mr_fixed
+from .coarse import CoarseOperator, build_coarse
+from .transfer import Transfer, from_chiral, to_chiral
+
+
+@dataclasses.dataclass
+class MGLevelParam:
+    """Per-level knobs (QudaMultigridParam analog)."""
+    block: Tuple[int, int, int, int] = (2, 2, 2, 2)
+    n_vec: int = 8
+    setup_iters: int = 150          # inverse-iteration count per null vector
+    pre_smooth: int = 0             # QUDA default: no pre-smoothing
+    post_smooth: int = 4
+    smoother_omega: float = 0.85
+    coarse_solver_iters: int = 8    # GCR iterations on the bottom level
+
+
+class _LevelOp:
+    """Adapter giving every level the same face: M/diag/hop in CHIRAL
+    layout for fine Dirac operators; CoarseOperator already is."""
+
+    def __init__(self, dirac):
+        self.dirac = dirac
+
+    def M(self, v):
+        return to_chiral(self.dirac.M(from_chiral(v)))
+
+    def MdagM(self, v):
+        return to_chiral(self.dirac.MdagM(from_chiral(v)))
+
+
+class MG:
+    """Multigrid preconditioner hierarchy."""
+
+    def __init__(self, fine_dirac, geom, params: Sequence[MGLevelParam],
+                 key=None, verbosity: int = 0):
+        self.geom = geom
+        self.params = list(params)
+        if key is None:
+            key = jax.random.PRNGKey(2024)
+        self.levels: List[dict] = []
+        self._setup(fine_dirac, key, verbosity)
+
+    # -- setup ---------------------------------------------------------
+    def _generate_null_vectors(self, op_M, op_MdagM, example, n_vec, iters,
+                               key):
+        """Inverse iteration: v = (MdagM)^{-1}-ish random, normalised."""
+        vecs = []
+        solve = jax.jit(
+            lambda b: cg_fixed_iters(op_MdagM, b, None, iters)[0].x)
+        for i in range(n_vec):
+            k = jax.random.fold_in(key, i)
+            rdt = jnp.zeros((), example.dtype).real.dtype
+            re = jax.random.normal(k, example.shape, rdt)
+            im = jax.random.normal(jax.random.fold_in(k, 1), example.shape,
+                                   rdt)
+            b = (re + 1j * im).astype(example.dtype)
+            v = solve(b)
+            v = v / jnp.sqrt(blas.norm2(v)).astype(v.dtype)
+            vecs.append(v)
+        return jnp.stack(vecs)
+
+    def _setup(self, fine_dirac, key, verbosity):
+        level_op = _LevelOp(fine_dirac)
+        lat_shape = self.geom.lattice_shape
+        k_fine = 6
+        for li, p in enumerate(self.params):
+            example = jnp.zeros(lat_shape + (2, k_fine),
+                                fine_dirac.gauge.dtype
+                                if hasattr(fine_dirac, "gauge")
+                                else jnp.complex128)
+            if isinstance(level_op, _LevelOp):
+                example = example.astype(level_op.dirac.gauge.dtype)
+                MdagM = level_op.MdagM
+                parts = _FinePartsAdapter(level_op.dirac)
+            else:
+                example = example.astype(level_op.x_diag.dtype)
+                MdagM = level_op.MdagM
+                parts = level_op
+            nulls = self._generate_null_vectors(
+                level_op.M, MdagM, example, p.n_vec, p.setup_iters,
+                jax.random.fold_in(key, li))
+            transfer = Transfer.from_null_vectors(nulls, p.block)
+            coarse = build_coarse(parts, transfer)
+            self.levels.append(dict(op=level_op, transfer=transfer,
+                                    coarse=coarse, param=p))
+            if verbosity:
+                print(f"MG level {li}: lattice {lat_shape} k={k_fine} "
+                      f"-> coarse {transfer.coarse_shape} n_vec={p.n_vec}")
+            # descend
+            level_op = coarse
+            lat_shape = transfer.coarse_shape
+            k_fine = p.n_vec
+
+    # -- apply ---------------------------------------------------------
+    def vcycle(self, level: int, b, x0=None):
+        """Approximately solve M_level x = b (chiral layout)."""
+        lv = self.levels[level]
+        op, tr, coarse, p = lv["op"], lv["transfer"], lv["coarse"], lv["param"]
+        x = jnp.zeros_like(b) if x0 is None else x0
+        if p.pre_smooth:
+            x = mr_fixed(op.M, b, p.pre_smooth, p.smoother_omega, x0=x)
+        r = b - op.M(x)
+        rc = tr.restrict(r)
+        if level + 1 < len(self.levels):
+            ec = self.vcycle(level + 1, rc)
+        else:
+            ec = gcr_fixed(coarse.M, rc, nkrylov=p.coarse_solver_iters,
+                           cycles=2)
+        x = x + tr.prolong(ec)
+        if p.post_smooth:
+            x = mr_fixed(op.M, b, p.post_smooth, p.smoother_omega, x0=x)
+        return x
+
+    def precondition(self, r_std):
+        """K(r) for an outer solver in STANDARD spin layout."""
+        return from_chiral(self.vcycle(0, to_chiral(r_std)))
+
+
+class _FinePartsAdapter:
+    """diag/hop of a fine Dirac operator, exposed in the chiral layout."""
+
+    def __init__(self, dirac):
+        self.dirac = dirac
+
+    def diag(self, v):
+        return to_chiral(self.dirac.diag(from_chiral(v)))
+
+    def hop(self, v, mu, sign):
+        return to_chiral(self.dirac.hop(from_chiral(v), mu, sign))
+
+
+def mg_solve(fine_dirac, geom, b_std, params: Sequence[MGLevelParam],
+             tol: float = 1e-10, nkrylov: int = 16, max_restarts: int = 100,
+             key=None, mg: Optional[MG] = None):
+    """Outer GCR preconditioned by the MG V-cycle (QUDA's standard wiring:
+    invertQuda with inv_type=GCR, inv_type_precondition=MG)."""
+    if mg is None:
+        mg = MG(fine_dirac, geom, params, key)
+    res = gcr(fine_dirac.M, b_std, precond=mg.precondition, tol=tol,
+              nkrylov=nkrylov, max_restarts=max_restarts)
+    return res, mg

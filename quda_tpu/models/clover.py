@@ -48,6 +48,14 @@ class DiracClover(Dirac):
     def M(self, psi):
         return self.A(psi) - self.kappa * self.D(psi)
 
+    # --- diag + hop decomposition (MG coarsening probes) ---
+    def diag(self, psi):
+        return self.A(psi)
+
+    def hop(self, psi, mu, sign):
+        from .wilson import DiracWilson
+        return DiracWilson.hop(self, psi, mu, sign)
+
     def flops_per_site_M(self) -> int:
         return 1320 + 504 + 48  # dslash + clover (2x 6x6 matvec) + axpy
 

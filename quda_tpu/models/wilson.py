@@ -36,6 +36,25 @@ class DiracWilson(Dirac):
     def M(self, psi):
         return psi - self.kappa * self.D(psi)
 
+    # --- diag + per-direction hop decomposition (MG coarsening probes) ---
+    def diag(self, psi):
+        return psi
+
+    def hop(self, psi, mu, sign):
+        """-kappa * single-direction Wilson hop (M = diag + sum hops)."""
+        from ..ops.gamma import PROJ_MINUS, PROJ_PLUS
+        from ..ops.shift import shift
+        from ..ops.su3 import dagger
+        if sign > 0:
+            u = self.gauge[mu]
+            proj = jnp.asarray(PROJ_MINUS[mu], psi.dtype)
+            h = jnp.einsum("...ab,...sb->...sa", u, shift(psi, mu, +1))
+        else:
+            u = shift(dagger(self.gauge[mu]), mu, -1)
+            proj = jnp.asarray(PROJ_PLUS[mu], psi.dtype)
+            h = jnp.einsum("...ab,...sb->...sa", u, shift(psi, mu, -1))
+        return -self.kappa * jnp.einsum("st,...tc->...sc", proj, h)
+
     def flops_per_site_M(self) -> int:
         return 1320 + 48  # dslash + axpy (include/dslash.h:475 flop model)
 

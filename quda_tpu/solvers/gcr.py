@@ -61,6 +61,32 @@ def gcr(matvec: Callable, b: jnp.ndarray, precond: Optional[Callable] = None,
     return SolverResult(x, jnp.int32(total), r2, r2 <= stop)
 
 
+def gcr_fixed(matvec: Callable, b: jnp.ndarray, nkrylov: int = 8,
+              cycles: int = 1, x0=None) -> jnp.ndarray:
+    """Fixed-work GCR (no convergence test) — jit-pure; used as the
+    coarsest-level solver inside MG V-cycles."""
+    x = jnp.zeros_like(b) if x0 is None else x0
+    r = b if x0 is None else b - matvec(x)
+    tiny = 1e-30
+    for _ in range(cycles):
+        ps, aps, ap2s = [], [], []
+        for _ in range(nkrylov):
+            z = r
+            az = matvec(z)
+            for p_i, ap_i, ap2_i in zip(ps, aps, ap2s):
+                c = blas.cdot(ap_i, az) / (ap2_i + tiny).astype(b.dtype)
+                az = az - c * ap_i
+                z = z - c * p_i
+            ap2 = blas.norm2(az)
+            ps.append(z)
+            aps.append(az)
+            ap2s.append(ap2)
+            alpha = blas.cdot(az, r) / (ap2 + tiny).astype(b.dtype)
+            x = x + alpha * z
+            r = r - alpha * az
+    return x
+
+
 def mr(matvec: Callable, b: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
        tol: float = 1e-10, maxiter: int = 100,
        omega: float = 1.0) -> SolverResult:
