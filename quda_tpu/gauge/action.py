@@ -1,0 +1,160 @@
+"""Gauge actions, forces via automatic differentiation, and HMC.
+
+Reference behavior: lib/gauge_force.cu + kernels/gauge_force.cuh (staple
+evaluation from path tables), lib/gauge_loop_trace.cu, lib/momentum.cu
+(momActionQuda, force monitor), lib/gauge_update_quda.cu (U <- exp(i eps p) U),
+plus the MILC-driven HMC workflow (lib/milc_interface.cpp).
+
+TPU-native design — THE key departure from the reference: forces are
+jax.grad of the action.  QUDA hand-derives every force (generic path
+staples, clover force chain rule, HISQ force with SVD differentiation,
+2000+ LoC); here ANY differentiable action — plaquette, rectangle,
+smeared, or a pseudofermion quadratic form through the whole solver chain
+— gets its su(3)-projected force from one `gauge_force` call.  Correctness
+is pinned by finite-difference tests and leapfrog energy conservation
+(dH = O(dt^2) scaling).
+
+Conventions: momenta P are Hermitian traceless (fields of su(3) coeffs
+p_a: P = sum_a p_a T_a); U(t) = exp(i t P) U; H = tr(P^2) + S(U);
+force F = sum_a T_a dS/d(theta_a) so that dP/dt = -F conserves H.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.fmunu import PLANES
+from ..ops.su3 import (dagger, expm_su3, mat_mul, project_su3,
+                       random_hermitian_traceless, trace)
+from .observables import plaquette_field
+
+
+# -- actions ---------------------------------------------------------------
+
+def wilson_action(gauge: jnp.ndarray, beta: float) -> jnp.ndarray:
+    """S = beta sum_{x, mu<nu} (1 - Re tr P_{mu nu} / 3)."""
+    s = 0.0
+    for mu, nu in PLANES:
+        p = trace(plaquette_field(gauge, mu, nu)).real / 3.0
+        s = s + jnp.sum(1.0 - p)
+    return beta * s
+
+
+def rectangle_field(gauge, mu, nu):
+    """2x1 loop R_{mu mu nu}(x) (for improved actions)."""
+    from ..ops.shift import shift
+    u_mu, u_nu = gauge[mu], gauge[nu]
+    two = mat_mul(u_mu, shift(u_mu, mu, +1))           # 2-link in mu
+    top = mat_mul(two, shift(u_nu, mu, 2))
+    bot = mat_mul(u_nu, shift(two, nu, +1))
+    return mat_mul(top, dagger(bot))
+
+
+def improved_action(gauge: jnp.ndarray, beta: float, c1: float):
+    """Luscher-Weisz class: c0 * plaq + c1 * rect, c0 = 1 - 8 c1
+    (c1 = -1/12: tree-level Symanzik; c1 = -0.331: Iwasaki)."""
+    c0 = 1.0 - 8.0 * c1
+    s = 0.0
+    for mu, nu in PLANES:
+        p = trace(plaquette_field(gauge, mu, nu)).real / 3.0
+        s = s + c0 * jnp.sum(1.0 - p)
+        r1 = trace(rectangle_field(gauge, mu, nu)).real / 3.0
+        r2 = trace(rectangle_field(gauge, nu, mu)).real / 3.0
+        s = s + c1 * (jnp.sum(1.0 - r1) + jnp.sum(1.0 - r2))
+    return beta * s
+
+
+# -- force via AD ----------------------------------------------------------
+
+def traceless_hermitian(m: jnp.ndarray) -> jnp.ndarray:
+    h = 0.5 * (m + dagger(m))
+    tr = trace(h) / 3.0
+    return h - tr[..., None, None] * jnp.eye(3, dtype=m.dtype)
+
+
+def gauge_force(action_fn: Callable, gauge: jnp.ndarray) -> jnp.ndarray:
+    """F_mu(x) = sum_a T_a dS/dtheta_a for U -> exp(i theta) U.
+
+    JAX's grad g of a real scalar wrt complex U satisfies
+    dS = Re sum conj(g) dU with g = dS/dRe(U) + i dS/dIm(U).
+    With dU = i Q U:  dS = Re tr(i Q U g^dag), giving the Hermitian
+    traceless force F = TA( i (M - M^dag) ) / 2 with M = U g^dag.
+    """
+    g = jax.grad(lambda u: action_fn(u).real)(gauge)
+    g = jnp.conjugate(g)  # JAX returns conj(dS/dRe + i dS/dIm) for real S
+    m = mat_mul(gauge, dagger(g))
+    k = 0.5j * (m - dagger(m))
+    # with H = tr(P^2) + S and dU/dt = i P U, energy conservation fixes
+    # F = TA(K)/2  (dS/dt = tr(P K), dT/dt = -2 tr(P F))
+    return 0.5 * traceless_hermitian(k)
+
+
+# -- momenta / update ------------------------------------------------------
+
+def random_momentum(key, gauge_shape, dtype=jnp.complex128):
+    """Gaussian su(3) momenta, <p_a^2> = 1 (gaussGaugeQuda mom mode)."""
+    return random_hermitian_traceless(key, gauge_shape, dtype=dtype)
+
+
+def mom_action(p: jnp.ndarray) -> jnp.ndarray:
+    """T = tr(P^2) summed (= 1/2 sum_a p_a^2; momActionQuda analog)."""
+    return jnp.sum(trace(mat_mul(p, p)).real)
+
+
+def update_gauge(gauge: jnp.ndarray, p: jnp.ndarray,
+                 eps: float) -> jnp.ndarray:
+    """U <- exp(i eps P) U (updateGaugeFieldQuda)."""
+    return mat_mul(expm_su3(eps * p), gauge)
+
+
+# -- integrators / HMC -----------------------------------------------------
+
+class HMCResult(NamedTuple):
+    gauge: jnp.ndarray
+    accept: jnp.ndarray
+    dH: jnp.ndarray
+    plaq: jnp.ndarray
+
+
+def leapfrog(action_fn, gauge, p, n_steps: int, dt: float):
+    """Standard leapfrog: half-kick, n drifts/kicks, half-kick."""
+    f = gauge_force(action_fn, gauge)
+    p = p - (0.5 * dt) * f
+    for i in range(n_steps):
+        gauge = update_gauge(gauge, p, dt)
+        f = gauge_force(action_fn, gauge)
+        p = p - (dt if i < n_steps - 1 else 0.5 * dt) * f
+    return gauge, p
+
+
+def omf2(action_fn, gauge, p, n_steps: int, dt: float,
+         lam: float = 0.1931833275037836):
+    """2nd-order Omelyan integrator (QUDA/MILC default flavor)."""
+    for _ in range(n_steps):
+        p = p - (lam * dt) * gauge_force(action_fn, gauge)
+        gauge = update_gauge(gauge, p, 0.5 * dt)
+        p = p - ((1.0 - 2.0 * lam) * dt) * gauge_force(action_fn, gauge)
+        gauge = update_gauge(gauge, p, 0.5 * dt)
+        p = p - (lam * dt) * gauge_force(action_fn, gauge)
+    return gauge, p
+
+
+def hmc_trajectory(key, action_fn, gauge, n_steps: int = 10,
+                   dt: float = 0.1, integrator=leapfrog) -> HMCResult:
+    """One HMC trajectory with Metropolis accept/reject."""
+    from .observables import plaquette
+    k_mom, k_acc = jax.random.split(key)
+    p0 = random_momentum(k_mom, gauge.shape[:-2], gauge.dtype)
+    h0 = mom_action(p0) + action_fn(gauge)
+    g1, p1 = integrator(action_fn, gauge, p0, n_steps, dt)
+    h1 = mom_action(p1) + action_fn(g1)
+    dh = h1 - h0
+    u = jax.random.uniform(k_acc, ())
+    accept = u < jnp.exp(jnp.minimum(-dh, 0.0))
+    g_new = jnp.where(accept, g1, gauge)
+    # reunitarise drift (QUDA projects after update too)
+    g_new = project_su3(g_new)
+    return HMCResult(g_new, accept, dh, plaquette(g_new)[0])

@@ -1,0 +1,71 @@
+"""Gauge observables: plaquette, Polyakov loop, topological charge, energy.
+
+Reference behavior: lib/gauge_plaq.cu, lib/gauge_polyakov_loop.cu,
+lib/gauge_qcharge.cu, lib/gauge_observable.cpp (gaugeObservablesQuda).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..ops.fmunu import PLANES, field_strength
+from ..ops.shift import shift
+from ..ops.su3 import dagger, mat_mul, trace
+
+
+def plaquette_field(gauge: jnp.ndarray, mu: int, nu: int) -> jnp.ndarray:
+    """P_{mu nu}(x) = U_mu(x) U_nu(x+mu) U_mu(x+nu)^dag U_nu(x)^dag."""
+    u_mu, u_nu = gauge[mu], gauge[nu]
+    return mat_mul(mat_mul(u_mu, shift(u_nu, mu, +1)),
+                   dagger(mat_mul(u_nu, shift(u_mu, nu, +1))))
+
+
+def plaquette(gauge: jnp.ndarray):
+    """(mean, spatial, temporal) normalised Re tr P / 3 (plaqQuda order)."""
+    sp, tm = [], []
+    for mu, nu in PLANES:
+        p = jnp.mean(trace(plaquette_field(gauge, mu, nu)).real) / 3.0
+        (tm if nu == 3 else sp).append(p)
+    s = sum(sp) / len(sp)
+    t = sum(tm) / len(tm)
+    return (s + t) / 2.0, s, t
+
+
+def polyakov_loop(gauge: jnp.ndarray):
+    """Volume-averaged trace of the temporal Wilson line
+    (lib/gauge_polyakov_loop.cu).  Returns complex <tr L>/3."""
+    u_t = gauge[3]                    # (T,Z,Y,X,3,3)
+    T = u_t.shape[0]
+    line = u_t[0]
+    for t in range(1, T):
+        line = mat_mul(line, u_t[t])
+    return jnp.mean(trace(line)) / 3.0
+
+
+def qcharge_density(gauge: jnp.ndarray) -> jnp.ndarray:
+    """Topological charge density q(x) = eps_{mu nu rho sigma}
+    tr[F F] / 32 pi^2 from the clover field strength
+    (kernels/gauge_qcharge.cuh)."""
+    f = field_strength(gauge)   # Hermitian F_h; lattice F = i F_h
+    # eps contraction over the 6 planes: (01)(23) - (02)(13) + (03)(12)
+    fxy, fxz, fxt, fyz, fyt, fzt = (f[i] for i in range(6))
+    dens = (trace(mat_mul(fxy, fzt)) - trace(mat_mul(fxz, fyt))
+            + trace(mat_mul(fxt, fyz)))
+    # tr(F^latt F^latt) = -tr(F_h F_h); overall factor 8 from eps pairs
+    return -8.0 * dens.real / (32.0 * math.pi ** 2)
+
+
+def qcharge(gauge: jnp.ndarray):
+    return jnp.sum(qcharge_density(gauge))
+
+
+def energy(gauge: jnp.ndarray):
+    """(total, spatial E, temporal B-ish) field-strength energy
+    E = sum tr F^2 (gauge_qcharge.cuh qcharge+energy mode)."""
+    f = field_strength(gauge)
+    e = [jnp.sum(trace(mat_mul(f[i], f[i])).real) for i in range(6)]
+    spatial = e[0] + e[1] + e[3]   # xy, xz, yz
+    temporal = e[2] + e[4] + e[5]  # xt, yt, zt
+    return spatial + temporal, spatial, temporal

@@ -1,0 +1,138 @@
+"""Gauge observables, AD force correctness, HMC energy conservation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import LatticeGeometry
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.gauge.action import (gauge_force, hmc_trajectory, improved_action,
+                                   leapfrog, mom_action, omf2, random_momentum,
+                                   traceless_hermitian, update_gauge,
+                                   wilson_action)
+from quda_tpu.gauge.observables import (energy, plaquette, polyakov_loop,
+                                        qcharge, qcharge_density)
+from quda_tpu.ops.su3 import dagger, expm_su3, mat_mul, trace
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    key = jax.random.PRNGKey(500)
+    return GaugeField.random(key, GEOM, scale=0.5).data
+
+
+def test_plaquette_unit_gauge():
+    u = GaugeField.unit(GEOM).data
+    mean, sp, tm = plaquette(u)
+    assert np.allclose([float(mean), float(sp), float(tm)], 1.0)
+    assert np.isclose(complex(polyakov_loop(u)).real, 1.0)
+
+
+def test_plaquette_random_range(cfg):
+    mean, sp, tm = plaquette(cfg)
+    assert 0.0 < float(mean) < 1.0
+    assert np.isclose(float(mean), (float(sp) + float(tm)) / 2.0)
+
+
+def test_plaquette_gauge_invariance(cfg):
+    """Plaquette must be invariant under random gauge transformations."""
+    from quda_tpu.ops.shift import shift
+    from quda_tpu.ops.su3 import random_su3
+    g = random_su3(jax.random.PRNGKey(7), GEOM.lattice_shape)
+    transformed = jnp.stack([
+        mat_mul(mat_mul(g, cfg[mu]), dagger(shift(g, mu, +1)))
+        for mu in range(4)])
+    assert np.isclose(float(plaquette(transformed)[0]),
+                      float(plaquette(cfg)[0]), atol=1e-12)
+
+
+def test_qcharge_properties(cfg):
+    q = float(qcharge(cfg))
+    assert np.isfinite(q)
+    dens = qcharge_density(cfg)
+    assert dens.dtype in (jnp.float64, jnp.float32)
+    # unit gauge: zero topological charge
+    assert np.isclose(float(qcharge(GaugeField.unit(GEOM).data)), 0.0)
+
+
+def test_force_matches_finite_difference(cfg):
+    """dS/dtheta along a random su(3) direction vs finite differences."""
+    beta = 5.5
+    act = lambda u: wilson_action(u, beta)
+    f = gauge_force(act, cfg)
+    # force must be traceless Hermitian
+    assert np.allclose(np.asarray(trace(f)), 0.0, atol=1e-10)
+    assert np.allclose(np.asarray(f), np.asarray(dagger(f)), atol=1e-12)
+
+    from quda_tpu.ops.su3 import random_hermitian_traceless
+    q = random_hermitian_traceless(jax.random.PRNGKey(3), cfg.shape[:-2],
+                                   dtype=cfg.dtype)
+    eps = 1e-5
+    up = mat_mul(expm_su3(eps * q), cfg)
+    dn = mat_mul(expm_su3(-eps * q), cfg)
+    fd = (float(act(up)) - float(act(dn))) / (2 * eps)
+    # analytic: dS/dt = sum_a q_a f_a = 2 sum tr(Q F)
+    ana = 2.0 * float(jnp.sum(trace(mat_mul(q, f)).real))
+    assert np.isclose(fd, ana, rtol=1e-6), (fd, ana)
+
+
+def test_improved_action_force_fd(cfg):
+    act = lambda u: improved_action(u, 5.0, -1.0 / 12.0)
+    f = gauge_force(act, cfg)
+    from quda_tpu.ops.su3 import random_hermitian_traceless
+    q = random_hermitian_traceless(jax.random.PRNGKey(9), cfg.shape[:-2],
+                                   dtype=cfg.dtype)
+    eps = 1e-5
+    fd = (float(act(mat_mul(expm_su3(eps * q), cfg)))
+          - float(act(mat_mul(expm_su3(-eps * q), cfg)))) / (2 * eps)
+    ana = 2.0 * float(jnp.sum(trace(mat_mul(q, f)).real))
+    assert np.isclose(fd, ana, rtol=1e-6)
+
+
+def test_leapfrog_energy_scaling(cfg):
+    """dH ~ O(dt^2): halving dt must cut |dH| by ~4 (reversible,
+    symplectic integrator + correct force)."""
+    beta = 5.5
+    act = lambda u: wilson_action(u, beta)
+    p0 = random_momentum(jax.random.PRNGKey(1), cfg.shape[:-2], cfg.dtype)
+
+    def dh(dt, n):
+        g1, p1 = leapfrog(act, cfg, p0, n, dt)
+        return float(mom_action(p1) + act(g1) - mom_action(p0) - act(cfg))
+
+    d1 = dh(0.0125, 32)
+    d2 = dh(0.00625, 64)
+    # second-order symplectic: ratio must approach 4
+    assert 3.0 < abs(d1) / abs(d2) < 5.0
+    assert abs(d2) < 0.1
+
+
+def test_leapfrog_reversibility(cfg):
+    act = lambda u: wilson_action(u, 5.5)
+    p0 = random_momentum(jax.random.PRNGKey(2), cfg.shape[:-2], cfg.dtype)
+    g1, p1 = leapfrog(act, cfg, p0, 6, 0.05)
+    g2, p2 = leapfrog(act, g1, -p1, 6, 0.05)
+    assert np.allclose(np.asarray(g2), np.asarray(cfg), atol=1e-9)
+    assert np.allclose(np.asarray(p2), np.asarray(-p0), atol=1e-9)
+
+
+def test_omf2_more_accurate_than_leapfrog(cfg):
+    act = lambda u: wilson_action(u, 5.5)
+    p0 = random_momentum(jax.random.PRNGKey(4), cfg.shape[:-2], cfg.dtype)
+    g1, p1 = leapfrog(act, cfg, p0, 10, 0.05)
+    dh_lf = abs(float(mom_action(p1) + act(g1) - mom_action(p0) - act(cfg)))
+    g2, p2 = omf2(act, cfg, p0, 10, 0.05)
+    dh_om = abs(float(mom_action(p2) + act(g2) - mom_action(p0) - act(cfg)))
+    assert dh_om < dh_lf
+
+
+def test_hmc_trajectory_runs(cfg):
+    act = lambda u: wilson_action(u, 5.5)
+    res = hmc_trajectory(jax.random.PRNGKey(10), act, cfg, n_steps=8,
+                         dt=0.05, integrator=omf2)
+    assert np.isfinite(float(res.dH))
+    assert abs(float(res.dH)) < 1.0
+    assert 0.0 < float(res.plaq) < 1.0

@@ -1,0 +1,143 @@
+"""Smearing, gradient flow, and AD fermion-force tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import LatticeGeometry
+from quda_tpu.fields.spinor import ColorSpinorField
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.gauge.action import (leapfrog, mom_action, random_momentum,
+                                   update_gauge, wilson_action, gauge_force)
+from quda_tpu.gauge.fermion_force import pseudofermion_force
+from quda_tpu.gauge.observables import plaquette, qcharge
+from quda_tpu.gauge.smear import (ape_smear, hyp_smear, stout_smear,
+                                  wilson_flow, wilson_flow_step)
+from quda_tpu.models.wilson import DiracWilson
+from quda_tpu.ops import blas
+from quda_tpu.ops.su3 import dagger, expm_su3, mat_mul, trace, \
+    random_hermitian_traceless
+from quda_tpu.solvers.cg import cg
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return GaugeField.random(jax.random.PRNGKey(321), GEOM, scale=0.5).data
+
+
+def _check_su3(u):
+    eye = np.broadcast_to(np.eye(3), u.shape)
+    assert np.allclose(np.asarray(mat_mul(u, dagger(u))), eye, atol=1e-8)
+    assert np.allclose(np.asarray(jnp.linalg.det(u)), 1.0, atol=1e-8)
+
+
+@pytest.mark.parametrize("smear,kw", [
+    (ape_smear, dict(alpha=0.6)),
+    (stout_smear, dict(rho=0.1)),
+    (stout_smear, dict(rho=0.06, epsilon=-0.25)),  # over-improved
+    (hyp_smear, dict()),
+])
+def test_smearing_smooths_and_stays_su3(cfg, smear, kw):
+    p0 = float(plaquette(cfg)[0])
+    out = smear(cfg, n_steps=2, **kw)
+    _check_su3(out)
+    p1 = float(plaquette(out)[0])
+    assert p1 > p0  # smoother configuration
+
+
+def test_ape_spatial_only_keeps_temporal(cfg):
+    out = ape_smear(cfg, alpha=0.6, spatial_only=True)
+    assert np.array_equal(np.asarray(out[3]), np.asarray(cfg[3]))
+    assert not np.allclose(np.asarray(out[0]), np.asarray(cfg[0]))
+
+
+def test_wilson_flow_smooths(cfg):
+    hist = []
+    out, hist = wilson_flow(cfg, eps=0.02, n_steps=6,
+                            measure=lambda u, t: float(plaquette(u)[0]))
+    _check_su3(out)
+    # plaquette increases monotonically along the flow
+    assert all(b > a for a, b in zip(hist, hist[1:]))
+    assert hist[0] > float(plaquette(cfg)[0])
+
+
+def test_flow_preserves_gauge_invariants_sanity(cfg):
+    q0 = float(qcharge(cfg))
+    out = wilson_flow_step(cfg, 0.01)
+    q1 = float(qcharge(out))
+    assert np.isfinite(q1)
+    # one small step cannot jump the charge wildly
+    assert abs(q1 - q0) < 1.0
+
+
+def test_pseudofermion_force_finite_difference(cfg):
+    """AD force through the Wilson operator == finite differences."""
+    kappa = 0.1
+    key = jax.random.PRNGKey(5)
+    phi = ColorSpinorField.gaussian(key, GEOM).data
+
+    def make_mdagm(u):
+        d = DiracWilson(u, GEOM, kappa)
+        return d.MdagM
+
+    x = cg(make_mdagm(cfg), phi, tol=1e-12, maxiter=500).x
+    f = pseudofermion_force(make_mdagm, cfg, x)
+    assert np.allclose(np.asarray(trace(f)), 0.0, atol=1e-10)
+    assert np.allclose(np.asarray(f), np.asarray(dagger(f)), atol=1e-12)
+
+    def s_pf(u):
+        xs = cg(make_mdagm(u), phi, tol=1e-13, maxiter=800).x
+        return float(blas.redot(phi, xs))
+
+    q = random_hermitian_traceless(jax.random.PRNGKey(6), cfg.shape[:-2],
+                                   dtype=cfg.dtype)
+    eps = 1e-5
+    fd = (s_pf(mat_mul(expm_su3(eps * q), cfg))
+          - s_pf(mat_mul(expm_su3(-eps * q), cfg))) / (2 * eps)
+    ana = 2.0 * float(jnp.sum(trace(mat_mul(q, f)).real))
+    assert np.isclose(fd, ana, rtol=1e-5), (fd, ana)
+
+
+def test_dynamical_hmc_energy_scaling(cfg):
+    """Full 2-flavor-Wilson HMC step: gauge + AD fermion force conserve H
+    at O(dt^2) — the computeCloverForceQuda-class integration test."""
+    kappa = 0.1
+    beta = 5.5
+    key = jax.random.PRNGKey(77)
+    # pseudofermion heatbath: phi = Mdag eta
+    eta = ColorSpinorField.gaussian(key, GEOM).data
+    d0 = DiracWilson(cfg, GEOM, kappa)
+    phi = d0.Mdag(eta)
+
+    def make_mdagm(u):
+        d = DiracWilson(u, GEOM, kappa)
+        return d.MdagM
+
+    solve = lambda u: cg(make_mdagm(u), phi, tol=1e-12, maxiter=800).x
+
+    def total_action(u):
+        xs = solve(u)
+        return float(wilson_action(u, beta)) + float(blas.redot(phi, xs))
+
+    def force(u):
+        fg = gauge_force(lambda v: wilson_action(v, beta), u)
+        ff = pseudofermion_force(make_mdagm, u, solve(u))
+        return fg + ff
+
+    p0 = random_momentum(jax.random.PRNGKey(8), cfg.shape[:-2], cfg.dtype)
+
+    def dh(dt, n):
+        u, p = cfg, p0
+        p = p - (0.5 * dt) * force(u)
+        for i in range(n):
+            u = update_gauge(u, p, dt)
+            p = p - (dt if i < n - 1 else 0.5 * dt) * force(u)
+        return (float(mom_action(p)) + total_action(u)
+                - float(mom_action(p0)) - total_action(cfg))
+
+    d1 = dh(0.02, 4)
+    d2 = dh(0.01, 8)
+    assert 2.5 < abs(d1) / abs(d2) < 6.0, (d1, d2)
