@@ -1,0 +1,168 @@
+"""Pure-gauge Monte Carlo: SU(3) heatbath + overrelaxation, hot/cold starts.
+
+Reference behavior: lib/pgauge_heatbath.cu (kernels/gauge_heatbath.cuh, 666
+LoC), lib/pgauge_init.cu.  Cabibbo-Marinari pseudo-heatbath over the three
+SU(2) subgroups with Kennedy-Pendleton sampling, plus microcanonical
+overrelaxation, updating one (parity, direction) checkerboard at a time
+(staples never touch links being updated).
+
+JAX-native rejection sampling: each site draws a fixed budget of K
+candidate (delta, accept) pairs at once and selects the first accepted via
+a masked argmax — no data-dependent loops.  At physical couplings
+(alpha = beta*k/3 >~ 1) the per-try acceptance is high and K=24 makes the
+miss probability negligible; misses keep the old link (exact for K -> inf).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields.geometry import LatticeGeometry
+from ..ops.su3 import dagger, mat_mul, random_su3, trace, unit_gauge
+from .smear import staple_sum
+
+# SU(2) subgroup index pairs within SU(3)
+SUBGROUPS = ((0, 1), (0, 2), (1, 2))
+
+
+def hot_start(key, geom: LatticeGeometry, dtype=jnp.complex128):
+    return random_su3(key, (4,) + geom.lattice_shape, dtype, scale=1.0)
+
+
+def cold_start(geom: LatticeGeometry, dtype=jnp.complex128):
+    return unit_gauge((4,) + geom.lattice_shape, dtype)
+
+
+def _subgroup_quaternion(w, i, j):
+    """b-vector of Re tr(g W) = a . b over the (i,j) SU(2) subgroup:
+    b0 = Re(Wii + Wjj), b1 = -Im(Wij + Wji), b2 = -Re(Wij - Wji),
+    b3 = -Im(Wii - Wjj)."""
+    wii, wjj = w[..., i, i], w[..., j, j]
+    wij, wji = w[..., i, j], w[..., j, i]
+    b0 = (wii + wjj).real
+    b1 = -(wij + wji).imag
+    b2 = -(wij - wji).real
+    b3 = -(wii - wjj).imag
+    return b0, b1, b2, b3
+
+
+def _embed_su2(a0, a1, a2, a3, i, j, dtype, lat_shape):
+    """Embed quaternion a into SU(3) as an (i,j)-subgroup rotation."""
+    g = jnp.zeros(lat_shape + (3, 3), dtype)
+    for k in range(3):
+        g = g.at[..., k, k].set(1.0)
+    g = g.at[..., i, i].set(a0 + 1j * a3)
+    g = g.at[..., i, j].set(a2 + 1j * a1)
+    g = g.at[..., j, i].set(-a2 + 1j * a1)
+    g = g.at[..., j, j].set(a0 - 1j * a3)
+    return g
+
+
+def _kp_sample(key, alpha, n_tries: int = 24):
+    """Kennedy-Pendleton: x0 in [-1,1] with P ~ sqrt(1-x0^2) e^{alpha x0}.
+
+    Returns (x0, ok) — ok=False where all tries rejected.
+    """
+    shape = alpha.shape
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    eps = 1e-12
+    r1 = jax.random.uniform(k1, (n_tries,) + shape, minval=eps, maxval=1.0)
+    r2 = jax.random.uniform(k2, (n_tries,) + shape)
+    r3 = jax.random.uniform(k3, (n_tries,) + shape, minval=eps, maxval=1.0)
+    r4 = jax.random.uniform(k4, (n_tries,) + shape)
+    a = jnp.maximum(alpha, 1e-10)
+    delta = -(jnp.log(r1) + jnp.cos(2 * jnp.pi * r2) ** 2 * jnp.log(r3)) / a
+    accept = (r4 ** 2) <= jnp.maximum(1.0 - 0.5 * delta, 0.0)
+    # first accepted try per site
+    idx = jnp.argmax(accept, axis=0)
+    any_ok = jnp.any(accept, axis=0)
+    d = jnp.take_along_axis(delta, idx[None], axis=0)[0]
+    return 1.0 - d, any_ok
+
+
+def _site_mask(geom: LatticeGeometry, parity: int):
+    T, Z, Y, X = geom.lattice_shape
+    t = np.arange(T)[:, None, None, None]
+    z = np.arange(Z)[None, :, None, None]
+    y = np.arange(Y)[None, None, :, None]
+    x = np.arange(X)[None, None, None, :]
+    return ((x + y + z + t) % 2 == parity)
+
+
+def _subgroup_update(key, u_mu, a_staple, beta, sg, heatbath: bool,
+                     n_tries: int):
+    """One SU(2)-subgroup update of all sites of u_mu (masked outside)."""
+    i, j = sg
+    w = mat_mul(u_mu, dagger(a_staple))
+    b0, b1, b2, b3 = _subgroup_quaternion(w, i, j)
+    k = jnp.sqrt(b0 ** 2 + b1 ** 2 + b2 ** 2 + b3 ** 2) + 1e-30
+    bh = [b0 / k, b1 / k, b2 / k, b3 / k]
+    if heatbath:
+        alpha = (beta / 3.0) * k
+        kx, kd = jax.random.split(key)
+        x0, ok = _kp_sample(kx, alpha, n_tries)
+        # uniform direction on the 2-sphere for the perpendicular part
+        kn1, kn2 = jax.random.split(kd)
+        ct = jax.random.uniform(kn1, k.shape, minval=-1.0, maxval=1.0)
+        ph = jax.random.uniform(kn2, k.shape, minval=0.0,
+                                maxval=2 * jnp.pi)
+        st = jnp.sqrt(jnp.maximum(1.0 - ct ** 2, 0.0))
+        n = [ct, st * jnp.cos(ph), st * jnp.sin(ph)]
+        xr = jnp.sqrt(jnp.maximum(1.0 - x0 ** 2, 0.0))
+        # a = (x0, xr*n) quaternion-multiplied by bhat: right translation on
+        # S^3 is an isometry sending e0 -> bhat, so a.bhat = x0 (KP-sampled)
+        # with the perpendicular direction uniform.  Quaternion product:
+        # (p0,p)(q0,q) = (p0 q0 - p.q, p0 q + q0 p + p x q)
+        p0, p1, p2, p3 = x0, xr * n[0], xr * n[1], xr * n[2]
+        q0, q1, q2, q3 = bh
+        a0 = p0 * q0 - p1 * q1 - p2 * q2 - p3 * q3
+        a1 = p0 * q1 + q0 * p1 + p2 * q3 - p3 * q2
+        a2 = p0 * q2 + q0 * p2 + p3 * q1 - p1 * q3
+        a3 = p0 * q3 + q0 * p3 + p1 * q2 - p2 * q1
+        # where rejection failed, keep identity (old link)
+        a0 = jnp.where(ok, a0, 1.0)
+        a1 = jnp.where(ok, a1, 0.0)
+        a2 = jnp.where(ok, a2, 0.0)
+        a3 = jnp.where(ok, a3, 0.0)
+    else:
+        # microcanonical overrelaxation: a = bhat * bhat (quaternion square)
+        q0, q1, q2, q3 = bh
+        a0 = q0 * q0 - q1 * q1 - q2 * q2 - q3 * q3
+        a1, a2, a3 = 2 * q0 * q1, 2 * q0 * q2, 2 * q0 * q3
+    g = _embed_su2(a0.astype(u_mu.real.dtype), a1, a2, a3, i, j,
+                   u_mu.dtype, u_mu.shape[:-2])
+    return mat_mul(g, u_mu)
+
+
+def sweep(key, gauge: jnp.ndarray, geom: LatticeGeometry, beta: float,
+          heatbath: bool = True, n_tries: int = 24) -> jnp.ndarray:
+    """One full lattice sweep: 2 parities x 4 directions x 3 subgroups."""
+    for parity in (0, 1):
+        mask = jnp.asarray(_site_mask(geom, parity))[..., None, None]
+        for mu in range(4):
+            a = staple_sum(gauge, mu)
+            u = gauge[mu]
+            for si, sg in enumerate(SUBGROUPS):
+                key, sub = jax.random.split(key)
+                u_new = _subgroup_update(sub, u, a, beta, sg, heatbath,
+                                         n_tries)
+                u = jnp.where(mask, u_new, u)
+            gauge = gauge.at[mu].set(u)
+    return gauge
+
+
+def heatbath_evolve(key, gauge, geom, beta: float, n_sweeps: int,
+                    n_or_per_hb: int = 0):
+    """Heatbath sweeps, optionally interleaved with OR sweeps
+    (the heatbath_test evolution pattern)."""
+    for _ in range(n_sweeps):
+        key, k1 = jax.random.split(key)
+        gauge = sweep(k1, gauge, geom, beta, heatbath=True)
+        for _ in range(n_or_per_hb):
+            key, k2 = jax.random.split(key)
+            gauge = sweep(k2, gauge, geom, beta, heatbath=False)
+    return gauge

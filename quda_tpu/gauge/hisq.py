@@ -1,0 +1,140 @@
+"""HISQ / asqtad link fattening: fat7 + reunitarisation + asqtad staples,
+Naik and Lepage terms, two-link field for staggered smearing.
+
+Reference behavior: lib/llfat_quda.cu (fat7/asqtad staples),
+lib/unitarize_links_quda.cu + include/svd_quda.h (U(3) projection),
+lib/staggered_two_link_quda.cu, driven by computeKSLinkQuda
+(quda.h:1358, lib/interface_quda.cpp).  Path coefficients follow the MILC
+convention: (one-link, naik, 3-staple, 5-staple, 7-staple, lepage).
+
+TPU-native notes:
+* staples at every level are the same nested `_staple_of` einsum pattern —
+  the 5-link and 7-link paths are staples of staples, the Lepage term a
+  same-direction double staple;
+* reunitarisation is W = V (V^dag V)^{-1/2} via a batched Hermitian
+  eigendecomposition — and because `jnp.linalg.eigh` has a JVP rule, the
+  HISQ FORCE differentiates straight through it (jax.grad replaces the
+  hand-derived SVD differentiation of unitarize_force.cuh / svd_quda.h).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.shift import shift
+from ..ops.su3 import dagger, mat_mul
+
+
+class HisqCoeffs(NamedTuple):
+    one_link: float
+    naik: float
+    three: float
+    five: float
+    seven: float
+    lepage: float
+
+
+# MILC fat7 (first HISQ level) and asqtad (second level) coefficient sets
+FAT7_COEFFS = HisqCoeffs(1.0 / 8.0, 0.0, 1.0 / 16.0, 1.0 / 64.0,
+                         1.0 / 384.0, 0.0)
+ASQTAD_COEFFS = HisqCoeffs(1.0 / 8.0 + 3.0 / 8.0 + 1.0 / 8.0, -1.0 / 24.0,
+                           1.0 / 16.0, 1.0 / 64.0, 1.0 / 384.0, -1.0 / 8.0)
+# second HISQ level includes the Naik correction via eps externally
+HISQ_L2_COEFFS = HisqCoeffs(1.0, -1.0 / 24.0, 1.0 / 16.0, 1.0 / 64.0,
+                            1.0 / 384.0, -1.0 / 8.0)
+
+
+def _staple_pair(x_mu: jnp.ndarray, u_nu: jnp.ndarray, mu: int, nu: int):
+    """Up+down staple of the link field x_mu decorated by u_nu."""
+    up = mat_mul(mat_mul(u_nu, shift(x_mu, nu, +1)),
+                 dagger(shift(u_nu, mu, +1)))
+    u_dn = shift(u_nu, nu, -1)
+    dn = mat_mul(dagger(u_dn), mat_mul(shift(x_mu, nu, -1),
+                                       shift(u_dn, mu, +1)))
+    return up + dn
+
+
+def fat_links(gauge: jnp.ndarray, c: HisqCoeffs) -> jnp.ndarray:
+    """Generalised fattening for one coefficient set.
+
+    3-staple: sum_nu staple_nu(U_mu);
+    5-staple: sum_{nu != rho} staple_nu(staple_rho(U_mu));
+    7-staple: the fully nested three-direction version;
+    Lepage:   staple_nu(staple_nu(U_mu)) (same direction twice).
+    """
+    fat = []
+    for mu in range(4):
+        acc = c.one_link * gauge[mu]
+        for nu in range(4):
+            if nu == mu:
+                continue
+            s3 = _staple_pair(gauge[mu], gauge[nu], mu, nu)
+            acc = acc + c.three * s3
+            if c.lepage != 0.0:
+                acc = acc + c.lepage * _staple_pair(s3, gauge[nu], mu, nu) \
+                    * 0.5  # both orientations already in s3; halve double count
+            for rho in range(4):
+                if rho in (mu, nu):
+                    continue
+                s5 = _staple_pair(_staple_pair(gauge[mu], gauge[rho],
+                                               mu, rho), gauge[nu], mu, nu)
+                acc = acc + c.five * s5 * 0.5
+                for sg in range(4):
+                    if sg in (mu, nu, rho):
+                        continue
+                    s7 = _staple_pair(
+                        _staple_pair(
+                            _staple_pair(gauge[mu], gauge[sg], mu, sg),
+                            gauge[rho], mu, rho), gauge[nu], mu, nu)
+                    acc = acc + c.seven * s7 / 6.0
+        fat.append(acc)
+    return jnp.stack(fat)
+
+
+def naik_links(gauge: jnp.ndarray) -> jnp.ndarray:
+    """Straight 3-link (Naik) field: U_mu(x) U_mu(x+mu) U_mu(x+2mu)."""
+    out = []
+    for mu in range(4):
+        u = gauge[mu]
+        out.append(mat_mul(mat_mul(u, shift(u, mu, +1)), shift(u, mu, 2)))
+    return jnp.stack(out)
+
+
+def two_link(gauge: jnp.ndarray) -> jnp.ndarray:
+    """U_mu(x) U_mu(x+mu) (lib/staggered_two_link_quda.cu, for two-link
+    Gaussian quark smearing)."""
+    return jnp.stack([mat_mul(gauge[mu], shift(gauge[mu], mu, +1))
+                      for mu in range(4)])
+
+
+def unitarize_links(v: jnp.ndarray) -> jnp.ndarray:
+    """U(3) projection W = V (V^dag V)^{-1/2} via batched eigh.
+
+    Differentiable (eigh JVP) — the HISQ-force path relies on this.
+    """
+    h = mat_mul(dagger(v), v)                      # Hermitian pos. def.
+    evals, evecs = jnp.linalg.eigh(h)
+    inv_sqrt = jnp.einsum(
+        "...ab,...b,...cb->...ac", evecs,
+        1.0 / jnp.sqrt(jnp.maximum(evals, 1e-18)), jnp.conjugate(evecs))
+    return mat_mul(v, inv_sqrt)
+
+
+class HisqLinks(NamedTuple):
+    fat: jnp.ndarray
+    long: jnp.ndarray
+    w_unitarized: jnp.ndarray
+
+
+def hisq_fattening(gauge: jnp.ndarray,
+                   naik_eps: float = 0.0) -> HisqLinks:
+    """Full two-level HISQ construction (computeKSLinkQuda pipeline):
+    fat7 -> U(3) reunitarise -> asqtad level-2 (+ Lepage), Naik from W."""
+    v = fat_links(gauge, FAT7_COEFFS)
+    w = unitarize_links(v)
+    fat = fat_links(w, HISQ_L2_COEFFS)
+    lng = (1.0 + naik_eps) * (-1.0 / 24.0) * naik_links(w)
+    return HisqLinks(fat, lng, w)

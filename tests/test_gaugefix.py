@@ -1,0 +1,49 @@
+"""Gauge fixing tests (gauge_alg_test analog): OVR and FFT both drive
+theta below tolerance; gauge-invariant observables are untouched."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import LatticeGeometry
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.gauge.fix import gaugefix_fft, gaugefix_ovr, gaugefix_quality
+from quda_tpu.gauge.observables import plaquette
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+TOL = 1e-9
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # moderately smooth config (fixing rough configs needs many iters)
+    return GaugeField.random(jax.random.PRNGKey(77), GEOM, scale=0.4).data
+
+
+@pytest.mark.parametrize("dirs", [4, 3])  # Landau, Coulomb
+def test_ovr_fixes(cfg, dirs):
+    fixed, iters, theta = gaugefix_ovr(cfg, GEOM, gauge_dirs=dirs,
+                                       tol=TOL, max_iter=2000)
+    assert theta < TOL, (iters, theta)
+    # gauge invariant observable unchanged
+    assert np.isclose(float(plaquette(fixed)[0]),
+                      float(plaquette(cfg)[0]), atol=1e-10)
+    # functional increased vs start
+    f0, _ = gaugefix_quality(cfg, dirs)
+    f1, _ = gaugefix_quality(fixed, dirs)
+    assert float(f1) > float(f0)
+
+
+def test_fft_fixes(cfg):
+    fixed, iters, theta = gaugefix_fft(cfg, GEOM, tol=TOL, max_iter=4000)
+    assert theta < TOL, (iters, theta)
+    assert np.isclose(float(plaquette(fixed)[0]),
+                      float(plaquette(cfg)[0]), atol=1e-10)
+
+
+def test_fixed_point_stable(cfg):
+    fixed, _, theta0 = gaugefix_ovr(cfg, GEOM, tol=TOL, max_iter=2000)
+    again, iters, theta1 = gaugefix_ovr(fixed, GEOM, tol=TOL, max_iter=50)
+    assert theta1 < TOL
+    assert iters <= 10  # already fixed: immediate exit

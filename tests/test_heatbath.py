@@ -1,0 +1,67 @@
+"""Heatbath/overrelaxation tests (heatbath_test analog)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import LatticeGeometry
+from quda_tpu.gauge.heatbath import (cold_start, heatbath_evolve, hot_start,
+                                     sweep)
+from quda_tpu.gauge.observables import plaquette
+from quda_tpu.ops.su3 import dagger, mat_mul
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+
+
+def _check_su3(u, tol=1e-9):
+    eye = np.broadcast_to(np.eye(3), u.shape)
+    assert np.allclose(np.asarray(mat_mul(u, dagger(u))), eye, atol=tol)
+    assert np.allclose(np.asarray(jnp.linalg.det(u)), 1.0, atol=tol)
+
+
+def test_sweep_preserves_su3():
+    g = hot_start(jax.random.PRNGKey(0), GEOM)
+    g = sweep(jax.random.PRNGKey(1), g, GEOM, beta=5.7)
+    _check_su3(g)
+    g = sweep(jax.random.PRNGKey(2), g, GEOM, beta=5.7, heatbath=False)
+    _check_su3(g)
+
+
+def test_overrelaxation_preserves_action():
+    """Microcanonical OR must keep the Wilson action (nearly) unchanged."""
+    from quda_tpu.gauge.action import wilson_action
+    g = heatbath_evolve(jax.random.PRNGKey(3), hot_start(
+        jax.random.PRNGKey(4), GEOM), GEOM, beta=5.7, n_sweeps=2)
+    s0 = float(wilson_action(g, 5.7))
+    g1 = sweep(jax.random.PRNGKey(5), g, GEOM, beta=5.7, heatbath=False)
+    s1 = float(wilson_action(g1, 5.7))
+    assert abs(s1 - s0) / abs(s0) < 1e-8
+    # but the configuration DID change
+    assert not np.allclose(np.asarray(g1), np.asarray(g), atol=1e-6)
+
+
+def test_thermalisation_beta57():
+    """beta=5.7 quenched SU(3): plaquette thermalises to ~0.55 from both
+    hot and cold starts (textbook value ~0.5495)."""
+    key = jax.random.PRNGKey(11)
+    g_cold = cold_start(GEOM)
+    g_cold = heatbath_evolve(key, g_cold, GEOM, 5.7, n_sweeps=25,
+                             n_or_per_hb=1)
+    p_cold = float(plaquette(g_cold)[0])
+    g_hot = hot_start(jax.random.fold_in(key, 1), GEOM)
+    g_hot = heatbath_evolve(jax.random.fold_in(key, 2), g_hot, GEOM, 5.7,
+                            n_sweeps=25, n_or_per_hb=1)
+    p_hot = float(plaquette(g_hot)[0])
+    # hot and cold starts must bracket/approach the same value
+    assert 0.50 < p_cold < 0.60, p_cold
+    assert 0.50 < p_hot < 0.60, p_hot
+    assert abs(p_cold - p_hot) < 0.04
+
+
+def test_strong_coupling_disorder():
+    """beta -> 0: plaquette stays near zero (disordered)."""
+    g = hot_start(jax.random.PRNGKey(21), GEOM)
+    g = heatbath_evolve(jax.random.PRNGKey(22), g, GEOM, beta=0.5,
+                        n_sweeps=6)
+    assert abs(float(plaquette(g)[0])) < 0.2

@@ -1,0 +1,100 @@
+"""HISQ fattening tests: gauge covariance, unitarity, AD force through
+the full fattening chain (the hisq_paths_force_test analog)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import LatticeGeometry
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.gauge.hisq import (ASQTAD_COEFFS, FAT7_COEFFS, fat_links,
+                                 hisq_fattening, naik_links, two_link,
+                                 unitarize_links)
+from quda_tpu.ops.shift import shift
+from quda_tpu.ops.su3 import (dagger, expm_su3, mat_mul, random_su3,
+                              random_hermitian_traceless, trace)
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return GaugeField.random(jax.random.PRNGKey(88), GEOM, scale=0.4).data
+
+
+def _gauge_transform(gauge, g):
+    return jnp.stack([
+        mat_mul(mat_mul(g, gauge[mu]), dagger(shift(g, mu, +1)))
+        for mu in range(4)])
+
+
+def test_fat_links_gauge_covariant(cfg):
+    """Fat links must transform like links: V'_mu = g V_mu g(x+mu)^dag."""
+    g = random_su3(jax.random.PRNGKey(5), GEOM.lattice_shape)
+    fat0 = fat_links(cfg, FAT7_COEFFS)
+    fat1 = fat_links(_gauge_transform(cfg, g), FAT7_COEFFS)
+    want = _gauge_transform(fat0, g)
+    assert np.allclose(np.asarray(fat1), np.asarray(want), atol=1e-11)
+
+
+def test_naik_gauge_covariant(cfg):
+    g = random_su3(jax.random.PRNGKey(6), GEOM.lattice_shape)
+    n0 = naik_links(cfg)
+    n1 = naik_links(_gauge_transform(cfg, g))
+    # 3-link transforms with g(x), g(x+3mu)
+    for mu in range(4):
+        want = mat_mul(mat_mul(g, n0[mu]), dagger(shift(g, mu, +1, 3)))
+        assert np.allclose(np.asarray(n1[mu]), np.asarray(want), atol=1e-11)
+
+
+def test_unit_gauge_fattening():
+    """On the unit gauge every staple is 1: fat link = (sum of coeffs) * 1."""
+    u = GaugeField.unit(GEOM).data
+    c = FAT7_COEFFS
+    fat = fat_links(u, c)
+    # per mu: one + 6 three-staples*2(up+down baked in pair)... just check
+    # the result is proportional to the identity and uniform
+    eye = np.eye(3)
+    f0 = np.asarray(fat[0, 0, 0, 0, 0])
+    assert np.allclose(f0.imag, 0, atol=1e-12)
+    assert np.allclose(f0, f0[0, 0] * eye, atol=1e-12)
+    assert np.allclose(np.asarray(fat), np.asarray(fat)[0, 0, 0, 0, 0],
+                       atol=1e-12)
+
+
+def test_unitarize(cfg):
+    v = fat_links(cfg, FAT7_COEFFS)
+    w = unitarize_links(v)
+    eye = np.broadcast_to(np.eye(3), w.shape)
+    assert np.allclose(np.asarray(mat_mul(w, dagger(w))), eye, atol=1e-10)
+
+
+def test_hisq_pipeline(cfg):
+    links = hisq_fattening(cfg, naik_eps=0.0)
+    assert np.all(np.isfinite(np.asarray(links.fat)))
+    eye = np.broadcast_to(np.eye(3), links.w_unitarized.shape)
+    assert np.allclose(
+        np.asarray(mat_mul(links.w_unitarized,
+                           dagger(links.w_unitarized))), eye, atol=1e-10)
+
+
+def test_force_through_fattening_finite_difference(cfg):
+    """jax.grad through fat7+eigh-reunitarisation+asqtad == finite
+    differences — the unitarize_force.cuh / svd_quda.h replacement."""
+    from quda_tpu.gauge.action import gauge_force
+
+    def act(u):
+        links = hisq_fattening(u)
+        # scalar probe functional of the fattened links
+        return jnp.sum(trace(mat_mul(links.fat, dagger(links.fat))).real) \
+            + jnp.sum(trace(links.long).real)
+
+    f = gauge_force(act, cfg)
+    q = random_hermitian_traceless(jax.random.PRNGKey(9), cfg.shape[:-2],
+                                   dtype=cfg.dtype)
+    eps = 1e-5
+    fd = (float(act(mat_mul(expm_su3(eps * q), cfg)))
+          - float(act(mat_mul(expm_su3(-eps * q), cfg)))) / (2 * eps)
+    ana = 2.0 * float(jnp.sum(trace(mat_mul(q, f)).real))
+    assert np.isclose(fd, ana, rtol=1e-5), (fd, ana)
