@@ -1,0 +1,113 @@
+"""Contractions: open-spin / gamma-insertion bilinears, momentum-projected
+correlators, LapH sink projection, noise dilution.
+
+Reference behavior: lib/contract.cu (kernels/contraction.cuh 474 LoC:
+open-spin and DegrandRossi contractions, contractFTQuda Fourier transform),
+lib/evec_project.cu (laphSinkProject, quda.h:1859), lib/spinor_dilute.in.cu.
+All become einsums + FFTs on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import gamma as g
+
+
+def contract_open_spin(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Open-spin contraction: C_{s s'}(x) = sum_c x*_{s c} y_{s' c}
+    (QUDA_CONTRACT_TYPE_OPEN)."""
+    return jnp.einsum("...sc,...tc->...st", jnp.conjugate(x), y)
+
+
+def contract_dr(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """DeGrand-Rossi gamma-basis contraction: tr over spin of
+    gamma_i x^dag gamma_i y for the 16 gamma-matrix basis elements
+    (QUDA_CONTRACT_TYPE_DR): returns (..., 16)."""
+    basis = _gamma_basis()
+    open_c = contract_open_spin(x, y)            # (..., s, t)
+    return jnp.einsum("gst,...ts->...g", jnp.asarray(basis, x.dtype), open_c)
+
+
+def _gamma_basis() -> np.ndarray:
+    """The 16 Dirac bilinear matrices: 1, g1..g4, g5, g5 g_mu, sigma_munu."""
+    out = [np.eye(4)]
+    out += [g.GAMMAS[mu] for mu in range(4)]
+    out.append(g.GAMMA_5)
+    out += [g.GAMMA_5 @ g.GAMMAS[mu] for mu in range(4)]
+    for mu in range(4):
+        for nu in range(mu + 1, 4):
+            out.append(g.SIGMA[mu, nu])
+    return np.stack(out)  # (16, 4, 4)
+
+
+def contract_ft(x: jnp.ndarray, y: jnp.ndarray,
+                momenta: Sequence[Sequence[int]]) -> jnp.ndarray:
+    """Momentum-projected open-spin correlator per time slice
+    (contractFTQuda): C(t, p, s, s') = sum_{xyz} e^{-i p.x} C_{ss'}(x).
+
+    x, y: (T,Z,Y,X,4,3); momenta: list of (px,py,pz) integer triples.
+    """
+    c = contract_open_spin(x, y)                  # (T,Z,Y,X,4,4)
+    T, Z, Y, X = c.shape[:4]
+    zc = jnp.arange(Z)
+    yc = jnp.arange(Y)
+    xc = jnp.arange(X)
+    outs = []
+    for (px, py, pz) in momenta:
+        phase = jnp.exp(-2j * jnp.pi * (
+            pz * zc[:, None, None] / Z + py * yc[None, :, None] / Y
+            + px * xc[None, None, :] / X)).astype(c.dtype)
+        outs.append(jnp.einsum("zyx,tzyxab->tab", phase, c))
+    return jnp.stack(outs, axis=1)                # (T, n_mom, 4, 4)
+
+
+def laph_sink_project(evecs: jnp.ndarray, psi: jnp.ndarray) -> jnp.ndarray:
+    """LapH sink projection (laphSinkProject): per time slice, the color
+    inner product of 3-d Laplacian eigenvectors with a propagator field.
+
+    evecs: (n_ev, T,Z,Y,X, 3) (spin-less);  psi: (T,Z,Y,X,4,3)
+    -> (n_ev, T, 4).
+    """
+    return jnp.einsum("ntzyxc,tzyxsc->nts", jnp.conjugate(evecs), psi)
+
+
+def dilute_spinor(psi: jnp.ndarray, scheme: str = "spin_color"):
+    """Split a noise source into orthogonal dilution components summing to
+    the original (lib/spinor_dilute.in.cu): returns (n_dil, ...) array.
+
+    schemes: 'spin', 'color', 'spin_color', 'eo' (site parity).
+    """
+    T, Z, Y, X, S, C = psi.shape
+    comps = []
+    if scheme in ("spin", "spin_color"):
+        spins = range(S)
+    else:
+        spins = [None]
+    if scheme in ("color", "spin_color"):
+        colors = range(C)
+    else:
+        colors = [None]
+    if scheme == "eo":
+        t = jnp.arange(T)[:, None, None, None]
+        z = jnp.arange(Z)[None, :, None, None]
+        y = jnp.arange(Y)[None, None, :, None]
+        x = jnp.arange(X)[None, None, None, :]
+        par = ((t + z + y + x) % 2)[..., None, None]
+        for p in (0, 1):
+            comps.append(jnp.where(par == p, psi, 0))
+        return jnp.stack(comps)
+    for s in spins:
+        for c in colors:
+            m = jnp.zeros((S, C), psi.dtype)
+            if s is None:
+                m = m.at[:, c].set(1)
+            elif c is None:
+                m = m.at[s, :].set(1)
+            else:
+                m = m.at[s, c].set(1)
+            comps.append(psi * m)
+    return jnp.stack(comps)

@@ -1,0 +1,43 @@
+"""Gauge Laplace and covariant derivative operators.
+
+Reference behavior: lib/laplace.cu (kernels/laplace.cuh),
+lib/covariant_derivative.cu (kernels/covariant_derivative.cuh),
+lib/gauge_laplace.cpp / lib/gauge_covdev.cpp (Dirac-class wrappers).
+The 3-d Laplacian is the LapH smearing kernel and the gauge-Laplace
+eigenproblem operator.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .shift import shift
+from .su3 import dagger
+
+
+def _cmul(u, psi):
+    return jnp.einsum("...ab,...sb->...sa", u, psi)
+
+
+def covariant_derivative(gauge: jnp.ndarray, psi: jnp.ndarray, mu: int,
+                         sign: int) -> jnp.ndarray:
+    """Forward (+) or backward (-) covariant shift:
+    (D^+_mu psi)(x) = U_mu(x) psi(x+mu);
+    (D^-_mu psi)(x) = U_mu(x-mu)^dag psi(x-mu)."""
+    if sign > 0:
+        return _cmul(gauge[mu], shift(psi, mu, +1))
+    return _cmul(shift(dagger(gauge[mu]), mu, -1), shift(psi, mu, -1))
+
+
+def laplace(gauge: jnp.ndarray, psi: jnp.ndarray, ndim: int = 3,
+            mass: float = 0.0) -> jnp.ndarray:
+    """(-Delta + m) psi over the first `ndim` directions (3 = spatial LapH,
+    4 = full gauge Laplace):
+
+    (-Delta psi)(x) = 2*ndim psi(x) - sum_mu [U psi(x+mu) + U^dag psi(x-mu)].
+    """
+    acc = (2.0 * ndim + mass) * psi
+    for mu in range(ndim):
+        acc = acc - covariant_derivative(gauge, psi, mu, +1)
+        acc = acc - covariant_derivative(gauge, psi, mu, -1)
+    return acc

@@ -1,0 +1,120 @@
+"""Contractions, Laplace/covdev operators, quark smearing tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import LatticeGeometry
+from quda_tpu.fields.spinor import ColorSpinorField
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.gauge.quark_smear import gaussian_smear, wuppertal_smear
+from quda_tpu.gauge.hisq import two_link
+from quda_tpu.ops import blas
+from quda_tpu.ops.contract import (contract_dr, contract_ft,
+                                   contract_open_spin, dilute_spinor,
+                                   laph_sink_project)
+from quda_tpu.ops.laplace import covariant_derivative, laplace
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+
+
+@pytest.fixture(scope="module")
+def fields():
+    key = jax.random.PRNGKey(1001)
+    k1, k2, k3 = jax.random.split(key, 3)
+    gauge = GaugeField.random(k1, GEOM).data
+    x = ColorSpinorField.gaussian(k2, GEOM).data
+    y = ColorSpinorField.gaussian(k3, GEOM).data
+    return gauge, x, y
+
+
+def test_open_spin_trace_is_inner_product(fields):
+    _, x, y = fields
+    c = contract_open_spin(x, y)
+    tr = jnp.einsum("...ss->...", c)
+    assert np.isclose(complex(jnp.sum(tr)), complex(blas.cdot(x, y)))
+
+
+def test_contract_dr_identity_component(fields):
+    """The identity element of the 16-basis equals the spin trace."""
+    _, x, y = fields
+    dr = contract_dr(x, y)
+    open_tr = jnp.einsum("...ss->...", contract_open_spin(x, y))
+    assert np.allclose(np.asarray(dr[..., 0]), np.asarray(open_tr),
+                       atol=1e-12)
+
+
+def test_contract_ft_zero_momentum(fields):
+    _, x, y = fields
+    out = contract_ft(x, y, [(0, 0, 0), (1, 0, 0)])
+    want = jnp.sum(contract_open_spin(x, y), axis=(1, 2, 3))
+    assert np.allclose(np.asarray(out[:, 0]), np.asarray(want), atol=1e-10)
+    assert not np.allclose(np.asarray(out[:, 1]), np.asarray(want))
+
+
+def test_laph_sink_project(fields):
+    _, x, _ = fields
+    key = jax.random.PRNGKey(9)
+    ev = (jax.random.normal(key, (3,) + GEOM.lattice_shape + (3,))
+          + 1j * jax.random.normal(jax.random.fold_in(key, 1),
+                                   (3,) + GEOM.lattice_shape + (3,)))
+    out = laph_sink_project(ev, x)
+    assert out.shape == (3, GEOM.T, 4)
+    # manual check for one (n, t, s)
+    want = complex(jnp.sum(jnp.conjugate(ev[1, 2]) * x[2, :, :, :, 3, :]))
+    assert np.isclose(complex(out[1, 2, 3]), want)
+
+
+@pytest.mark.parametrize("scheme,n", [("spin", 4), ("color", 3),
+                                      ("spin_color", 12), ("eo", 2)])
+def test_dilution_partitions(fields, scheme, n):
+    _, x, _ = fields
+    comps = dilute_spinor(x, scheme)
+    assert comps.shape[0] == n
+    # components sum to the original and are mutually orthogonal
+    assert np.allclose(np.asarray(jnp.sum(comps, 0)), np.asarray(x))
+    for i in range(n):
+        for j in range(i + 1, n):
+            assert abs(complex(blas.cdot(comps[i], comps[j]))) < 1e-10
+
+
+def test_laplace_hermitian_positive(fields):
+    gauge, x, y = fields
+    lx = laplace(gauge, x, ndim=3)
+    lhs = blas.cdot(y, lx)
+    rhs = jnp.conjugate(blas.cdot(x, laplace(gauge, y, ndim=3)))
+    assert np.isclose(complex(lhs), complex(rhs), atol=1e-10)
+    assert float(blas.cdot(x, lx).real) > 0
+
+
+def test_covdev_adjointness(fields):
+    """(D^+_mu)^dag = D^-_mu."""
+    gauge, x, y = fields
+    lhs = blas.cdot(y, covariant_derivative(gauge, x, 2, +1))
+    rhs = jnp.conjugate(
+        blas.cdot(x, covariant_derivative(gauge, y, 2, -1)))
+    assert np.isclose(complex(lhs), complex(rhs), atol=1e-10)
+
+
+def test_wuppertal_smearing_spreads(fields):
+    gauge, _, _ = fields
+    src = ColorSpinorField.point(GEOM, site=(2, 2, 2, 1)).data
+    sm = wuppertal_smear(gauge, src, alpha=3.0, n_steps=5)
+    # norm on the source site decreased, neighbours got support
+    assert float(jnp.abs(sm[1, 2, 2, 2, 0, 0])) < 1.0
+    assert float(jnp.sum(jnp.abs(sm[1, 2, 2, 3]))) > 0
+    # t-slices untouched (spatial smearing only)
+    assert float(jnp.sum(jnp.abs(sm[2]))) == 0.0
+
+
+def test_gaussian_two_link_smearing(fields):
+    gauge, _, _ = fields
+    src = ColorSpinorField.point(GEOM, site=(0, 0, 0, 0), nspin=4).data
+    tl = two_link(gauge)
+    sm = gaussian_smear(gauge, src, omega=2.0, n_steps=4,
+                        two_link_gauge=tl)
+    assert np.isfinite(float(blas.norm2(sm)))
+    # two-link hops move support by 2 sites: site (1,0,0,0) stays empty
+    assert float(jnp.sum(jnp.abs(sm[0, 0, 0, 1]))) < 1e-12
+    assert float(jnp.sum(jnp.abs(sm[0, 0, 0, 2]))) > 0
