@@ -1,0 +1,30 @@
+"""Gauge-field site checksums (ILDG-compatible structure).
+
+Reference behavior: lib/checksum.cu — per-site CRC32 of the link data,
+combined with site-rank-dependent rotations into two 32-bit sums (the
+ILDG scidac-checksum a/b pair).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+
+def gauge_checksum(gauge) -> dict:
+    """ILDG-style (suma, sumb) over per-site CRC32s."""
+    g = np.asarray(gauge)
+    # site-major copy: (T,Z,Y,X, mu,3,3)
+    site = np.ascontiguousarray(np.moveaxis(g, 0, 4))
+    T, Z, Y, X = site.shape[:4]
+    flat = site.reshape(T * Z * Y * X, -1)
+    suma = 0
+    sumb = 0
+    for rank in range(flat.shape[0]):
+        crc = zlib.crc32(flat[rank].tobytes())
+        r29 = rank % 29
+        r31 = rank % 31
+        suma ^= ((crc << r29) | (crc >> (32 - r29))) & 0xFFFFFFFF
+        sumb ^= ((crc << r31) | (crc >> (32 - r31))) & 0xFFFFFFFF
+    return {"suma": suma & 0xFFFFFFFF, "sumb": sumb & 0xFFFFFFFF}

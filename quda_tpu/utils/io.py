@@ -1,0 +1,132 @@
+"""Field I/O: gauge/propagator save-load, eigenvector sets, checkpoints.
+
+Reference behavior: lib/qio_field.cpp (SciDAC/ILDG gauge + spinor files,
+partition-aware layout lib/layout_hyper.cpp), lib/vector_io.cpp (VectorIO:
+MG null spaces / eigenvector sets with optional precision drop on disk),
+orbax-style checkpointing for HMC state (SURVEY.md §5.4).
+
+Formats:
+* native: .npz with metadata + crc32 site checksums (fast, self-describing)
+* ildg: raw big-endian complex128 in ILDG site order (t,z,y,x slowest->
+  fastest; mu inner; row-major color) for interop with community tools
+* orbax: optional wrapper when orbax-checkpoint is importable
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Dict, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields.geometry import LatticeGeometry
+from .checksum import gauge_checksum
+
+
+def save_field(path: str, arr, meta: Optional[Dict] = None):
+    """Save any lattice field with metadata + checksum (native format)."""
+    a = np.asarray(arr)
+    meta = dict(meta or {})
+    meta["dtype"] = str(a.dtype)
+    meta["shape"] = list(a.shape)
+    meta["crc32"] = int(zlib.crc32(np.ascontiguousarray(a).tobytes()))
+    np.savez_compressed(path, data=a, meta=json.dumps(meta))
+
+
+def load_field(path: str, verify: bool = True):
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    with np.load(path, allow_pickle=False) as z:
+        a = z["data"]
+        meta = json.loads(str(z["meta"]))
+    if verify:
+        crc = int(zlib.crc32(np.ascontiguousarray(a).tobytes()))
+        if crc != meta.get("crc32"):
+            raise IOError(f"checksum mismatch loading {path}")
+    return jnp.asarray(a), meta
+
+
+# -- ILDG-style raw binary (interop) ---------------------------------------
+
+def save_gauge_ildg(path: str, gauge, geom: LatticeGeometry):
+    """(4,T,Z,Y,X,3,3) -> ILDG binary: site-major (t slowest, x fastest),
+    per site mu=0..3 (x,y,z,t), row-major 3x3, big-endian complex128."""
+    g = np.asarray(gauge).astype(np.complex128)
+    # (T,Z,Y,X,mu,3,3)
+    site_major = np.moveaxis(g, 0, 4)
+    be = site_major.astype(">c16")
+    with open(path, "wb") as fh:
+        fh.write(be.tobytes())
+    side = {"dims": list(geom.dims), "checksum": gauge_checksum(gauge)}
+    with open(path + ".meta.json", "w") as fh:
+        json.dump(side, fh)
+
+
+def load_gauge_ildg(path: str, geom: LatticeGeometry):
+    n = geom.volume * 4 * 9
+    raw = np.fromfile(path, dtype=">c16", count=n)
+    site_major = raw.reshape(geom.lattice_shape + (4, 3, 3))
+    return jnp.asarray(np.moveaxis(site_major.astype(np.complex128), 4, 0))
+
+
+# -- vector sets (MG null spaces / eigenvectors) ---------------------------
+
+def save_vectors(path: str, vecs, evals=None, save_dtype=None):
+    """VectorIO::save analog; save_dtype drops precision on disk."""
+    a = np.asarray(vecs)
+    if save_dtype is not None:
+        a = a.astype(save_dtype)
+    meta = {"n_vec": a.shape[0]}
+    payload = {"data": a, "meta": json.dumps(meta)}
+    if evals is not None:
+        payload["evals"] = np.asarray(evals)
+    np.savez_compressed(path, **payload)
+
+
+def load_vectors(path: str, dtype=None):
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    with np.load(path, allow_pickle=False) as z:
+        a = z["data"]
+        evals = z["evals"] if "evals" in z else None
+    if dtype is not None:
+        a = a.astype(dtype)
+    return jnp.asarray(a), (jnp.asarray(evals) if evals is not None else None)
+
+
+# -- HMC / trainer-style checkpoints ---------------------------------------
+
+def save_checkpoint(path: str, state: Dict):
+    """Checkpoint a pytree-of-arrays dict (gauge, momenta, rng key, step...).
+
+    Uses orbax when available, else the native npz path per entry.
+    """
+    try:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.abspath(path), state, force=True)
+        return "orbax"
+    except Exception:
+        os.makedirs(path, exist_ok=True)
+        keys = {}
+        for k, v in state.items():
+            np.save(os.path.join(path, f"{k}.npy"), np.asarray(v))
+            keys[k] = str(np.asarray(v).dtype)
+        with open(os.path.join(path, "manifest.json"), "w") as fh:
+            json.dump(keys, fh)
+        return "npz"
+
+
+def load_checkpoint(path: str) -> Dict:
+    manifest = os.path.join(path, "manifest.json")
+    if os.path.exists(manifest):
+        with open(manifest) as fh:
+            keys = json.load(fh)
+        return {k: jnp.asarray(np.load(os.path.join(path, f"{k}.npy")))
+                for k in keys}
+    import orbax.checkpoint as ocp
+    ckptr = ocp.PyTreeCheckpointer()
+    return ckptr.restore(os.path.abspath(path))

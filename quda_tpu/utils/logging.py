@@ -1,0 +1,82 @@
+"""Verbosity-laddered, process-gated logging with prefix push/pop.
+
+Reference behavior: lib/util_quda.cpp / include/util_quda.h — QudaVerbosity
+ladder (SILENT..DEBUG_VERBOSE), rank-0-gated printfQuda, setOutputPrefix /
+pushOutputPrefix, errorQuda aborting with file:line.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from contextlib import contextmanager
+
+SILENT = 0
+SUMMARIZE = 1
+VERBOSE = 2
+DEBUG_VERBOSE = 3
+
+_LEVELS = {"silent": SILENT, "summarize": SUMMARIZE, "verbose": VERBOSE,
+           "debug": DEBUG_VERBOSE}
+
+_state = {
+    "verbosity": _LEVELS.get(os.environ.get("QUDA_TPU_VERBOSITY",
+                                            "summarize"), SUMMARIZE),
+    "prefix": ["quda_tpu: "],
+    "rank": int(os.environ.get("QUDA_TPU_PROCESS_INDEX", "0")),
+    "rank_verbosity_all": os.environ.get("QUDA_TPU_RANK_VERBOSITY") == "all",
+}
+
+
+def set_verbosity(level):
+    _state["verbosity"] = _LEVELS[level] if isinstance(level, str) else level
+
+
+def get_verbosity() -> int:
+    return _state["verbosity"]
+
+
+@contextmanager
+def push_verbosity(level):
+    old = _state["verbosity"]
+    set_verbosity(level)
+    try:
+        yield
+    finally:
+        _state["verbosity"] = old
+
+
+@contextmanager
+def push_prefix(prefix: str):
+    _state["prefix"].append(prefix)
+    try:
+        yield
+    finally:
+        _state["prefix"].pop()
+
+
+def _emit(msg: str):
+    if _state["rank"] == 0 or _state["rank_verbosity_all"]:
+        sys.stderr.write(_state["prefix"][-1] + msg + "\n")
+
+
+def printq(msg: str, level: int = SUMMARIZE):
+    """printfQuda analog: emitted when verbosity >= level on rank 0."""
+    if _state["verbosity"] >= level:
+        _emit(msg)
+
+
+def warningq(msg: str):
+    if _state["verbosity"] >= SUMMARIZE:
+        _emit("WARNING: " + msg)
+
+
+class QudaError(RuntimeError):
+    pass
+
+
+def errorq(msg: str):
+    """errorQuda analog: raise (single-process) instead of comm_abort."""
+    _emit("ERROR: " + msg)
+    raise QudaError(msg)

@@ -1,0 +1,78 @@
+"""Background monitor: periodic device/host sampling to a tsv file.
+
+Reference behavior: lib/monitor.cpp — a host thread samples power, energy,
+temperature and clocks every QUDA_ENABLE_MONITOR_PERIOD microseconds into
+monitor_n<rank>_<time>.tsv; solvers integrate energy over their window.
+
+TPU analog: no NVML — we sample wall time, device memory stats
+(jax.local_devices()[0].memory_stats() when the backend provides them) and
+host RSS.  The same start/stop/integration API shape is kept so solver
+reports can attach resource usage.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional
+
+
+class Monitor:
+    def __init__(self, period_s: float = 0.05, path: Optional[str] = None):
+        self.period = period_s
+        self.path = path
+        self.samples: List[dict] = []
+        self._thread = None
+        self._stop = threading.Event()
+
+    def _device_mem(self):
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0) if stats else 0
+        except Exception:
+            return 0
+
+    def _host_rss(self):
+        try:
+            with open("/proc/self/statm") as fh:
+                return int(fh.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+        except Exception:
+            return 0
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.samples.append({
+                "time": time.time(),
+                "device_bytes": self._device_mem(),
+                "host_rss": self._host_rss(),
+            })
+            self._stop.wait(self.period)
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        if self.path:
+            with open(self.path, "w") as fh:
+                fh.write("time\tdevice_bytes\thost_rss\n")
+                for s in self.samples:
+                    fh.write(f"{s['time']:.6f}\t{s['device_bytes']}\t"
+                             f"{s['host_rss']}\n")
+
+    def window(self, t0: float, t1: float):
+        """Samples within [t0, t1] (solver-window integration analog)."""
+        return [s for s in self.samples if t0 <= s["time"] <= t1]
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,109 @@
+"""TimeProfile: named, categorised, nestable timers with a global stack.
+
+Reference behavior: include/timer.h / lib/timer.cpp — TimeProfile with
+~30 QudaProfileType categories, pushProfile RAII, device timers via event
+pairs, and the endQuda summary print.  Device timing here wraps
+block_until_ready around the timed region (XLA's async dispatch plays the
+role of CUDA streams).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+# QudaProfileType analog
+CATEGORIES = (
+    "init", "download", "upload", "compute", "comms", "epilogue", "free",
+    "io", "chrono", "eigen", "tune", "setup", "preamble", "total",
+)
+
+
+class TimeProfile:
+    def __init__(self, name: str):
+        self.name = name
+        self.seconds: Dict[str, float] = defaultdict(float)
+        self.count: Dict[str, int] = defaultdict(int)
+        self._open: Dict[str, float] = {}
+
+    def start(self, category: str = "total"):
+        self._open[category] = time.perf_counter()
+
+    def stop(self, category: str = "total", sync=None):
+        if sync is not None:
+            sync.block_until_ready()
+        t0 = self._open.pop(category, None)
+        if t0 is None:
+            return
+        self.seconds[category] += time.perf_counter() - t0
+        self.count[category] += 1
+
+    @contextmanager
+    def __call__(self, category: str = "total"):
+        self.start(category)
+        try:
+            yield
+        finally:
+            self.stop(category)
+
+    def summary(self) -> str:
+        lines = [f"TimeProfile [{self.name}]"]
+        for cat in sorted(self.seconds, key=lambda c: -self.seconds[c]):
+            lines.append(f"  {cat:>10}: {self.seconds[cat]:10.4f} s"
+                         f"  ({self.count[cat]} calls)")
+        return "\n".join(lines)
+
+
+_profiles: Dict[str, TimeProfile] = {}
+_stack: List[TimeProfile] = []
+
+
+def get_profile(name: str) -> TimeProfile:
+    if name not in _profiles:
+        _profiles[name] = TimeProfile(name)
+    return _profiles[name]
+
+
+@contextmanager
+def push_profile(name: str, category: str = "total"):
+    """pushProfile RAII analog (timer.h:243)."""
+    prof = get_profile(name)
+    _stack.append(prof)
+    prof.start(category)
+    try:
+        yield prof
+    finally:
+        prof.stop(category)
+        _stack.pop()
+
+
+def current_profile() -> Optional[TimeProfile]:
+    return _stack[-1] if _stack else None
+
+
+def print_summary():
+    from .logging import printq
+    for prof in _profiles.values():
+        printq(prof.summary())
+
+
+# global flop/byte counters (Tunable::flops_global analog, lib/tune.cpp)
+_counters = {"flops": 0.0, "bytes": 0.0}
+
+
+def add_flops(n: float):
+    _counters["flops"] += n
+
+
+def add_bytes(n: float):
+    _counters["bytes"] += n
+
+
+def flops_global() -> float:
+    return _counters["flops"]
+
+
+def bytes_global() -> float:
+    return _counters["bytes"]

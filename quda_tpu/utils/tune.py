@@ -1,0 +1,128 @@
+"""Autotuner with a persistent on-disk cache.
+
+Reference behavior: lib/tune.cpp (1167 LoC) + include/tune_quda.h — every
+kernel brute-force times its launch configurations once, caches the winner
+in $QUDA_RESOURCE_PATH/tunecache.tsv keyed by {volume, name, aux}, and
+doubles as the profiling system (profile_N.tsv).
+
+TPU analog: XLA already schedules fused kernels, so what remains tunable is
+the CHOICE among whole implementations (pure-XLA stencil vs Pallas kernel,
+Pallas block shapes, halo policies).  `tune` times jitted candidates
+(median of inner reps after warmup), persists winners to
+$QUDA_TPU_RESOURCE_PATH/tunecache.json, and records per-key call counts and
+timings for `save_profile`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, Sequence, Tuple
+
+_cache: Dict[str, dict] = {}
+_profile: Dict[str, dict] = {}
+_loaded_path = None
+
+
+def _resource_path():
+    return os.environ.get("QUDA_TPU_RESOURCE_PATH", "")
+
+
+def tune_key(name: str, volume, aux: str = "") -> str:
+    """TuneKey {volume, name, aux} analog (include/tune_key.h:56)."""
+    return f"{volume}|{name}|{aux}"
+
+
+def load_cache():
+    global _loaded_path
+    path = _resource_path()
+    if not path:
+        return
+    f = os.path.join(path, "tunecache.json")
+    if os.path.exists(f):
+        try:
+            with open(f) as fh:
+                _cache.update(json.load(fh))
+        except (json.JSONDecodeError, OSError):
+            pass
+    _loaded_path = f
+
+
+def save_cache():
+    path = _resource_path()
+    if not path:
+        return
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "tunecache.json"), "w") as fh:
+        json.dump(_cache, fh, indent=1, sort_keys=True)
+
+
+def tuning_enabled() -> bool:
+    return os.environ.get("QUDA_TPU_ENABLE_TUNING", "1") != "0"
+
+
+def tune(name: str, volume, candidates: Dict[str, Callable], args: tuple,
+         aux: str = "", reps: int = 3, inner: int = 5) -> str:
+    """Return the winning candidate key; time once, cache forever.
+
+    candidates: {param_string: jitted callable}; each is called as f(*args)
+    and must return a jax array (block_until_ready used for timing).
+    """
+    key = tune_key(name, volume, aux)
+    if key in _cache and _cache[key]["param"] in candidates:
+        return _cache[key]["param"]
+    if not tuning_enabled():
+        return next(iter(candidates))
+    best, best_t = None, float("inf")
+    for param, fn in candidates.items():
+        try:
+            out = fn(*args)
+            out.block_until_ready()  # compile + warmup
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for _ in range(inner):
+                    out = fn(*args)
+                out.block_until_ready()
+                times.append((time.perf_counter() - t0) / inner)
+            t = min(times)
+        except Exception:
+            continue
+        if t < best_t:
+            best, best_t = param, t
+    if best is None:
+        raise RuntimeError(f"no tuning candidate succeeded for {key}")
+    _cache[key] = {"param": best, "time": best_t}
+    save_cache()
+    return best
+
+
+def record_launch(name: str, volume, aux: str, seconds: float,
+                  flops: float = 0.0, bytes_: float = 0.0):
+    """Accumulate per-kernel stats (the profiler half of lib/tune.cpp)."""
+    key = tune_key(name, volume, aux)
+    p = _profile.setdefault(key, {"calls": 0, "seconds": 0.0, "flops": 0.0,
+                                  "bytes": 0.0})
+    p["calls"] += 1
+    p["seconds"] += seconds
+    p["flops"] += flops
+    p["bytes"] += bytes_
+
+
+def save_profile(fname: str = "profile_0.tsv"):
+    """Write profile_N.tsv like lib/tune.cpp:528-610."""
+    path = _resource_path()
+    if not path:
+        return
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, fname), "w") as fh:
+        fh.write("key\tcalls\tseconds\tGFLOPS\tGB/s\n")
+        for key, p in sorted(_profile.items()):
+            s = max(p["seconds"], 1e-12)
+            fh.write(f"{key}\t{p['calls']}\t{p['seconds']:.6f}\t"
+                     f"{p['flops'] / s / 1e9:.2f}\t"
+                     f"{p['bytes'] / s / 1e9:.2f}\n")
+
+
+load_cache()
