@@ -1,0 +1,157 @@
+"""Public parameter structs — the QudaGaugeParam/QudaInvertParam/... analog.
+
+Reference behavior: include/quda.h:31-871 param structs with generated
+default-init/validation/printing from lib/check_params.h X-macros.
+Python dataclasses give the same three operations natively: defaults in
+field definitions, validate() for CHECK_PARAM, describe() for PRINT_PARAM.
+Enum strings follow include/enum_quda.h spellings, lowercased.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+# enum value sets (enum_quda.h analogs)
+DSLASH_TYPES = ("wilson", "clover", "twisted-mass", "twisted-clover",
+                "ndeg-twisted-mass", "staggered", "asqtad", "hisq",
+                "domain-wall", "domain-wall-4d", "mobius", "laplace")
+INVERTER_TYPES = ("cg", "cg3", "cgne", "cgnr", "pcg", "bicgstab",
+                  "bicgstab-l", "gcr", "mr", "sd", "ca-cg", "ca-gcr",
+                  "multi-shift-cg", "gcr-mg")
+PRECISIONS = ("double", "single", "half", "quarter")
+MATPC_TYPES = ("even-even", "odd-odd")
+SOLUTION_TYPES = ("mat", "matpc", "matdag-mat", "matpc-dag-matpc")
+SOLVE_TYPES = ("direct", "direct-pc", "normop", "normop-pc")
+
+
+def _check(cond, msg):
+    if not cond:
+        from ..utils.logging import errorq
+        errorq(msg)
+
+
+@dataclasses.dataclass
+class GaugeParam:
+    """QudaGaugeParam (quda.h:31)."""
+    X: Tuple[int, int, int, int] = (8, 8, 8, 8)   # (x,y,z,t)
+    t_boundary: str = "antiperiodic"               # periodic|antiperiodic
+    cpu_prec: str = "double"
+    cuda_prec: str = "double"                      # device precision
+    reconstruct: int = 18
+    anisotropy: float = 1.0
+    tadpole_coeff: float = 1.0
+    staggered_phase_type: str = "milc"
+    make_resident_gauge: bool = True
+
+    def validate(self):
+        _check(len(self.X) == 4 and all(d > 0 for d in self.X),
+               f"bad lattice dims {self.X}")
+        _check(self.t_boundary in ("periodic", "antiperiodic"),
+               f"bad t_boundary {self.t_boundary}")
+        _check(self.cuda_prec in PRECISIONS, f"bad prec {self.cuda_prec}")
+        return self
+
+    def describe(self) -> str:
+        return "\n".join(f"{f.name} = {getattr(self, f.name)}"
+                         for f in dataclasses.fields(self))
+
+
+@dataclasses.dataclass
+class InvertParam:
+    """QudaInvertParam (quda.h:100)."""
+    dslash_type: str = "wilson"
+    inv_type: str = "cg"
+    solution_type: str = "mat"
+    solve_type: str = "normop-pc"
+    matpc_type: str = "even-even"
+    mass: float = -0.9
+    kappa: float = 0.12
+    mu: float = 0.0
+    epsilon: float = 0.0
+    csw: float = 0.0
+    m5: float = -1.8                  # domain wall height (QUDA sign conv.)
+    Ls: int = 8
+    b5: float = 1.5
+    c5: float = 0.5
+    laplace3D: int = 3
+    tol: float = 1e-10
+    tol_hq: float = 0.0
+    maxiter: int = 10000
+    reliable_delta: float = 0.1
+    pipeline: int = 0
+    num_offset: int = 0               # multi-shift
+    offset: Sequence[float] = ()
+    cuda_prec: str = "double"
+    cuda_prec_sloppy: str = "single"
+    cuda_prec_precondition: str = "half"
+    gcrNkrylov: int = 16
+    verbosity: str = "summarize"
+    # results (returned)
+    true_res: float = 0.0
+    iter_count: int = 0
+    secs: float = 0.0
+    gflops: float = 0.0
+
+    def validate(self):
+        _check(self.dslash_type in DSLASH_TYPES,
+               f"unknown dslash_type {self.dslash_type}")
+        _check(self.inv_type in INVERTER_TYPES,
+               f"unknown inv_type {self.inv_type}")
+        _check(self.solve_type in SOLVE_TYPES,
+               f"unknown solve_type {self.solve_type}")
+        _check(self.matpc_type in MATPC_TYPES,
+               f"unknown matpc_type {self.matpc_type}")
+        _check(self.tol > 0 and self.maxiter > 0, "bad tol/maxiter")
+        if self.num_offset:
+            _check(len(self.offset) == self.num_offset, "offset mismatch")
+        return self
+
+    def describe(self) -> str:
+        return "\n".join(f"{f.name} = {getattr(self, f.name)}"
+                         for f in dataclasses.fields(self))
+
+
+@dataclasses.dataclass
+class EigParamAPI:
+    """QudaEigParam (quda.h:471)."""
+    eig_type: str = "trlm"            # trlm | iram
+    n_ev: int = 8
+    n_kr: int = 32
+    tol: float = 1e-8
+    max_restarts: int = 100
+    spectrum: str = "SR"
+    use_poly_acc: bool = False
+    poly_deg: int = 20
+    a_min: float = 0.1
+    a_max: float = 4.0
+    use_norm_op: bool = True          # solve on MdagM
+    use_dagger: bool = False
+    vec_outfile: str = ""
+    vec_infile: str = ""
+
+    def validate(self):
+        _check(self.eig_type in ("trlm", "iram"), "bad eig_type")
+        _check(0 < self.n_ev < self.n_kr, "need n_ev < n_kr")
+        return self
+
+
+@dataclasses.dataclass
+class MultigridParamAPI:
+    """QudaMultigridParam (quda.h:616), per-level lists."""
+    n_level: int = 2
+    geo_block_size: Sequence[Tuple[int, int, int, int]] = ((2, 2, 2, 2),)
+    n_vec: Sequence[int] = (8,)
+    setup_iters: Sequence[int] = (150,)
+    nu_pre: Sequence[int] = (0,)
+    nu_post: Sequence[int] = (4,)
+    smoother_omega: float = 0.85
+    coarse_solver_iters: int = 8
+    vec_outfile: str = ""
+    vec_infile: str = ""
+
+    def validate(self):
+        n = self.n_level - 1
+        _check(len(self.geo_block_size) >= n, "need block size per level")
+        _check(len(self.n_vec) >= n, "need n_vec per level")
+        return self

@@ -1,0 +1,522 @@
+"""Public entry points — the interface_quda.cpp analog.
+
+Mirrors the C API surface (include/quda.h): init_quda / load_gauge_quda /
+invert_quda / invert_multishift_quda / eigensolve_quda / dslash_quda /
+mat_quda / plaq_quda / gauss_gauge_quda / perform_gauge_smear_quda /
+perform_wflow_quda / compute_gauge_fixing_* / compute_ks_link_quda /
+compute_gauge_force_quda / update_gauge_field_quda / mom_action_quda /
+contract_quda, with resident-field state (make_resident_gauge) kept in a
+module-level context the way interface_quda.cpp keeps gaugePrecise etc.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..fields.geometry import EVEN, ODD, LatticeGeometry
+from ..fields.spinor import even_odd_join, even_odd_split
+from ..ops import blas
+from ..utils import logging as qlog
+from ..utils.precision import complex_dtype
+from .params import EigParamAPI, GaugeParam, InvertParam, MultigridParamAPI
+
+_ctx = {
+    "initialized": False,
+    "geom": None,
+    "gauge": None,          # resident gauge (4,T,Z,Y,X,3,3)
+    "gauge_param": None,
+    "fat": None,
+    "long": None,
+    "mg": None,
+}
+
+
+def init_quda(device: int = 0):
+    """initQuda analog (device selection is PJRT's job on TPU)."""
+    _ctx["initialized"] = True
+    qlog.printq("initialized", qlog.VERBOSE)
+
+
+def end_quda():
+    for k in list(_ctx):
+        _ctx[k] = None if k != "initialized" else False
+    from ..utils.timer import print_summary
+    print_summary()
+
+
+def _require_init():
+    if not _ctx["initialized"]:
+        qlog.errorq("initQuda has not been called")
+
+
+def load_gauge_quda(gauge, param: GaugeParam):
+    """loadGaugeQuda: host layout (4,T,Z,Y,X,3,3) -> resident device gauge."""
+    _require_init()
+    param.validate()
+    geom = LatticeGeometry(tuple(param.X))
+    dtype = complex_dtype(param.cuda_prec)
+    g = jnp.asarray(gauge, dtype)
+    if g.shape != (4,) + geom.lattice_shape + (3, 3):
+        qlog.errorq(f"gauge shape {g.shape} != expected for {param.X}")
+    _ctx["geom"] = geom
+    _ctx["gauge"] = g
+    _ctx["gauge_param"] = param
+
+
+def free_gauge_quda():
+    _ctx["gauge"] = None
+
+
+def _antiperiodic():
+    return _ctx["gauge_param"].t_boundary == "antiperiodic"
+
+
+def _build_dirac(p: InvertParam, pc: bool):
+    from ..models import clover as mclover
+    from ..models import domain_wall as mdw
+    from ..models import staggered as mstag
+    from ..models import twisted as mtw
+    from ..models import wilson as mwil
+
+    geom = _ctx["geom"]
+    g = _ctx["gauge"]
+    ap = _antiperiodic()
+    matpc = EVEN if p.matpc_type == "even-even" else ODD
+    t = p.dslash_type
+    if t == "wilson":
+        return (mwil.DiracWilsonPC(g, geom, p.kappa, ap, matpc) if pc
+                else mwil.DiracWilson(g, geom, p.kappa, ap))
+    if t == "clover":
+        return (mclover.DiracCloverPC(g, geom, p.kappa, p.csw, ap, matpc)
+                if pc else mclover.DiracClover(g, geom, p.kappa, p.csw, ap))
+    if t == "twisted-mass":
+        return (mtw.DiracTwistedMassPC(g, geom, p.kappa, p.mu, ap, matpc)
+                if pc else mtw.DiracTwistedMass(g, geom, p.kappa, p.mu, ap))
+    if t == "twisted-clover":
+        return (mtw.DiracTwistedCloverPC(g, geom, p.kappa, p.mu, p.csw, ap,
+                                         matpc) if pc
+                else mtw.DiracTwistedClover(g, geom, p.kappa, p.mu, p.csw,
+                                            ap))
+    if t == "ndeg-twisted-mass":
+        return mtw.DiracNdegTwistedMass(g, geom, p.kappa, p.mu, p.epsilon,
+                                        ap)
+    if t in ("staggered", "asqtad", "hisq"):
+        improved = t != "staggered"
+        fat = _ctx["fat"] if improved else g
+        lng = _ctx["long"] if improved else None
+        if improved and fat is None:
+            qlog.errorq("asqtad/hisq invert requires compute_ks_link_quda "
+                        "or load_fat_long_quda first")
+        return (mstag.DiracStaggeredPC(fat, geom, p.mass, improved, lng,
+                                       matpc, antiperiodic_t=ap) if pc
+                else mstag.DiracStaggered(fat, geom, p.mass, improved, lng,
+                                          antiperiodic_t=ap))
+    if t in ("domain-wall", "domain-wall-4d", "mobius"):
+        b5, c5 = (1.0, 0.0) if t != "mobius" else (p.b5, p.c5)
+        m5 = -p.m5  # QUDA passes m5 negative
+        if pc:
+            return mdw.DiracMobiusPC(g, geom, p.Ls, m5, p.mass, b5, c5, ap,
+                                     matpc)
+        return mdw.DiracMobius(g, geom, p.Ls, m5, p.mass, b5, c5, ap)
+    if t == "laplace":
+        from ..ops.laplace import laplace
+
+        class _Lap:
+            def M(self, psi):
+                return laplace(g, psi, ndim=p.laplace3D, mass=p.mass)
+
+            Mdag = M
+
+            def MdagM(self, psi):
+                return self.M(self.M(psi))
+
+        return _Lap()
+    qlog.errorq(f"dslash_type {t} not wired into invert yet")
+
+
+def _split(b, p):
+    geom = _ctx["geom"]
+    if p.dslash_type in ("domain-wall", "domain-wall-4d", "mobius"):
+        be = jax.vmap(lambda v: even_odd_split(v, geom)[0])(b)
+        bo = jax.vmap(lambda v: even_odd_split(v, geom)[1])(b)
+        return be, bo
+    return even_odd_split(b, geom)
+
+
+def _join(xe, xo, p):
+    geom = _ctx["geom"]
+    if p.dslash_type in ("domain-wall", "domain-wall-4d", "mobius"):
+        return jax.vmap(lambda e, o: even_odd_join(e, o, geom))(xe, xo)
+    return even_odd_join(xe, xo, geom)
+
+
+def invert_quda(source, param: InvertParam):
+    """invertQuda: solve M x = b per param; returns x, mutates param
+    result fields (true_res, iter_count, secs, gflops)."""
+    _require_init()
+    param.validate()
+    from .. import solvers
+
+    dtype = complex_dtype(param.cuda_prec)
+    b = jnp.asarray(source, dtype)
+    t0 = time.perf_counter()
+    pc = param.solve_type.endswith("-pc")
+    d = _build_dirac(param, pc)
+    d_full = _build_dirac(param, False)
+
+    if pc:
+        be, bo = _split(b, param)
+        rhs = d.prepare(be, bo)
+    else:
+        rhs = b
+
+    normop = param.solve_type.startswith("normop")
+    hermitian_pc = getattr(d, "hermitian", False)
+
+    if param.num_offset:
+        qlog.errorq("use invert_multishift_quda for shifted solves")
+
+    mixed = (param.cuda_prec_sloppy != param.cuda_prec
+             and param.inv_type == "cg"
+             and param.cuda_prec == "double")
+
+    if hermitian_pc:           # staggered PC: already the normal operator
+        mv = d.M
+        sys_rhs = rhs
+        back = lambda x: x
+    elif normop:
+        mv = lambda v: d.Mdag(d.M(v))
+        sys_rhs = d.Mdag(rhs)
+        back = lambda x: x
+    else:
+        mv = d.M
+        sys_rhs = rhs
+        back = lambda x: x
+
+    inv = param.inv_type
+    if inv == "cg" and not (hermitian_pc or normop):
+        qlog.warningq("cg on a non-normal system; switching to normal eq")
+        mv = lambda v: d.Mdag(d.M(v))
+        sys_rhs = d.Mdag(rhs)
+
+    if mixed and inv == "cg":
+        sl = _build_sloppy(param, pc)
+        if hermitian_pc:
+            mv_lo = sl.M
+        else:
+            mv_lo = lambda v: sl.Mdag(sl.M(v))
+        res = solvers.cg_reliable(
+            mv, mv_lo, sys_rhs, complex_dtype(param.cuda_prec_sloppy),
+            tol=param.tol, maxiter=param.maxiter,
+            delta=param.reliable_delta)
+    elif inv in ("cg", "pcg", "cg3"):
+        fn = solvers.create(inv)
+        res = fn(mv, sys_rhs, tol=param.tol, maxiter=param.maxiter)
+    elif inv == "bicgstab":
+        res = solvers.bicgstab(mv, sys_rhs, tol=param.tol,
+                               maxiter=param.maxiter)
+    elif inv == "bicgstab-l":
+        res = solvers.bicgstab_l(mv, sys_rhs, L=4, tol=param.tol,
+                                 maxiter=param.maxiter)
+    elif inv == "gcr":
+        res = solvers.gcr(mv, sys_rhs, tol=param.tol,
+                          nkrylov=param.gcrNkrylov,
+                          max_restarts=max(1, param.maxiter
+                                           // param.gcrNkrylov))
+    elif inv in ("ca-cg", "ca-gcr"):
+        fn = solvers.create(inv)
+        res = fn(mv, sys_rhs, tol=param.tol,
+                 max_cycles=max(1, param.maxiter // 8))
+    elif inv == "gcr-mg":
+        res = _solve_mg(d_full, b, param)
+        x_full = res.x
+        param.iter_count = int(res.iters)
+        param.secs = time.perf_counter() - t0
+        r = b - d_full.M(x_full)
+        param.true_res = float(jnp.sqrt(blas.norm2(r) / blas.norm2(b)))
+        return x_full
+    else:
+        qlog.errorq(f"inv_type {inv} not wired")
+
+    x_sys = back(res.x)
+    if pc:
+        xe, xo = d.reconstruct(x_sys, be, bo)
+        x_full = _join(xe, xo, param)
+    else:
+        x_full = x_sys
+
+    param.iter_count = int(res.iters)
+    param.secs = time.perf_counter() - t0
+    r = b - d_full.M(x_full)
+    param.true_res = float(jnp.sqrt(blas.norm2(r) / blas.norm2(b)))
+    flops = getattr(d, "flops_per_site_M", lambda: 0)()
+    vol = _ctx["geom"].volume
+    param.gflops = (param.iter_count * 2.0 * flops * vol) / 1e9
+    qlog.printq(
+        f"invert_quda[{param.dslash_type}/{inv}]: {param.iter_count} iters,"
+        f" true_res {param.true_res:.2e}, {param.secs:.2f} s")
+    return x_full
+
+
+def _build_sloppy(p: InvertParam, pc: bool):
+    import copy
+    sl = copy.copy(p)
+    sl.cuda_prec = p.cuda_prec_sloppy
+    dt = complex_dtype(p.cuda_prec_sloppy)
+    saved = {k: _ctx[k] for k in ("gauge", "fat", "long")}
+    for k, v in saved.items():
+        if v is not None:
+            _ctx[k] = v.astype(dt)
+    try:
+        d = _build_dirac(sl, pc)
+    finally:
+        _ctx.update(saved)
+    return d
+
+
+def _solve_mg(d_full, b, param: InvertParam, mg_param=None):
+    from ..mg.mg import MG, MGLevelParam, mg_solve
+    mp = mg_param or MultigridParamAPI()
+    params = [MGLevelParam(block=tuple(mp.geo_block_size[i]),
+                           n_vec=mp.n_vec[i],
+                           setup_iters=mp.setup_iters[i]
+                           if i < len(mp.setup_iters) else 150,
+                           pre_smooth=mp.nu_pre[i] if i < len(mp.nu_pre)
+                           else 0,
+                           post_smooth=mp.nu_post[i] if i < len(mp.nu_post)
+                           else 4,
+                           smoother_omega=mp.smoother_omega,
+                           coarse_solver_iters=mp.coarse_solver_iters)
+              for i in range(mp.n_level - 1)]
+    res, mg = mg_solve(d_full, _ctx["geom"], b, params, tol=param.tol,
+                       nkrylov=param.gcrNkrylov, mg=_ctx["mg"])
+    _ctx["mg"] = mg
+    return res
+
+
+def new_multigrid_quda(mg_param: MultigridParamAPI, invert_param: InvertParam):
+    """newMultigridQuda: run setup, keep hierarchy resident."""
+    _require_init()
+    mg_param.validate()
+    from ..mg.mg import MG, MGLevelParam
+    d = _build_dirac(invert_param, False)
+    params = [MGLevelParam(block=tuple(mg_param.geo_block_size[i]),
+                           n_vec=mg_param.n_vec[i])
+              for i in range(mg_param.n_level - 1)]
+    _ctx["mg"] = MG(d, _ctx["geom"], params)
+    return _ctx["mg"]
+
+
+def destroy_multigrid_quda():
+    _ctx["mg"] = None
+
+
+def invert_multishift_quda(source, param: InvertParam):
+    """invertMultiShiftQuda: (A + offset_i) x_i = b on the PC normal op."""
+    _require_init()
+    param.validate()
+    from ..solvers.multishift import multishift_cg
+    b = jnp.asarray(source, complex_dtype(param.cuda_prec))
+    d = _build_dirac(param, True)
+    be, bo = _split(b, param)
+    rhs = d.prepare(be, bo)
+    if getattr(d, "hermitian", False):
+        mv = d.M
+    else:
+        mv = lambda v: d.Mdag(d.M(v))
+        rhs = d.Mdag(rhs)
+    t0 = time.perf_counter()
+    res = multishift_cg(mv, rhs, tuple(param.offset), tol=param.tol,
+                        maxiter=param.maxiter)
+    param.iter_count = int(res.iters)
+    param.secs = time.perf_counter() - t0
+    return res.x
+
+
+def dslash_quda(psi, param: InvertParam, parity: int):
+    """dslashQuda: apply the PC hop D_{parity, 1-parity}."""
+    _require_init()
+    d = _build_dirac(param, True)
+    return d.D_to(jnp.asarray(psi, complex_dtype(param.cuda_prec)), parity)
+
+
+def mat_quda(psi, param: InvertParam):
+    """MatQuda: full operator application."""
+    _require_init()
+    d = _build_dirac(param, False)
+    return d.M(jnp.asarray(psi, complex_dtype(param.cuda_prec)))
+
+
+def mat_dag_mat_quda(psi, param: InvertParam):
+    _require_init()
+    d = _build_dirac(param, False)
+    return d.MdagM(jnp.asarray(psi, complex_dtype(param.cuda_prec)))
+
+
+def eigensolve_quda(eig_param: EigParamAPI, invert_param: InvertParam):
+    """eigensolveQuda: returns (evals, evecs)."""
+    _require_init()
+    eig_param.validate()
+    from ..eig.iram import iram
+    from ..eig.lanczos import EigParam, trlm
+    pc = invert_param.solve_type.endswith("-pc")
+    d = _build_dirac(invert_param, pc)
+    geom = _ctx["geom"]
+    dtype = complex_dtype(invert_param.cuda_prec)
+    shape = (geom.half_lattice_shape if pc else geom.lattice_shape) + (4, 3)
+    if invert_param.dslash_type in ("staggered", "asqtad", "hisq"):
+        shape = shape[:-2] + (1, 3)
+    example = jnp.zeros(shape, dtype)
+    p = EigParam(n_ev=eig_param.n_ev, n_kr=eig_param.n_kr,
+                 tol=eig_param.tol, max_restarts=eig_param.max_restarts,
+                 use_poly_acc=eig_param.use_poly_acc,
+                 poly_deg=eig_param.poly_deg, a_min=eig_param.a_min,
+                 a_max=eig_param.a_max, spectrum=eig_param.spectrum)
+    op = d.MdagM if eig_param.use_norm_op else d.M
+    if eig_param.eig_type == "trlm":
+        res = trlm(op, example, p)
+    else:
+        res = iram(op, example, p)
+    if eig_param.vec_outfile:
+        from ..utils.io import save_vectors
+        save_vectors(eig_param.vec_outfile, res.evecs, res.evals)
+    return res.evals, res.evecs
+
+
+# -- gauge utilities -------------------------------------------------------
+
+def plaq_quda():
+    from ..gauge.observables import plaquette
+    _require_init()
+    m, s, t = plaquette(_ctx["gauge"])
+    return float(m), float(s), float(t)
+
+
+def gauge_observables_quda():
+    from ..gauge.observables import energy, plaquette, polyakov_loop, qcharge
+    _require_init()
+    g = _ctx["gauge"]
+    return {
+        "plaquette": tuple(float(x) for x in plaquette(g)),
+        "polyakov_loop": complex(polyakov_loop(g)),
+        "qcharge": float(qcharge(g)),
+        "energy": tuple(float(x) for x in energy(g)),
+    }
+
+
+def gauss_gauge_quda(seed: int, sigma: float):
+    """gaussGaugeQuda: randomise the resident gauge field."""
+    from ..ops.su3 import random_su3
+    _require_init()
+    key = jax.random.PRNGKey(seed)
+    _ctx["gauge"] = random_su3(key, (4,) + _ctx["geom"].lattice_shape,
+                               _ctx["gauge"].dtype, scale=sigma)
+
+
+def perform_gauge_smear_quda(smear_type: str, n_steps: int, **kw):
+    """performGaugeSmearQuda: ape|stout|ovrimp-stout|hyp on resident gauge."""
+    from ..gauge import smear as gsm
+    _require_init()
+    g = _ctx["gauge"]
+    if smear_type == "ape":
+        g = gsm.ape_smear(g, kw.get("alpha", 0.6), n_steps=n_steps)
+    elif smear_type == "stout":
+        g = gsm.stout_smear(g, kw.get("rho", 0.1), n_steps=n_steps)
+    elif smear_type == "ovrimp-stout":
+        g = gsm.stout_smear(g, kw.get("rho", 0.08), n_steps=n_steps,
+                            epsilon=kw.get("epsilon", -0.25))
+    elif smear_type == "hyp":
+        g = gsm.hyp_smear(g, n_steps=n_steps)
+    else:
+        qlog.errorq(f"unknown smear type {smear_type}")
+    _ctx["gauge"] = g
+
+
+def perform_wflow_quda(n_steps: int, eps: float, smear_type="wilson",
+                       measure=None):
+    from ..gauge.smear import symanzik_flow_step, wilson_flow_step
+    _require_init()
+    step = wilson_flow_step if smear_type == "wilson" else symanzik_flow_step
+    hist = []
+    g = _ctx["gauge"]
+    for i in range(n_steps):
+        g = step(g, eps)
+        if measure:
+            hist.append(measure(g, (i + 1) * eps))
+    _ctx["gauge"] = g
+    return hist
+
+
+def compute_gauge_fixing_ovr_quda(gauge_dirs: int = 4, **kw):
+    from ..gauge.fix import gaugefix_ovr
+    _require_init()
+    g, iters, theta = gaugefix_ovr(_ctx["gauge"], _ctx["geom"],
+                                   gauge_dirs=gauge_dirs, **kw)
+    _ctx["gauge"] = g
+    return iters, theta
+
+
+def compute_gauge_fixing_fft_quda(gauge_dirs: int = 4, **kw):
+    from ..gauge.fix import gaugefix_fft
+    _require_init()
+    g, iters, theta = gaugefix_fft(_ctx["gauge"], _ctx["geom"],
+                                   gauge_dirs=gauge_dirs, **kw)
+    _ctx["gauge"] = g
+    return iters, theta
+
+
+def compute_ks_link_quda(naik_eps: float = 0.0):
+    """computeKSLinkQuda: HISQ fatten the resident gauge; keep fat/long
+    resident for staggered inverts."""
+    from ..gauge.hisq import hisq_fattening
+    _require_init()
+    links = hisq_fattening(_ctx["gauge"], naik_eps)
+    _ctx["fat"] = links.fat
+    _ctx["long"] = links.long
+    return links
+
+
+def load_fat_long_quda(fat, long_links):
+    _require_init()
+    dtype = _ctx["gauge"].dtype if _ctx["gauge"] is not None else None
+    _ctx["fat"] = jnp.asarray(fat, dtype)
+    _ctx["long"] = jnp.asarray(long_links, dtype)
+
+
+def compute_gauge_force_quda(beta: float, c1: float = 0.0):
+    from ..gauge.action import gauge_force, improved_action, wilson_action
+    _require_init()
+    act = (lambda u: wilson_action(u, beta)) if c1 == 0.0 else \
+        (lambda u: improved_action(u, beta, c1))
+    return gauge_force(act, _ctx["gauge"])
+
+
+def update_gauge_field_quda(mom, dt: float, reunitarize: bool = True):
+    from ..gauge.action import update_gauge
+    from ..ops.su3 import project_su3
+    _require_init()
+    g = update_gauge(_ctx["gauge"], mom, dt)
+    if reunitarize:
+        g = project_su3(g)
+    _ctx["gauge"] = g
+
+
+def mom_action_quda(mom):
+    from ..gauge.action import mom_action
+    return float(mom_action(mom))
+
+
+def contract_quda(x, y, contract_type: str = "open", momenta=None):
+    from ..ops.contract import contract_dr, contract_ft, contract_open_spin
+    if contract_type == "open":
+        return contract_open_spin(jnp.asarray(x), jnp.asarray(y))
+    if contract_type == "dr":
+        return contract_dr(jnp.asarray(x), jnp.asarray(y))
+    if contract_type == "ft":
+        return contract_ft(jnp.asarray(x), jnp.asarray(y),
+                           momenta or [(0, 0, 0)])
+    qlog.errorq(f"unknown contract type {contract_type}")
