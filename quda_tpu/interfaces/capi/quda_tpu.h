@@ -1,0 +1,64 @@
+/* quda_tpu C ABI — the libquda-style native interface.
+ *
+ * Mirrors the shape of include/quda.h's C API (initQuda/loadGaugeQuda/
+ * invertQuda/plaqQuda/endQuda) for host applications (MILC-class codes)
+ * linking a plain C library.  The implementation (quda_tpu_c.cpp) hosts an
+ * embedded CPython interpreter running the JAX/XLA compute path; when
+ * loaded into an already-running Python process it reuses that
+ * interpreter.
+ *
+ * Conventions:
+ *  - links: 4 * V * 3 * 3 complex doubles, direction-major
+ *    [mu][t][z][y][x][row][col], mu = 0,1,2,3 = x,y,z,t (row-major 3x3),
+ *    interleaved re/im (i.e. C99 double _Complex layout).
+ *  - fermion fields: V * 4(spin) * 3(color) complex doubles, site-major
+ *    [t][z][y][x][spin][color].
+ *  - X[4] = {Lx, Ly, Lz, Lt}.
+ * All functions return 0 on success, nonzero on error.
+ */
+
+#ifndef QUDA_TPU_H
+#define QUDA_TPU_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct QTpuInvertArgs_s {
+  const char *dslash_type;   /* "wilson", "clover", "staggered", ... */
+  const char *inv_type;      /* "cg", "bicgstab", ... */
+  const char *solve_type;    /* "normop-pc", "direct-pc", ... */
+  double kappa;
+  double mass;
+  double mu;
+  double csw;
+  double tol;
+  int maxiter;
+  /* results */
+  double true_res;
+  int iter_count;
+  double secs;
+} QTpuInvertArgs;
+
+int qtpu_init(void);
+int qtpu_end(void);
+
+/* load the resident gauge field (see layout above) */
+int qtpu_load_gauge(const double *links, const int X[4],
+                    int antiperiodic_t);
+
+/* plaquette of the resident gauge: out[0]=mean, [1]=spatial, [2]=temporal */
+int qtpu_plaq(double out[3]);
+
+/* solve M x = b; source/solution are full-lattice fermion fields */
+int qtpu_invert(double *solution, const double *source,
+                QTpuInvertArgs *args);
+
+/* last error message (empty string if none) */
+const char *qtpu_error_string(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* QUDA_TPU_H */

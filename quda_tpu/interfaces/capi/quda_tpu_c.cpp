@@ -1,0 +1,170 @@
+/* quda_tpu C ABI implementation: a thin native host layer that embeds
+ * CPython and drives quda_tpu.interfaces.capi_bridge.
+ *
+ * This is the native analog of lib/interface_quda.cpp for the TPU build:
+ * the heavy compute lives in XLA executables launched by JAX; the C++
+ * layer owns process embedding, GIL discipline, buffer passing
+ * (zero-copy memoryviews over the caller's arrays) and error capture.
+ */
+
+#include "quda_tpu.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_mutex;
+std::string g_error;
+bool g_we_initialized = false;
+PyObject *g_bridge = nullptr;  // quda_tpu.interfaces.capi_bridge module
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      g_error = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  } else {
+    g_error = "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+struct Gil {
+  PyGILState_STATE state;
+  Gil() : state(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state); }
+};
+
+PyObject *bridge() {
+  if (!g_bridge) {
+    g_bridge = PyImport_ImportModule("quda_tpu.interfaces.capi_bridge");
+    if (!g_bridge) set_error_from_python();
+  }
+  return g_bridge;
+}
+
+// call bridge.<name>(*args); returns new ref or nullptr (error set)
+PyObject *call(const char *name, PyObject *args) {
+  PyObject *mod = bridge();
+  if (!mod) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *fn = PyObject_GetAttrString(mod, name);
+  if (!fn) {
+    set_error_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *out = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  Py_XDECREF(args);
+  if (!out) set_error_from_python();
+  return out;
+}
+
+PyObject *mv_ro(const double *p, Py_ssize_t n_doubles) {
+  return PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<double *>(p)),
+      n_doubles * sizeof(double), PyBUF_READ);
+}
+
+PyObject *mv_rw(double *p, Py_ssize_t n_doubles) {
+  return PyMemoryView_FromMemory(reinterpret_cast<char *>(p),
+                                 n_doubles * sizeof(double), PyBUF_WRITE);
+}
+
+}  // namespace
+
+extern "C" {
+
+int qtpu_init(void) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+    // release the GIL acquired by Py_Initialize so Gil{} works uniformly
+    PyEval_SaveThread();
+  }
+  Gil gil;
+  PyObject *out = call("init", PyTuple_New(0));
+  if (!out) return 1;
+  Py_DECREF(out);
+  return 0;
+}
+
+int qtpu_end(void) {
+  Gil gil;
+  PyObject *out = call("end", PyTuple_New(0));
+  if (!out) return 1;
+  Py_DECREF(out);
+  return 0;
+}
+
+int qtpu_load_gauge(const double *links, const int X[4],
+                    int antiperiodic_t) {
+  Gil gil;
+  long vol = 1L * X[0] * X[1] * X[2] * X[3];
+  PyObject *args = Py_BuildValue(
+      "(N(iiii)i)", mv_ro(links, vol * 4 * 9 * 2), X[0], X[1], X[2], X[3],
+      antiperiodic_t);
+  PyObject *out = call("load_gauge", args);
+  if (!out) return 1;
+  Py_DECREF(out);
+  return 0;
+}
+
+int qtpu_plaq(double out3[3]) {
+  Gil gil;
+  PyObject *out = call("plaq", PyTuple_New(0));
+  if (!out) return 1;
+  if (!PyArg_ParseTuple(out, "ddd", &out3[0], &out3[1], &out3[2])) {
+    set_error_from_python();
+    Py_DECREF(out);
+    return 1;
+  }
+  Py_DECREF(out);
+  return 0;
+}
+
+int qtpu_invert(double *solution, const double *source,
+                QTpuInvertArgs *a) {
+  Gil gil;
+  PyObject *vol_obj = call("volume", PyTuple_New(0));
+  if (!vol_obj) return 1;
+  long vol = PyLong_AsLong(vol_obj);
+  Py_DECREF(vol_obj);
+  long n = vol * 4 * 3 * 2;  // spin*color*complex doubles
+  PyObject *args = Py_BuildValue(
+      "(NNsssdddddi)", mv_rw(solution, n), mv_ro(source, n),
+      a->dslash_type ? a->dslash_type : "wilson",
+      a->inv_type ? a->inv_type : "cg",
+      a->solve_type ? a->solve_type : "normop-pc", a->kappa, a->mass,
+      a->mu, a->csw, a->tol, a->maxiter);
+  PyObject *out = call("invert", args);
+  if (!out) return 1;
+  if (!PyArg_ParseTuple(out, "did", &a->true_res, &a->iter_count,
+                        &a->secs)) {
+    set_error_from_python();
+    Py_DECREF(out);
+    return 1;
+  }
+  Py_DECREF(out);
+  return 0;
+}
+
+const char *qtpu_error_string(void) { return g_error.c_str(); }
+
+}  // extern "C"

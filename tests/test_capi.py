@@ -1,0 +1,66 @@
+"""Native C ABI tests: build libquda_tpu.so, drive it from a real C host
+program (the MILC-linkage analog) and via ctypes."""
+
+import ctypes
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+CAPI_DIR = os.path.join(os.path.dirname(__file__), "..", "quda_tpu",
+                        "interfaces", "capi")
+
+
+@pytest.fixture(scope="module")
+def libpath(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    out = tmp_path_factory.mktemp("capi")
+    r = subprocess.run(["sh", "build.sh", str(out)], cwd=CAPI_DIR,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return str(out / "libquda_tpu.so")
+
+
+def test_c_host_program(libpath, tmp_path):
+    """Compile and run the standalone C driver against the shared lib."""
+    exe = str(tmp_path / "test_capi")
+    r = subprocess.run(
+        ["gcc", os.path.join(CAPI_DIR, "test_capi.c"), "-I", CAPI_DIR,
+         f"-L{os.path.dirname(libpath)}", "-lquda_tpu", "-lm", "-o", exe],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ)
+    env["LD_LIBRARY_PATH"] = os.path.dirname(libpath)
+    env["PYTHONPATH"] = (os.path.abspath(os.path.join(CAPI_DIR, "..", "..",
+                                                      ".."))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    # force the CPU backend inside the embedded interpreter
+    env["QUDA_TPU_FORCE_CPU"] = "1"
+    r = subprocess.run([exe], capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "C ABI test passed" in r.stdout
+
+
+def test_ctypes_in_process(libpath):
+    """Load the ABI into this (already-running) interpreter: the shim must
+    detect Py_IsInitialized and reuse it."""
+    lib = ctypes.CDLL(libpath)
+    lib.qtpu_error_string.restype = ctypes.c_char_p
+    assert lib.qtpu_init() == 0, lib.qtpu_error_string()
+
+    L = 4
+    vol = L ** 4
+    links = np.zeros((4, L, L, L, L, 3, 3), dtype=np.complex128)
+    links[..., 0, 0] = links[..., 1, 1] = links[..., 2, 2] = 1.0
+    X = (ctypes.c_int * 4)(L, L, L, L)
+    assert lib.qtpu_load_gauge(
+        links.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), X, 1) == 0, \
+        lib.qtpu_error_string()
+    out = (ctypes.c_double * 3)()
+    assert lib.qtpu_plaq(out) == 0
+    assert abs(out[0] - 1.0) < 1e-12
