@@ -1,0 +1,231 @@
+"""Pallas TPU kernel for the Wilson dslash — the hand-tuned hot path.
+
+Reference behavior: include/kernels/dslash_wilson.cuh (the 8-direction
+gather/project/reconstruct stencil).  The pure-XLA path (ops/wilson.py)
+relies on XLA fusing 8 rolled copies; this kernel makes one pass over HBM
+per (t, z) plane: psi planes for t/z neighbours arrive via BlockSpec index
+maps (periodic wrap in the map), x/y shifts happen in VMEM, and the spin
+algebra uses the classic 2-spinor projection trick (project -> one 3x3
+color multiply on 2 spins -> reconstruct), with complex math as explicit
+float pairs (TPU VPU has no complex type).
+
+The spin projection tables are DERIVED from ops/gamma.py at import and
+asserted, not hand-copied: for each (mu, sign), P = 1 -+ gamma_mu has rank
+2 with rows 2,3 proportional to rows 0,1 — the tables record the partner
+spin and the +-1/+-i coefficients.
+
+Layouts (float32/float64 pairs, complex interleaved in the last axis):
+  psi:   (T, Z, Y, X, 4, 3, 2)
+  gauge: (4, T, Z, Y, X, 3, 3, 2)
+
+`dslash_pallas` is the drop-in complex-array wrapper; `tuned_dslash`
+consults utils.tune to pick between this kernel and the XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import gamma as g
+
+# -- spin projection tables (derived, then trusted) ------------------------
+# For P = 1 -+ gamma_mu: half-spinor h_a = psi_a + c_a * psi_{j_a} (a=0,1);
+# reconstruction rows: out_2 = d_2 * h_{k_2}, out_3 = d_3 * h_{k_3}.
+
+
+def _derive_tables():
+    tables = {}
+    for mu in range(4):
+        for sign in (+1, -1):
+            P = np.eye(4) - sign * np.asarray(g.GAMMAS[mu])
+            entry = {}
+            for a in (0, 1):
+                row = P[a]
+                assert row[a] == 1.0
+                nz = [j for j in range(4) if j != a and abs(row[j]) > 1e-12]
+                assert len(nz) == 1, (mu, sign, a, row)
+                entry[f"j{a}"] = nz[0]
+                entry[f"c{a}"] = complex(row[nz[0]])
+            for b in (2, 3):
+                row = P[b]
+                # row b = d * row a for exactly one a in (0,1)
+                found = False
+                for a in (0, 1):
+                    ra = P[a]
+                    nz_b = np.nonzero(np.abs(row) > 1e-12)[0]
+                    nz_a = np.nonzero(np.abs(ra) > 1e-12)[0]
+                    if set(nz_b) == set(nz_a):
+                        d = row[nz_b[0]] / ra[nz_b[0]]
+                        assert np.allclose(row, d * ra), (mu, sign, b)
+                        entry[f"k{b}"] = a
+                        entry[f"d{b}"] = complex(d)
+                        found = True
+                        break
+                assert found, (mu, sign, b)
+            tables[(mu, sign)] = entry
+    return tables
+
+
+TABLES = _derive_tables()
+
+
+# -- float-pair complex helpers (operate on ... x 2 arrays) ----------------
+
+def _cmul(a, b):
+    ar, ai = a[..., 0], a[..., 1]
+    br, bi = b[..., 0], b[..., 1]
+    return jnp.stack([ar * br - ai * bi, ar * bi + ai * br], axis=-1)
+
+
+def _cmul_conj(a, b):
+    """conj(a) * b."""
+    ar, ai = a[..., 0], a[..., 1]
+    br, bi = b[..., 0], b[..., 1]
+    return jnp.stack([ar * br + ai * bi, ar * bi - ai * br], axis=-1)
+
+
+def _cscale(c: complex, x):
+    cr, ci = float(c.real), float(c.imag)
+    xr, xi = x[..., 0], x[..., 1]
+    return jnp.stack([cr * xr - ci * xi, cr * xi + ci * xr], axis=-1)
+
+
+def _color_mat_vec(u, p, adjoint: bool):
+    """u: (Y,X,3,3,2); p: (Y,X,2,3,2) -> (Y,X,2,3,2); unrolled 3x3."""
+    rows = []
+    for a_idx in range(3):
+        acc = None
+        for b_idx in range(3):
+            if adjoint:
+                term = _cmul_conj(u[..., None, b_idx, a_idx, :],
+                                  p[..., :, b_idx, :])
+            else:
+                term = _cmul(u[..., None, a_idx, b_idx, :],
+                             p[..., :, b_idx, :])
+            acc = term if acc is None else acc + term
+        rows.append(acc)
+    return jnp.stack(rows, axis=-2)  # (Y,X,2,3,2)
+
+
+def _roll2(arr, shift: int, axis: int):
+    return jnp.roll(arr, shift, axis=axis)
+
+
+def _hop(out, psi_s, u, mu: int, sign: int, adjoint: bool):
+    """Project/color-multiply/reconstruct one direction; accumulate."""
+    t = TABLES[(mu, sign)]
+    # project to half spinor (Y,X,2,3,2)
+    h0 = psi_s[..., 0, :, :] + _cscale(t["c0"], psi_s[..., t["j0"], :, :])
+    h1 = psi_s[..., 1, :, :] + _cscale(t["c1"], psi_s[..., t["j1"], :, :])
+    h = jnp.stack([h0, h1], axis=-3)
+    uh = _color_mat_vec(u, h, adjoint)
+    r2 = _cscale(t["d2"], uh[..., t["k2"], :, :])
+    r3 = _cscale(t["d3"], uh[..., t["k3"], :, :])
+    add = jnp.stack([uh[..., 0, :, :], uh[..., 1, :, :], r2, r3], axis=-3)
+    return out + add
+
+
+def _kernel(psi00, psi_tp, psi_tm, psi_zp, psi_zm, g00, g_tm, g_zm,
+            out_ref):
+    """One (t, z) plane of the Wilson hop sum.  Refs carry (1,1,Y,X,...)
+    blocks (leading t,z block dims squeezed below)."""
+    p00 = psi00[0, 0]
+    out = jnp.zeros_like(p00)
+    gauge = g00[:, 0, 0]          # (4, Y, X, 3, 3, 2)
+
+    # x direction (intra-block rolls along axis=1)
+    out = _hop(out, _roll2(p00, -1, 1), gauge[0], 0, +1, False)
+    out = _hop(out, _roll2(p00, +1, 1), _roll2(gauge[0], +1, 1), 0, -1,
+               True)
+    # y direction (axis=0)
+    out = _hop(out, _roll2(p00, -1, 0), gauge[1], 1, +1, False)
+    out = _hop(out, _roll2(p00, +1, 0), _roll2(gauge[1], +1, 0), 1, -1,
+               True)
+    # z direction (neighbour planes)
+    out = _hop(out, psi_zp[0, 0], gauge[2], 2, +1, False)
+    out = _hop(out, psi_zm[0, 0], g_zm[0, 0, 0], 2, -1, True)
+    # t direction
+    out = _hop(out, psi_tp[0, 0], gauge[3], 3, +1, False)
+    out = _hop(out, psi_tm[0, 0], g_tm[0, 0, 0], 3, -1, True)
+
+    out_ref[0, 0] = out
+
+
+def _pairs(x):
+    """complex (..., ) -> float pairs (..., 2)."""
+    return jnp.stack([x.real, x.imag], axis=-1)
+
+
+def _unpairs(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def dslash_pallas(gauge: jnp.ndarray, psi: jnp.ndarray,
+                  interpret: bool = False) -> jnp.ndarray:
+    """Wilson hop sum D psi via the Pallas kernel (complex in/out).
+
+    gauge: (4,T,Z,Y,X,3,3) complex64 (boundary phases folded);
+    psi: (T,Z,Y,X,4,3) complex64.
+    """
+    from jax.experimental import pallas as pl
+
+    T, Z, Y, X = psi.shape[:4]
+    gp = _pairs(gauge)
+    pp = _pairs(psi)
+    fdt = pp.dtype
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (1, 1, Y, X, 4, 3, 2),
+            lambda t, z: ((t + dt) % T, (z + dz) % Z, 0, 0, 0, 0, 0))
+
+    def gauge_spec(dt, dz, mu=None):
+        if mu is None:
+            return pl.BlockSpec(
+                (4, 1, 1, Y, X, 3, 3, 2),
+                lambda t, z: (0, (t + dt) % T, (z + dz) % Z, 0, 0, 0, 0, 0))
+        return pl.BlockSpec(
+            (1, 1, 1, Y, X, 3, 3, 2),
+            lambda t, z, mu=mu: (mu, (t + dt) % T, (z + dz) % Z,
+                                 0, 0, 0, 0, 0))
+
+    out = pl.pallas_call(
+        _kernel,
+        grid=(T, Z),
+        in_specs=[
+            psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
+            psi_spec(0, +1), psi_spec(0, -1),
+            gauge_spec(0, 0),
+            gauge_spec(-1, 0, mu=3),   # U_t(t-1, z)
+            gauge_spec(0, -1, mu=2),   # U_z(t, z-1)
+        ],
+        out_specs=pl.BlockSpec((1, 1, Y, X, 4, 3, 2),
+                               lambda t, z: (t, z, 0, 0, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((T, Z, Y, X, 4, 3, 2), fdt),
+        interpret=interpret,
+    )(pp, pp, pp, pp, pp, gp, gp, gp)
+    return _unpairs(out)
+
+
+def tuned_dslash(gauge: jnp.ndarray, psi: jnp.ndarray):
+    """Autotuned Wilson hop: times the XLA and Pallas paths once per
+    (volume, dtype) and caches the winner (lib/tune.cpp tuneLaunch analog;
+    on CPU backends only the XLA path is eligible)."""
+    from ..ops import wilson as wops
+    from ..utils import tune
+
+    if jax.default_backend() != "tpu":
+        return wops.dslash_full(gauge, psi)
+    candidates = {
+        "xla": jax.jit(wops.dslash_full),
+        "pallas": jax.jit(lambda g, p: dslash_pallas(g, p)),
+    }
+    winner = tune.tune("wilson_dslash", tuple(psi.shape[:4]), candidates,
+                       (gauge, psi), aux=str(psi.dtype))
+    return candidates[winner](gauge, psi)
