@@ -1,0 +1,83 @@
+"""Multi-RHS solving: batched CG (vmap) and true block CG.
+
+Reference behavior: QUDA threads cvector_ref<ColorSpinorField> through
+every solver for multi-RHS batching (inv_msrc_cg_quda.cpp, the src_idx
+kernel dimension, QUDA_MAX_MULTI_RHS); the MG coarse-dslash MMA path
+batches RHS onto tensor cores.
+
+TPU-native: a leading RHS axis + vmap gives the batched solver (XLA turns
+the batched stencils into one larger kernel — the MXU sees nrhs x the
+work, exactly what the hardware wants), and true block CG shares one
+Krylov space across RHS with (nrhs x nrhs) Gram matrices solved on the
+fly — communication-optimal for small nrhs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import blas
+from .cg import SolverResult
+
+
+def batched_cg(matvec: Callable, B: jnp.ndarray, tol: float = 1e-10,
+               maxiter: int = 1000) -> SolverResult:
+    """vmapped CG over a leading RHS axis; iterates until ALL converge."""
+    from .cg import cg
+    return jax.vmap(lambda b: cg(matvec, b, tol=tol, maxiter=maxiter))(B)
+
+
+class BlockCGResult(NamedTuple):
+    x: jnp.ndarray
+    iters: jnp.ndarray
+    r2: jnp.ndarray          # (nrhs,)
+    converged: jnp.ndarray   # (nrhs,)
+
+
+def block_cg(matvec: Callable, B: jnp.ndarray, tol: float = 1e-10,
+             maxiter: int = 1000) -> BlockCGResult:
+    """Block CG (O'Leary): solve A X = B sharing one Krylov space.
+
+    B: (nrhs, ...).  Per iteration ONE batched matvec plus two small
+    (nrhs, nrhs) Gram solves; RHS with shared spectral content converge in
+    fewer iterations than independent CG.
+    """
+    n = B.shape[0]
+    b2 = jax.vmap(blas.norm2)(B)
+    stop = (tol ** 2) * b2
+    cdt = B.dtype
+
+    def gram(U, V):
+        return jnp.einsum("i...,j...->ij", jnp.conjugate(U), V)
+
+    X = jnp.zeros_like(B)
+    R = B
+    P = R
+
+    def cond(c):
+        return jnp.logical_and(jnp.any(c["r2"] > stop),
+                               c["k"] < maxiter)
+
+    def body(c):
+        X, R, P = c["X"], c["R"], c["P"]
+        AP = jax.vmap(matvec)(P)
+        pap = gram(P, AP)                       # (n, n)
+        rr = gram(R, R)
+        # alpha solves (P^H A P) alpha = P^H R
+        alpha = jnp.linalg.solve(pap, gram(P, R))
+        X = X + jnp.einsum("ij,i...->j...", alpha, P)
+        R = R - jnp.einsum("ij,i...->j...", alpha, AP)
+        rr_new = gram(R, R)
+        beta = jnp.linalg.solve(rr, rr_new)
+        P = R + jnp.einsum("ij,i...->j...", beta, P)
+        return dict(X=X, R=R, P=P,
+                    r2=jnp.real(jnp.einsum("...ii->...i", rr_new[None]))[0],
+                    k=c["k"] + 1)
+
+    state = dict(X=X, R=R, P=P, r2=b2, k=jnp.int32(0))
+    out = jax.lax.while_loop(cond, body, state)
+    return BlockCGResult(out["X"], out["k"], out["r2"],
+                         out["r2"] <= stop)
