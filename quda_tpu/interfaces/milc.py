@@ -1,0 +1,125 @@
+"""MILC-convention interface: the staggered/HISQ RHMC workflow entry points.
+
+Reference behavior: lib/milc_interface.cpp (3284 LoC) /
+include/quda_milc_interface.h — ~60 qudaXxx functions wrapping the C API
+with MILC's conventions (mass instead of kappa, MILC site ordering, fat/
+long link pairs, multi-shift rational fractions, fermion/gauge forces).
+
+This module is the Python-level equivalent driving interfaces/quda_api;
+MILC layout conventions match our canonical layout up to the phase
+convention (MILC staggered phases are folded by the operator layer).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import logging as qlog
+from . import quda_api as api
+from .params import GaugeParam, InvertParam
+
+
+def qudaInit(verbosity: str = "summarize"):
+    qlog.set_verbosity(verbosity)
+    api.init_quda()
+
+
+def qudaFinalize():
+    api.end_quda()
+
+
+def qudaLoadGauge(links, X, antiperiodic_t: bool = True, prec="double"):
+    api.load_gauge_quda(links, GaugeParam(
+        X=tuple(X), cuda_prec=prec,
+        t_boundary="antiperiodic" if antiperiodic_t else "periodic"))
+
+
+def qudaLoadKSLink(fat, long_links):
+    """Load precomputed fat/long links (MILC supplies its own fattening)."""
+    api.load_fat_long_quda(fat, long_links)
+
+
+def qudaComputeKSLink(naik_eps: float = 0.0):
+    """Fatten the resident thin links in-framework (computeKSLinkQuda)."""
+    return api.compute_ks_link_quda(naik_eps)
+
+
+def qudaInvert(mass: float, source, tol: float = 1e-10,
+               maxiter: int = 10000, improved: bool = True,
+               prec="double", sloppy_prec="single"):
+    """qudaInvert: staggered/HISQ CG solve; returns (solution, info)."""
+    p = InvertParam(
+        dslash_type="hisq" if improved else "staggered",
+        inv_type="cg", solve_type="normop-pc", mass=mass, tol=tol,
+        maxiter=maxiter, cuda_prec=prec, cuda_prec_sloppy=sloppy_prec)
+    x = api.invert_quda(source, p)
+    return x, {"true_res": p.true_res, "iters": p.iter_count,
+               "secs": p.secs}
+
+
+def qudaMultishiftInvert(mass: float, offsets: Sequence[float], source,
+                         tol: float = 1e-10, maxiter: int = 10000,
+                         improved: bool = True):
+    """qudaMultishiftInvert: the RHMC rational-fraction solve
+    ((4m^2 - D_eo D_oe) + offset_i) x_i = b."""
+    p = InvertParam(
+        dslash_type="hisq" if improved else "staggered",
+        inv_type="multi-shift-cg", solve_type="normop-pc", mass=mass,
+        tol=tol, maxiter=maxiter, num_offset=len(offsets),
+        offset=tuple(offsets))
+    return api.invert_multishift_quda(source, p)
+
+
+def qudaDslash(source, parity: int, mass: float = 0.0,
+               improved: bool = True):
+    p = InvertParam(dslash_type="hisq" if improved else "staggered",
+                    mass=mass, solve_type="normop-pc")
+    return api.dslash_quda(source, p, parity)
+
+
+def qudaPlaquette():
+    return api.plaq_quda()
+
+
+def qudaGaugeForce(beta: float, c1: float = 0.0):
+    return api.compute_gauge_force_quda(beta, c1)
+
+
+def qudaUpdateU(mom, dt: float):
+    api.update_gauge_field_quda(mom, dt)
+
+
+def qudaMomAction(mom) -> float:
+    return api.mom_action_quda(mom)
+
+
+def qudaHisqForce(mass: float, phi, n_cg_iters: int = 0,
+                  tol: float = 1e-10, maxiter: int = 4000):
+    """computeHISQForceQuda-class fermion force: d/dU of the HISQ
+    pseudofermion action, with jax.grad differentiating through the full
+    fattening chain (fat7 + reunitarisation + asqtad)."""
+    from ..fields.geometry import EVEN
+    from ..fields.spinor import even_odd_split
+    from ..gauge.fermion_force import pseudofermion_force
+    from ..gauge.hisq import hisq_fattening
+    from ..models.staggered import DiracStaggeredPC
+    from ..solvers.cg import cg
+
+    gauge = api._ctx["gauge"]
+    geom = api._ctx["geom"]
+
+    def make_op(u):
+        links = hisq_fattening(u)
+        return DiracStaggeredPC(links.fat, geom, mass, improved=True,
+                                long_links=links.long).M
+
+    phi_e = phi
+    x = cg(make_op(gauge), phi_e, tol=tol, maxiter=maxiter).x
+
+    def make_mdagm(u):
+        return make_op(u)  # staggered PC op is already the normal op
+
+    return pseudofermion_force(make_mdagm, gauge, x)

@@ -1,0 +1,77 @@
+"""eigCG, incremental eigCG, and GMRES-DR tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import LatticeGeometry
+from quda_tpu.fields.spinor import ColorSpinorField, even_odd_split
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.models.wilson import DiracWilsonPC
+from quda_tpu.ops import blas
+from quda_tpu.solvers.cg import cg
+from quda_tpu.solvers.eigcg import IncrementalEigCG, eigcg
+from quda_tpu.solvers.gmresdr import gmres_dr
+
+GEOM = LatticeGeometry((4, 4, 4, 8))
+KAPPA = 0.124
+
+
+@pytest.fixture(scope="module")
+def problem():
+    key = jax.random.PRNGKey(71)
+    gauge = GaugeField.random(key, GEOM).data
+    dpc = DiracWilsonPC(gauge, GEOM, KAPPA)
+    b = even_odd_split(ColorSpinorField.gaussian(
+        jax.random.PRNGKey(72), GEOM).data, GEOM)[0]
+    return dpc, b
+
+
+def test_eigcg_solves_and_harvests(problem):
+    dpc, b = problem
+    res = eigcg(dpc.MdagM, b, n_ev=4, m=20, tol=1e-10, maxiter=2000)
+    assert res.converged
+    rel = float(jnp.sqrt(blas.norm2(b - dpc.MdagM(res.x))
+                         / blas.norm2(b)))
+    assert rel < 5e-10
+    # harvested eigenvalues approximate the true lowest spectrum
+    from quda_tpu.eig.lanczos import EigParam, trlm
+    want = trlm(dpc.MdagM, b, EigParam(n_ev=4, n_kr=24, tol=1e-8,
+                                       max_restarts=100)).evals
+    # eigCG pairs are approximate; the lowest should be within a few %
+    assert abs(res.evals[0] - want[0]) / want[0] < 0.1
+
+
+def test_incremental_eigcg_accelerates(problem):
+    dpc, b = problem
+    inc = IncrementalEigCG(dpc.MdagM, n_ev=4, m=20, max_space=16)
+    key = jax.random.PRNGKey(73)
+    iters = []
+    for i in range(4):
+        rhs = even_odd_split(ColorSpinorField.gaussian(
+            jax.random.fold_in(key, i), GEOM).data, GEOM)[0]
+        res = inc.solve(rhs, tol=1e-10, maxiter=2000)
+        assert res.converged
+        iters.append(res.iters)
+    # later solves deflate with the accumulated space -> fewer iterations
+    assert iters[-1] < iters[0]
+
+
+def test_gmres_dr_converges(problem):
+    dpc, b = problem
+    res = gmres_dr(dpc.M, b, m=20, k=5, tol=1e-9, max_cycles=200)
+    rel = float(jnp.sqrt(blas.norm2(b - dpc.M(res.x)) / blas.norm2(b)))
+    assert rel < 5e-9
+    assert bool(res.converged)
+
+
+def test_gmres_dr_beats_plain_restarts(problem):
+    """Deflation must help vs undeflated restarted GCR at equal budget."""
+    dpc, b = problem
+    from quda_tpu.solvers.gcr import gcr
+    res_dr = gmres_dr(dpc.M, b, m=20, k=5, tol=1e-8, max_cycles=60)
+    res_plain = gcr(dpc.M, b, tol=1e-8, nkrylov=20, max_restarts=60)
+    assert bool(res_dr.converged)
+    if bool(res_plain.converged):
+        assert int(res_dr.iters) <= int(res_plain.iters) * 1.2
