@@ -1,0 +1,101 @@
+"""Staggered Kähler-Dirac block preconditioning ("level 0.5" of staggered
+multigrid).
+
+Reference behavior: lib/staggered_kd_build_xinv.cu (builds the inverse of
+the staggered operator's 2^4-hypercube block-diagonal part, a dense 48x48
+per block) and lib/staggered_kd_apply_xinv.cu (applies it), used by
+lib/dirac_staggered_kd.cpp as the right preconditioner that converts the
+staggered operator's spectrum from a circle through zero into something a
+Krylov method loves.
+
+TPU-native construction: the block-diagonal part of M is extracted by
+BLOCK-CHECKERBOARD probing — with only even(or odd)-parity 2^4 blocks lit,
+a block's output receives no contribution from its (opposite-parity)
+neighbours, so 48 dof x 2 block colors = 96 operator applications yield
+the exact dense blocks, batched-inverted with one jnp.linalg.inv.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields.geometry import LatticeGeometry
+
+BLOCK = (2, 2, 2, 2)
+BLOCK_DOF = 16 * 3  # 2^4 sites x 3 colors (staggered: nspin=1)
+
+
+def _to_blocks(psi: jnp.ndarray):
+    """(T,Z,Y,X,1,3) -> (Tb,Zb,Yb,Xb, 48)."""
+    T, Z, Y, X = psi.shape[:4]
+    r = psi.reshape(T // 2, 2, Z // 2, 2, Y // 2, 2, X // 2, 2, 3)
+    r = r.transpose(0, 2, 4, 6, 1, 3, 5, 7, 8)
+    return r.reshape(T // 2, Z // 2, Y // 2, X // 2, BLOCK_DOF)
+
+
+def _from_blocks(b: jnp.ndarray):
+    Tb, Zb, Yb, Xb = b.shape[:4]
+    r = b.reshape(Tb, Zb, Yb, Xb, 2, 2, 2, 2, 3)
+    r = r.transpose(0, 4, 1, 5, 2, 6, 3, 7, 8)
+    return r.reshape(Tb * 2, Zb * 2, Yb * 2, Xb * 2, 1, 3)
+
+
+def _block_parity(geom: LatticeGeometry):
+    Tb, Zb, Yb, Xb = (d // 2 for d in geom.lattice_shape)
+    t = np.arange(Tb)[:, None, None, None]
+    z = np.arange(Zb)[None, :, None, None]
+    y = np.arange(Yb)[None, None, :, None]
+    x = np.arange(Xb)[None, None, None, :]
+    return (t + z + y + x) % 2
+
+
+def build_kd_xinv(apply_m: Callable, geom: LatticeGeometry,
+                  dtype=jnp.complex128) -> jnp.ndarray:
+    """Dense inverse of the 2^4-block-diagonal part of apply_m.
+
+    apply_m: full-lattice staggered operator on (T,Z,Y,X,1,3) fields.
+    Returns (Tb,Zb,Yb,Xb, 48, 48).
+    """
+    for d in geom.lattice_shape:
+        if d % 4 != 0 and d != 2:
+            # block parity masking needs an even number of blocks per dim
+            # (or a single pair); d % 4 == 2 with d > 2 gives odd block
+            # counts, which breaks the checkerboard at the wrap
+            if (d // 2) % 2 != 0:
+                raise ValueError(
+                    f"extent {d}: need an even number of 2^4 blocks")
+    bpar = jnp.asarray(_block_parity(geom))
+    blatt = bpar.shape
+
+    mv = jax.jit(apply_m)
+    cols = []
+    for dof in range(BLOCK_DOF):
+        col = jnp.zeros(blatt + (BLOCK_DOF,), dtype)
+        for p in (0, 1):
+            probe_b = jnp.zeros(blatt + (BLOCK_DOF,), dtype)
+            probe_b = probe_b.at[..., dof].set(
+                (bpar == p).astype(dtype))
+            out = mv(_from_blocks(probe_b))
+            out_b = _to_blocks(out)
+            col = col + jnp.where((bpar == p)[..., None], out_b, 0)
+        cols.append(col)
+    x = jnp.stack(cols, axis=-1)          # (blatt, 48, 48)
+    return jnp.linalg.inv(x)
+
+
+def apply_kd_xinv(xinv: jnp.ndarray, psi: jnp.ndarray) -> jnp.ndarray:
+    """X^{-1} psi via one batched (48,48) matvec per block."""
+    b = _to_blocks(psi)
+    out = jnp.einsum("...ab,...b->...a", xinv, b)
+    return _from_blocks(out)
+
+
+def kd_preconditioner(apply_m: Callable, geom: LatticeGeometry,
+                      dtype=jnp.complex128) -> Callable:
+    """Right-preconditioner closure K(r) = X^{-1} r for GCR/PCG."""
+    xinv = build_kd_xinv(apply_m, geom, dtype)
+    return lambda r: apply_kd_xinv(xinv, r)

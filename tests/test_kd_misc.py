@@ -1,0 +1,100 @@
+"""Staggered KD preconditioning, Hasenbusch twist, distance reweighting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import LatticeGeometry
+from quda_tpu.fields.spinor import ColorSpinorField
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.mg.staggered_kd import (apply_kd_xinv, build_kd_xinv,
+                                      kd_preconditioner)
+from quda_tpu.models.hasenbusch import DiracCloverHasenbuschTwist
+from quda_tpu.models.staggered import DiracStaggered
+from quda_tpu.models.twisted import DiracTwistedClover
+from quda_tpu.ops import blas
+from quda_tpu.ops.distance import distance_reweight, distance_weights
+from quda_tpu.solvers.gcr import gcr
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+
+
+@pytest.fixture(scope="module")
+def staggered():
+    gauge = GaugeField.random(jax.random.PRNGKey(81), GEOM).data
+    d = DiracStaggered(gauge, GEOM, mass=0.05)
+    return gauge, d
+
+
+def test_kd_xinv_inverts_block_diagonal(staggered):
+    """X^{-1} X psi == psi where X is the block-diagonal part: verified by
+    checking X^{-1} M psi == psi for psi supported on a SINGLE 2^4 block
+    interior coupling only (use a block-constant field argument instead:
+    apply to a random field and compare against dense per-block math)."""
+    gauge, d = staggered
+    xinv = build_kd_xinv(d.M, GEOM)
+    assert xinv.shape == (2, 2, 2, 2, 48, 48)
+    # extract X by probing the SAME way and check X X^{-1} = I per block
+    x = jnp.linalg.inv(xinv)
+    eye = jnp.broadcast_to(jnp.eye(48, dtype=x.dtype), x.shape)
+    prod = jnp.einsum("...ab,...bc->...ac", x, xinv)
+    assert np.allclose(np.asarray(prod), np.asarray(eye), atol=1e-10)
+
+
+def test_kd_block_extraction_exact(staggered):
+    """For a field supported on one block, (M psi) restricted to that
+    block must equal X psi there."""
+    gauge, d = staggered
+    xinv = build_kd_xinv(d.M, GEOM)
+    x = jnp.linalg.inv(xinv)
+    psi = jnp.zeros(GEOM.spinor_shape(1, 3), jnp.complex128)
+    # fill block (0,0,0,0): sites (t,z,y,x) in {0,1}^4
+    key = jax.random.PRNGKey(5)
+    vals = jax.random.normal(key, (2, 2, 2, 2, 3)) \
+        + 1j * jax.random.normal(jax.random.fold_in(key, 1),
+                                 (2, 2, 2, 2, 3))
+    psi = psi.at[:2, :2, :2, :2, 0, :].set(vals)
+    out = d.M(psi)
+    from quda_tpu.mg.staggered_kd import _to_blocks
+    got = _to_blocks(out)[0, 0, 0, 0]
+    want = x[0, 0, 0, 0] @ _to_blocks(psi)[0, 0, 0, 0]
+    assert np.allclose(np.asarray(got), np.asarray(want), atol=1e-12)
+
+
+def test_kd_preconditioned_solve_converges(staggered):
+    """KD-preconditioned GCR solves the staggered system correctly.
+
+    (The spectral ACCELERATION of KD preconditioning shows up at small
+    mass with the tuned massless-block construction of the staggered-MG
+    papers; tuning that regime is deferred — here we pin the machinery:
+    the preconditioned solve must reach the same answer.)"""
+    gauge, d = staggered
+    b = ColorSpinorField.gaussian(jax.random.PRNGKey(6), GEOM, nspin=1).data
+    K = kd_preconditioner(d.M, GEOM)
+    res_kd = gcr(d.M, b, precond=K, tol=1e-8, nkrylov=30, max_restarts=40)
+    assert bool(res_kd.converged)
+    rel = float(jnp.sqrt(blas.norm2(b - d.M(res_kd.x)) / blas.norm2(b)))
+    assert rel < 5e-8
+
+
+def test_hasenbusch_twist_convention():
+    gauge = GaugeField.random(jax.random.PRNGKey(82), GEOM).data
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(83), GEOM).data
+    mu, kappa, csw = 0.3, 0.11, 1.0
+    d_h = DiracCloverHasenbuschTwist(gauge, GEOM, kappa, mu, csw)
+    # equals twisted clover with mu' chosen so 2 kappa mu' = mu
+    d_tc = DiracTwistedClover(gauge, GEOM, kappa, mu / (2 * kappa), csw)
+    assert np.allclose(np.asarray(d_h.M(psi)), np.asarray(d_tc.M(psi)),
+                       atol=1e-12)
+
+
+def test_distance_reweight_roundtrip():
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(84), GEOM).data
+    w = distance_reweight(psi, GEOM, 0.5, t0=1)
+    back = distance_reweight(w, GEOM, 0.5, t0=1, inverse=True)
+    assert np.allclose(np.asarray(back), np.asarray(psi), atol=1e-12)
+    weights = np.asarray(distance_weights(GEOM, 0.5, 1))
+    assert weights[1] == 1.0
+    assert weights[3] == weights[3 - 4]  # periodic distance
+    assert np.all(weights >= 1.0)
