@@ -1,0 +1,87 @@
+"""Schwarz domain-decomposition preconditioning: comm-free local solves.
+
+Reference behavior: QUDA's additive/multiplicative Schwarz preconditioner
+(QudaSchwarzType, the commDim overrides in DiracParam that disable halo
+exchange so each rank solves its local sub-volume with Dirichlet
+boundaries) — the "don't talk every step" lever for strong scaling
+(SURVEY.md §5.7).
+
+TPU-native: instead of comm-disabled ranks, a DOMAIN MASK zeroes every
+stencil contribution that crosses a domain boundary: `domain_shift`
+wraps ops.shift and multiplies by a precomputed face mask, turning any
+operator built on it into the block-Jacobi (additive Schwarz) local
+operator — identical math, no communicator surgery, works on 1 or N
+devices (domains usually = shards, but any block size works).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Callable, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields.geometry import LatticeGeometry, axis_of_mu
+from ..ops.shift import shift
+
+
+@lru_cache(maxsize=None)
+def _face_masks(geom: LatticeGeometry, domain: Tuple[int, int, int, int]):
+    """masks[(mu, sign)]: 1 where the neighbour at x + sign*mu lies in the
+    SAME domain, else 0.  numpy (T,Z,Y,X) float arrays."""
+    T, Z, Y, X = geom.lattice_shape
+    coords = np.meshgrid(np.arange(T), np.arange(Z), np.arange(Y),
+                         np.arange(X), indexing="ij")
+    # coords order (t,z,y,x); direction mu: 0=x..3=t -> array axis 3-mu
+    ext = {0: X, 1: Y, 2: Z, 3: T}
+    # domain passed as (dt,dz,dy,dx) block extents
+    dt, dz, dy, dx = domain
+    dom_ext = {0: dx, 1: dy, 2: dz, 3: dt}
+    masks = {}
+    for mu in range(4):
+        c = coords[axis_of_mu(mu)]
+        d = dom_ext[mu]
+        blk = c // d
+        blk_fwd = ((c + 1) % ext[mu]) // d
+        blk_bwd = ((c - 1) % ext[mu]) // d
+        masks[(mu, +1)] = (blk_fwd == blk).astype(np.float64)
+        masks[(mu, -1)] = (blk_bwd == blk).astype(np.float64)
+    return masks
+
+
+def make_domain_shift(geom: LatticeGeometry,
+                      domain: Tuple[int, int, int, int]) -> Callable:
+    """A shift_fn with Dirichlet (zero) conditions at domain boundaries.
+
+    domain: (dt, dz, dy, dx) block extents dividing the lattice.
+    """
+    for d, ext in zip(domain, geom.lattice_shape):
+        assert ext % d == 0, (domain, geom.lattice_shape)
+    masks = _face_masks(geom, tuple(domain))
+
+    def domain_shift(arr, mu, sign, nhop: int = 1):
+        out = shift(arr, mu, sign, nhop)
+        m = masks[(mu, +1 if sign > 0 else -1)]
+        if nhop != 1:
+            # n-hop: every intermediate face must stay inside
+            mm = m.copy()
+            for h in range(1, nhop):
+                mm = mm * np.roll(m, -sign * h, axis=axis_of_mu(mu))
+            m = mm
+        mask = jnp.asarray(m).reshape(m.shape + (1,) * (arr.ndim - 4))
+        return out * mask.astype(arr.dtype)
+
+    return domain_shift
+
+
+def additive_schwarz(matvec_local: Callable, n_iter: int = 4,
+                     omega: float = 0.8) -> Callable:
+    """K(r): a few MR iterations on the domain-local operator — the
+    additive-Schwarz smoother QUDA hosts inside GCR."""
+    from ..solvers.gcr import mr_fixed
+
+    def K(r):
+        return mr_fixed(matvec_local, r, n_iter, omega)
+
+    return K
