@@ -16,6 +16,7 @@ the honest precision to compare against single-precision GPU numbers.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 
@@ -28,12 +29,31 @@ def main():
     if os.environ.get("QUDA_TPU_BENCH_CPU"):
         jax.config.update("jax_platforms", "cpu")
 
-    try:
-        devs = jax.devices()
-        platform = devs[0].platform
-    except Exception:
-        jax.config.update("jax_platforms", "cpu")
-        devs = jax.devices()
+    # The axon TPU tunnel can wedge (device init hangs instead of failing).
+    # Probe device init in a watchdog thread; fall back to CPU rather than
+    # hang the whole benchmark run.
+    import threading
+
+    probe = {}
+
+    def _probe():
+        try:
+            devs = jax.devices()
+            probe["platform"] = devs[0].platform
+        except Exception as e:
+            probe["error"] = str(e)
+
+    th = threading.Thread(target=_probe, daemon=True)
+    th.start()
+    th.join(timeout=float(os.environ.get("QUDA_TPU_BENCH_PROBE_S", "120")))
+    if "platform" in probe:
+        platform = probe["platform"]
+    else:
+        # hung or failed: a hung backend cannot be recovered in-process;
+        # re-exec ourselves with the CPU override so the run completes
+        if not os.environ.get("QUDA_TPU_BENCH_CPU"):
+            os.environ["QUDA_TPU_BENCH_CPU"] = "1"
+            os.execv(sys.executable, [sys.executable] + sys.argv)
         platform = "cpu"
 
     from quda_tpu.fields.geometry import LatticeGeometry
