@@ -1,0 +1,134 @@
+"""MADWF-ML: Möbius-accelerated domain-wall fermions with machine-learned
+5th-dimension transfer.
+
+Reference behavior: lib/madwf_ml.cpp (338 LoC), lib/madwf_transfer.cu,
+lib/madwf_tensor.cu, include/madwf_ml.h — accelerate an expensive Möbius
+solve (large Ls) with an inner solve at small Ls_cheap, connected by a
+trainable 5th-dimension transfer T (per-chirality (Ls_cheap, Ls) complex
+matrices).  QUDA trains T with a hand-rolled device optimiser on null
+vectors; here the transfer is a pytree of parameters, the training
+objective is differentiated by jax.grad, and optax.adam does the update —
+the "ML" part of MADWF-ML collapses into 30 lines of standard JAX.
+
+Preconditioner form (QUDA's use inside PCG on the PC operator M):
+    K(r) = T^dag  Minv_cheap  T r
+where Minv_cheap is a loose solve with a small-Ls Möbius PC operator.
+Training minimises ||r - M K(r)||^2 / ||r||^2 over random vectors.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields.geometry import LatticeGeometry
+from ..ops import blas
+from .domain_wall import DiracMobiusPC
+
+
+class MadwfTransfer(NamedTuple):
+    """Trainable per-chirality 5th-dim transfer: (Ls_cheap, Ls) each."""
+    tp: jnp.ndarray
+    tm: jnp.ndarray
+
+
+def init_transfer(ls_cheap: int, ls: int, key, dtype=jnp.complex128,
+                  scale: float = 0.1) -> MadwfTransfer:
+    k1, k2 = jax.random.split(key)
+    rdt = jnp.zeros((), dtype).real.dtype
+
+    def rnd(k):
+        a = jax.random.normal(k, (ls_cheap, ls), rdt)
+        b = jax.random.normal(jax.random.fold_in(k, 1), (ls_cheap, ls), rdt)
+        return scale * (a + 1j * b).astype(dtype)
+
+    # seed with a truncation-like pattern (identity on the first slices)
+    eye = jnp.zeros((ls_cheap, ls), dtype).at[:, :ls_cheap].set(
+        jnp.eye(ls_cheap, dtype=dtype))
+    return MadwfTransfer(eye + rnd(k1), eye + rnd(k2))
+
+
+def apply_transfer(t: MadwfTransfer, psi: jnp.ndarray,
+                   dagger: bool = False) -> jnp.ndarray:
+    """psi: (Ls[, ...], 4, 3) -> (Ls_cheap, ...) (or adjoint)."""
+    tp, tm = t.tp, t.tm
+    if dagger:
+        tp = jnp.conjugate(tp).T
+        tm = jnp.conjugate(tm).T
+    up = jnp.einsum("st,t...->s...", tp, psi[..., :2, :])
+    dn = jnp.einsum("st,t...->s...", tm, psi[..., 2:, :])
+    return jnp.concatenate([up, dn], axis=-2)
+
+
+def make_madwf_preconditioner(t: MadwfTransfer, cheap_op: DiracMobiusPC,
+                              inner_iters: int = 8) -> Callable:
+    """K(r) = T^dag (MdagM_cheap)^{-1}-ish T r with a fixed-iteration
+    inner CG (jit-pure, usable inside flexible solvers)."""
+    from ..solvers.cg import cg_fixed_iters
+
+    def K(r):
+        rc = apply_transfer(t, r)
+        rhs = cheap_op.Mdag(rc)
+        yc = cg_fixed_iters(lambda v: cheap_op.Mdag(cheap_op.M(v)),
+                            rhs, None, inner_iters)[0].x
+        return apply_transfer(t, yc, dagger=True)
+
+    return K
+
+
+def train_transfer(t: MadwfTransfer, fine_op: DiracMobiusPC,
+                   cheap_op: DiracMobiusPC, example_shape, dtype,
+                   key, n_vec: int = 4, n_steps: int = 200,
+                   lr: float = 1e-3, inner_iters: int = 6):
+    """Minimise the preconditioned residual mismatch over random vectors
+    (the madwf_ml.cpp training loop, as optax.adam over jax.grad)."""
+    import optax
+
+    rdt = jnp.zeros((), dtype).real.dtype
+    vecs = []
+    for i in range(n_vec):
+        k = jax.random.fold_in(key, i)
+        v = (jax.random.normal(k, example_shape, rdt)
+             + 1j * jax.random.normal(jax.random.fold_in(k, 1),
+                                      example_shape, rdt)).astype(dtype)
+        vecs.append(v / jnp.sqrt(blas.norm2(v)).astype(dtype))
+    V = jnp.stack(vecs)
+
+    from ..solvers.cg import cg_fixed_iters
+
+    def loss_fn(params):
+        def K(r):
+            rc = apply_transfer(params, r)
+            rhs = cheap_op.Mdag(rc)
+            yc = cg_fixed_iters(
+                lambda u: cheap_op.Mdag(cheap_op.M(u)), rhs, None,
+                inner_iters)[0].x
+            return apply_transfer(params, yc, dagger=True)
+
+        def one(v):
+            res = v - fine_op.M(K(v))
+            return blas.norm2(res) / blas.norm2(v)
+
+        return jnp.mean(jax.vmap(one)(V))
+
+    opt = optax.adam(lr)
+    state = opt.init(t)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # optax expects real pytrees or handles complex? conjugate for
+        # proper descent on complex parameters
+        grads = jax.tree.map(jnp.conjugate, grads)
+        updates, state = opt.update(grads, state)
+        params = optax.apply_updates(params, updates)
+        return params, state, loss
+
+    losses = []
+    for _ in range(n_steps):
+        t, state, loss = step(t, state)
+        losses.append(float(loss))
+    return t, losses
