@@ -1,0 +1,85 @@
+"""Block TRLM (degenerate spectra) and MSPCG tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse.linalg as ssl
+
+from quda_tpu.fields.geometry import LatticeGeometry
+from quda_tpu.fields.spinor import ColorSpinorField, even_odd_split
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.eig.block_lanczos import block_trlm
+from quda_tpu.eig.lanczos import EigParam
+from quda_tpu.models.staggered import DiracStaggeredPC
+from quda_tpu.models.wilson import DiracWilsonPC
+from quda_tpu.ops import blas
+from quda_tpu.solvers.cg import cg
+from quda_tpu.solvers.mspcg import make_local_mdagm, mspcg
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+
+
+@pytest.fixture(scope="module")
+def stag():
+    """Staggered PC normal op: spectrum rich in (near-)degenerate pairs."""
+    gauge = GaugeField.random(jax.random.PRNGKey(95), GEOM).data
+    d = DiracStaggeredPC(gauge, GEOM, mass=0.1)
+    example = even_odd_split(
+        ColorSpinorField.zeros(GEOM, nspin=1).data, GEOM)[0]
+    return d, example
+
+
+def test_block_trlm_vs_arpack(stag):
+    d, example = stag
+    shape = example.shape
+    dim = int(np.prod(shape))
+    mv = jax.jit(d.M)
+    linop = ssl.LinearOperator(
+        (dim, dim),
+        matvec=lambda a: np.asarray(mv(jnp.asarray(
+            a.astype(np.complex128).reshape(shape)))).reshape(dim),
+        dtype=np.complex128)
+    k = 6
+    want = np.sort(ssl.eigsh(linop, k=k, which="SA",
+                             return_eigenvectors=False))
+    param = EigParam(n_ev=k, n_kr=32, tol=1e-7, max_restarts=200)
+    res = block_trlm(d.M, example, param, block_size=2)
+    assert res.converged
+    assert np.allclose(res.evals[:k], want, rtol=1e-5), (res.evals, want)
+    assert np.all(res.residua < 1e-5)
+
+
+def test_mspcg_converges_with_fewer_outer_iterations():
+    gauge = GaugeField.random(jax.random.PRNGKey(96), GEOM).data
+    dpc = DiracWilsonPC(gauge, GEOM, 0.124)
+    b = even_odd_split(ColorSpinorField.gaussian(
+        jax.random.PRNGKey(97), GEOM).data, GEOM)[0]
+
+    # local MdagM: rebuild the PC operator over the domain-local shift
+    from quda_tpu.ops import wilson as wops
+    from quda_tpu.models.dirac import apply_gamma5
+    from quda_tpu.ops.boundary import apply_t_boundary
+
+    g_bc = apply_t_boundary(gauge, GEOM, -1)
+
+    def build(shift_fn):
+        mv = lambda v: wops.matvec_full(g_bc, v, 0.124, shift_fn=shift_fn)
+        mdag = lambda v: apply_gamma5(mv(apply_gamma5(v)))
+        return lambda v: mdag(mv(v))
+
+    # full-lattice (2,2,2,2)-domain local operator on FULL fields; for the
+    # test apply MSPCG to the full normal system
+    from quda_tpu.ops.shift import shift as global_shift
+    mdagm = build(global_shift)
+    mdagm_local = make_local_mdagm(GEOM, (2, 2, 2, 2), build)
+
+    b_full = ColorSpinorField.gaussian(jax.random.PRNGKey(98), GEOM).data
+    res = mspcg(mdagm, mdagm_local, b_full, tol=1e-9, maxiter=2000,
+                inner_iters=4)
+    assert bool(res.converged)
+    rel = float(jnp.sqrt(blas.norm2(b_full - mdagm(res.x))
+                         / blas.norm2(b_full)))
+    assert rel < 5e-9
+    plain = cg(mdagm, b_full, tol=1e-9, maxiter=2000)
+    assert int(res.iters) < int(plain.iters)
