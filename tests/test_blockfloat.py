@@ -1,0 +1,81 @@
+"""bf16 / int8 block-float storage codec tests, incl. use as the sloppy
+format inside reliable-update CG (the half-precision-solver pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import LatticeGeometry
+from quda_tpu.fields.spinor import ColorSpinorField, even_odd_split
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.models.wilson import DiracWilsonPC
+from quda_tpu.ops import blas
+from quda_tpu.ops.blockfloat import (from_bf16, from_int8, to_bf16, to_int8)
+from quda_tpu.solvers.mixed import solve_refined
+from quda_tpu.solvers.cg import cg
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+
+
+def test_bf16_roundtrip_accuracy():
+    x = ColorSpinorField.gaussian(jax.random.PRNGKey(1), GEOM,
+                                  dtype=jnp.complex64).data
+    back = from_bf16(to_bf16(x))
+    rel = float(jnp.sqrt(blas.norm2(x - back) / blas.norm2(x)))
+    assert rel < 1e-2          # bf16: ~8 mantissa bits
+    assert to_bf16(x).data.dtype == jnp.bfloat16
+
+
+def test_int8_roundtrip_accuracy():
+    x = ColorSpinorField.gaussian(jax.random.PRNGKey(2), GEOM,
+                                  dtype=jnp.complex64).data
+    f = to_int8(x)
+    assert f.data.dtype == jnp.int8
+    back = from_int8(f)
+    rel = float(jnp.sqrt(blas.norm2(x - back) / blas.norm2(x)))
+    assert rel < 2e-2          # 7-bit mantissa + per-site scale
+
+
+def test_int8_scale_is_per_site():
+    x = ColorSpinorField.gaussian(jax.random.PRNGKey(3), GEOM,
+                                  dtype=jnp.complex64).data
+    # make one site huge: other sites must keep full relative accuracy
+    x = x.at[0, 0, 0, 0].multiply(1e4)
+    f = to_int8(x)
+    back = from_int8(f)
+    other = x[1:, :, :, :]
+    rel = float(jnp.sqrt(blas.norm2(other - back[1:])
+                         / blas.norm2(other)))
+    assert rel < 2e-2
+
+
+def test_gauge_int8_roundtrip():
+    g = GaugeField.random(jax.random.PRNGKey(4), GEOM,
+                          dtype=jnp.complex64).data
+    back = from_int8(to_int8(g))
+    rel = float(jnp.sqrt(blas.norm2(g - back) / blas.norm2(g)))
+    assert rel < 2e-2
+
+
+def test_bf16_sloppy_refinement_reaches_double():
+    """Iterative refinement whose inner solve runs on a bf16-compressed
+    gauge field still reaches 1e-10 — the QUDA half-precision-sloppy
+    solver pattern with the TPU codec."""
+    key = jax.random.PRNGKey(5)
+    gauge = GaugeField.random(key, GEOM).data
+    dpc = DiracWilsonPC(gauge, GEOM, 0.12)
+    b = even_odd_split(ColorSpinorField.gaussian(
+        jax.random.fold_in(key, 1), GEOM).data, GEOM)[0]
+    rhs = dpc.Mdag(dpc.prepare(b, jnp.zeros_like(b)))
+
+    g_lo = from_bf16(to_bf16(gauge.astype(jnp.complex64)))
+    dpc_lo = DiracWilsonPC(g_lo, GEOM, 0.12)
+    inner = jax.jit(lambda r: cg(dpc_lo.MdagM, r, tol=1e-3,
+                                 maxiter=200).x.astype(jnp.complex64))
+    res = solve_refined(dpc.MdagM, inner, rhs, jnp.complex64, tol=1e-10,
+                        max_cycles=40)
+    assert bool(res.converged)
+    rel = float(jnp.sqrt(blas.norm2(rhs - dpc.MdagM(res.x))
+                         / blas.norm2(rhs)))
+    assert rel < 2e-10
