@@ -100,13 +100,16 @@ def qudaHisqForce(mass: float, phi, n_cg_iters: int = 0,
                   tol: float = 1e-10, maxiter: int = 4000):
     """computeHISQForceQuda-class fermion force: d/dU of the HISQ
     pseudofermion action, with jax.grad differentiating through the full
-    fattening chain (fat7 + reunitarisation + asqtad)."""
-    from ..fields.geometry import EVEN
-    from ..fields.spinor import even_odd_split
+    fattening chain (fat7 + reunitarisation + asqtad).
+
+    n_cg_iters > 0 runs a truncated fixed-iteration force solve (the
+    cheap inner-force evaluations MILC's integrators request); otherwise
+    the solve converges to `tol`.
+    """
     from ..gauge.fermion_force import pseudofermion_force
     from ..gauge.hisq import hisq_fattening
     from ..models.staggered import DiracStaggeredPC
-    from ..solvers.cg import cg
+    from ..solvers.cg import cg, cg_fixed_iters
 
     gauge = api._ctx["gauge"]
     geom = api._ctx["geom"]
@@ -116,10 +119,11 @@ def qudaHisqForce(mass: float, phi, n_cg_iters: int = 0,
         return DiracStaggeredPC(links.fat, geom, mass, improved=True,
                                 long_links=links.long).M
 
-    phi_e = phi
-    x = cg(make_op(gauge), phi_e, tol=tol, maxiter=maxiter).x
+    op = make_op(gauge)
+    if n_cg_iters > 0:
+        x = cg_fixed_iters(op, phi, None, n_cg_iters)[0].x
+    else:
+        x = cg(op, phi, tol=tol, maxiter=maxiter).x
 
-    def make_mdagm(u):
-        return make_op(u)  # staggered PC op is already the normal op
-
-    return pseudofermion_force(make_mdagm, gauge, x)
+    # the staggered PC operator is already the normal operator
+    return pseudofermion_force(make_op, gauge, x)

@@ -370,13 +370,20 @@ def eigensolve_quda(eig_param: EigParamAPI, invert_param: InvertParam):
     shape = (geom.half_lattice_shape if pc else geom.lattice_shape) + (4, 3)
     if invert_param.dslash_type in ("staggered", "asqtad", "hisq"):
         shape = shape[:-2] + (1, 3)
+    if invert_param.dslash_type in ("domain-wall", "domain-wall-4d",
+                                    "mobius"):
+        shape = (invert_param.Ls,) + shape
     example = jnp.zeros(shape, dtype)
     p = EigParam(n_ev=eig_param.n_ev, n_kr=eig_param.n_kr,
                  tol=eig_param.tol, max_restarts=eig_param.max_restarts,
                  use_poly_acc=eig_param.use_poly_acc,
                  poly_deg=eig_param.poly_deg, a_min=eig_param.a_min,
                  a_max=eig_param.a_max, spectrum=eig_param.spectrum)
-    op = d.MdagM if eig_param.use_norm_op else d.M
+    if eig_param.use_norm_op:
+        # staggered PC: M already IS the (Hermitian) normal operator
+        op = d.M if getattr(d, "hermitian", False) else d.MdagM
+    else:
+        op = d.M
     if eig_param.eig_type == "trlm":
         res = trlm(op, example, p)
     else:

@@ -60,10 +60,14 @@ def from_int8(f: Int8Field, dtype=jnp.complex64) -> jnp.ndarray:
     return (d[..., 0] + 1j * d[..., 1]).astype(dtype)
 
 
-def compression_ratio(x: jnp.ndarray, codec: str) -> float:
-    """Bytes(original complex) / bytes(compressed)."""
+def compression_ratio(x: jnp.ndarray, codec: str,
+                      dof_per_site: int = 12) -> float:
+    """Bytes(original complex) / bytes(compressed), including the per-site
+    float32 scale for the int8 codec (dof_per_site complex numbers share
+    one scale: 12 for fermions, 9 per link for gauge)."""
+    orig = x.dtype.itemsize * dof_per_site
     if codec == "bf16":
-        return x.dtype.itemsize / (2 * 2)
+        return orig / (2 * 2 * dof_per_site)
     if codec == "int8":
-        return x.dtype.itemsize / (2 * 1 + 1e-9)  # scale amortised
+        return orig / (2 * 1 * dof_per_site + 4)
     raise ValueError(codec)

@@ -21,6 +21,7 @@ via basis="chebyshev" with (lambda_min, lambda_max) estimates.
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -50,13 +51,8 @@ def _build_basis(matvec, v, s, basis, lam):
     return jnp.stack(vs)
 
 
-def ca_gcr(matvec: Callable, b: jnp.ndarray, s: int = 8,
-           x0: Optional[jnp.ndarray] = None, tol: float = 1e-10,
-           max_cycles: int = 100, basis: str = "power",
-           lam: Tuple[float, float] = (0.0, 2.0)) -> SolverResult:
-    b2 = blas.norm2(b)
-    stop = float((tol ** 2) * b2)
-
+@lru_cache(maxsize=64)
+def _ca_gcr_cycle(matvec, s, basis, lam):
     @jax.jit
     def cycle(x, r):
         V = _build_basis(matvec, r, s, basis, lam)
@@ -68,6 +64,42 @@ def ca_gcr(matvec: Callable, b: jnp.ndarray, s: int = 8,
         x = x + jnp.einsum("i,i...->...", c, V)
         r = r - jnp.einsum("i,i...->...", c, AV)
         return x, r, blas.norm2(r)
+
+    return cycle
+
+
+@lru_cache(maxsize=64)
+def _ca_cg_cycle(matvec, s, basis, lam):
+    @jax.jit
+    def cycle(x, r, p_prev, have_prev):
+        V = _build_basis(matvec, r, s, basis, lam)
+        V = jnp.concatenate([V, p_prev[None]], axis=0)      # (s+1, ...)
+        AV = jax.vmap(matvec)(V)
+        G = jnp.einsum("i...,j...->ij", jnp.conjugate(V), AV)
+        rhs = jnp.einsum("i...,...->i", jnp.conjugate(V), r)
+        n = s + 1
+        mask = jnp.concatenate([jnp.ones(s), have_prev[None]])
+        Gm = G * mask[:, None] * mask[None, :] \
+            + jnp.diag(1.0 - mask).astype(G.dtype)
+        cvec = jnp.linalg.solve(Gm, rhs * mask.astype(rhs.dtype))
+        step = jnp.einsum("i,i...->...", cvec, V)
+        x = x + step
+        r = r - jnp.einsum("i,i...->...", cvec, AV)
+        return x, r, blas.norm2(r), step
+
+    return cycle
+
+
+def ca_gcr(matvec: Callable, b: jnp.ndarray, s: int = 8,
+           x0: Optional[jnp.ndarray] = None, tol: float = 1e-10,
+           max_cycles: int = 100, basis: str = "power",
+           lam: Tuple[float, float] = (0.0, 2.0)) -> SolverResult:
+    b2 = blas.norm2(b)
+    stop = float((tol ** 2) * b2)
+    try:
+        cycle = _ca_gcr_cycle(matvec, s, basis, tuple(lam))
+    except TypeError:  # unhashable matvec: per-call jit fallback
+        cycle = _ca_gcr_cycle.__wrapped__(matvec, s, basis, tuple(lam))
 
     x = jnp.zeros_like(b) if x0 is None else x0
     r = b if x0 is None else b - matvec(x)
@@ -89,24 +121,10 @@ def ca_cg(matvec: Callable, b: jnp.ndarray, s: int = 8,
     over the s-Krylov basis augmented with the previous step direction."""
     b2 = blas.norm2(b)
     stop = float((tol ** 2) * b2)
-
-    @jax.jit
-    def cycle(x, r, p_prev, have_prev):
-        V = _build_basis(matvec, r, s, basis, lam)
-        V = jnp.concatenate([V, p_prev[None]], axis=0)      # (s+1, ...)
-        AV = jax.vmap(matvec)(V)
-        G = jnp.einsum("i...,j...->ij", jnp.conjugate(V), AV)   # <v_i, A v_j>
-        rhs = jnp.einsum("i...,...->i", jnp.conjugate(V), r)
-        # mask the augmentation direction on the first cycle
-        n = s + 1
-        mask = jnp.concatenate([jnp.ones(s), have_prev[None]])
-        Gm = G * mask[:, None] * mask[None, :] \
-            + jnp.diag(1.0 - mask).astype(G.dtype)
-        cvec = jnp.linalg.solve(Gm, rhs * mask.astype(rhs.dtype))
-        step = jnp.einsum("i,i...->...", cvec, V)
-        x = x + step
-        r = r - jnp.einsum("i,i...->...", cvec, AV)
-        return x, r, blas.norm2(r), step
+    try:
+        cycle = _ca_cg_cycle(matvec, s, basis, tuple(lam))
+    except TypeError:
+        cycle = _ca_cg_cycle.__wrapped__(matvec, s, basis, tuple(lam))
 
     x = jnp.zeros_like(b) if x0 is None else x0
     r = b if x0 is None else b - matvec(x)

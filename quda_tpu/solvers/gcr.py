@@ -13,6 +13,7 @@ QUDA also re-orthogonalises on the host side).
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Callable, Optional
 
 import jax
@@ -22,32 +23,51 @@ from ..ops import blas
 from .cg import SolverResult
 
 
-def gcr(matvec: Callable, b: jnp.ndarray, precond: Optional[Callable] = None,
-        x0: Optional[jnp.ndarray] = None, tol: float = 1e-10,
-        nkrylov: int = 10, max_restarts: int = 50) -> SolverResult:
-    b2 = blas.norm2(b)
-    stop = float((tol ** 2) * b2)
-    K = (lambda v: v) if precond is None else precond
+def _identity(v):
+    return v
+
+
+@lru_cache(maxsize=64)
+def _gcr_cycle(matvec, K, nkrylov: int, dtype_name: str):
+    """Cached jitted GCR cycle — keyed on the (hashable) operator
+    callables so repeated solves (HMC, resident MG) reuse the compiled
+    unrolled cycle instead of re-tracing every call."""
 
     @jax.jit
     def cycle(x, r):
         ps, aps, ap2s = [], [], []
+        dt = x.dtype
         for _ in range(nkrylov):
             z = K(r)
             az = matvec(z)
             # modified Gram-Schmidt of az against previous Ap's
             for p_i, ap_i, ap2_i in zip(ps, aps, ap2s):
-                c = blas.cdot(ap_i, az) / ap2_i.astype(b.dtype)
+                c = blas.cdot(ap_i, az) / ap2_i.astype(dt)
                 az = az - c * ap_i
                 z = z - c * p_i
             ap2 = blas.norm2(az)
             ps.append(z)
             aps.append(az)
             ap2s.append(ap2)
-            alpha = blas.cdot(az, r) / ap2.astype(b.dtype)
+            alpha = blas.cdot(az, r) / ap2.astype(dt)
             x = x + alpha * z
             r = r - alpha * az
         return x, r, blas.norm2(r)
+
+    return cycle
+
+
+def gcr(matvec: Callable, b: jnp.ndarray, precond: Optional[Callable] = None,
+        x0: Optional[jnp.ndarray] = None, tol: float = 1e-10,
+        nkrylov: int = 10, max_restarts: int = 50) -> SolverResult:
+    b2 = blas.norm2(b)
+    stop = float((tol ** 2) * b2)
+    K = _identity if precond is None else precond
+    try:
+        cycle = _gcr_cycle(matvec, K, nkrylov, str(b.dtype))
+    except TypeError:  # unhashable callables: fall back to per-call jit
+        _gcr_cycle.cache_clear()
+        cycle = _gcr_cycle.__wrapped__(matvec, K, nkrylov, str(b.dtype))
 
     x = jnp.zeros_like(b) if x0 is None else x0
     r = b if x0 is None else b - matvec(x)

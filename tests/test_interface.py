@@ -120,6 +120,32 @@ def test_eigensolve_api():
     assert np.all(np.asarray(evals).real > 0)  # MdagM spectrum
 
 
+def test_eigensolve_staggered_not_squared():
+    """Staggered PC eigensolve must return eigenvalues of the normal
+    operator itself (>= 4m^2), not of its square (regression: the PC op
+    already IS MdagM)."""
+    p = InvertParam(dslash_type="staggered", mass=0.1,
+                    solve_type="normop-pc")
+    ep = EigParamAPI(n_ev=4, n_kr=24, tol=1e-6, max_restarts=200)
+    evals, _ = api.eigensolve_quda(ep, p)
+    evals = np.asarray(evals).real
+    assert np.all(evals >= 4 * 0.1 ** 2 - 1e-8)
+    # eigenvalues of the SQUARED operator would be >= (4m^2)^2 and the
+    # smallest here must sit well below 1 (spectral edge of MdagM)
+    assert evals[0] < 2.0
+
+
+def test_eigensolve_domain_wall_shape():
+    """DWF eigensolve must build the (Ls, ...) probe vector (regression:
+    the s-operator used to contract against the time axis)."""
+    p = InvertParam(dslash_type="mobius", Ls=4, mass=0.04, m5=-1.4,
+                    b5=1.5, c5=0.5, solve_type="normop-pc")
+    ep = EigParamAPI(n_ev=2, n_kr=12, tol=1e-4, max_restarts=100)
+    evals, evecs = api.eigensolve_quda(ep, p)
+    assert evecs.shape[1] == 4  # leading Ls axis present
+    assert np.all(np.asarray(evals).real > 0)
+
+
 def test_gauge_utilities():
     m, s, t = api.plaq_quda()
     assert 0 < m < 1
