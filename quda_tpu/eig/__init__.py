@@ -1,0 +1,6 @@
+"""Eigensolvers: TRLM (+Chebyshev), block TRLM, restarted Arnoldi, deflation."""
+
+from .lanczos import EigParam, EigResult, chebyshev_op, trlm  # noqa: F401
+from .block_lanczos import block_trlm  # noqa: F401
+from .iram import iram  # noqa: F401
+from .deflation import DeflationSpace, deflated_guess, deflated_solve  # noqa: F401

@@ -1,0 +1,8 @@
+"""Gauge sector: actions/forces/HMC, smearing, flow, heatbath, fixing,
+HISQ fattening, observables, quark smearing."""
+
+from .action import (gauge_force, hmc_trajectory, improved_action,  # noqa: F401
+                     leapfrog, mom_action, omf2, random_momentum,
+                     update_gauge, wilson_action)
+from .observables import energy, plaquette, polyakov_loop, qcharge  # noqa: F401
+from .fermion_force import pseudofermion_force, rational_force  # noqa: F401

@@ -62,6 +62,12 @@ def load_gauge_quda(gauge, param: GaugeParam):
     g = jnp.asarray(gauge, dtype)
     if g.shape != (4,) + geom.lattice_shape + (3, 3):
         qlog.errorq(f"gauge shape {g.shape} != expected for {param.X}")
+    if param.anisotropy != 1.0:
+        # QUDA folds the Wilson anisotropy into the links at load time:
+        # spatial links are divided by xi (GaugeFieldParam anisotropy)
+        scale = jnp.ones((4, 1, 1, 1, 1, 1, 1), g.real.dtype)
+        scale = scale.at[:3].set(1.0 / param.anisotropy)
+        g = g * scale.astype(dtype)
     _ctx["geom"] = geom
     _ctx["gauge"] = g
     _ctx["gauge_param"] = param

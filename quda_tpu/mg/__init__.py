@@ -1,0 +1,5 @@
+"""Adaptive multigrid: transfer, Galerkin coarse ops, V-cycles, KD blocks."""
+
+from .transfer import Transfer, from_chiral, to_chiral  # noqa: F401
+from .coarse import CoarseOperator, build_coarse  # noqa: F401
+from .mg import MG, MGLevelParam, mg_solve  # noqa: F401

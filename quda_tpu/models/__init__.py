@@ -1,0 +1,13 @@
+"""Dirac operator families (the Dirac::create zoo)."""
+
+from .dirac import Dirac, DiracPC, apply_gamma5  # noqa: F401
+from .wilson import DiracWilson, DiracWilsonPC  # noqa: F401
+from .clover import DiracClover, DiracCloverPC  # noqa: F401
+from .twisted import (DiracNdegTwistedMass, DiracTwistedClover,  # noqa: F401
+                      DiracTwistedCloverPC, DiracTwistedMass,
+                      DiracTwistedMassPC)
+from .hasenbusch import (DiracCloverHasenbuschTwist,  # noqa: F401
+                         DiracCloverHasenbuschTwistPC)
+from .staggered import DiracStaggered, DiracStaggeredPC  # noqa: F401
+from .domain_wall import (DiracDomainWall, DiracMobius,  # noqa: F401
+                          DiracMobiusPC)

@@ -1,0 +1,8 @@
+"""Device mesh, sharding, halo exchange, split grid, Schwarz DD."""
+
+from .mesh import (AXES, SRC_AXIS, factor_devices, gauge_pspec,  # noqa: F401
+                   make_lattice_mesh, shard_gauge, shard_spinor,
+                   spinor_pspec)
+from .halo import make_sharded_shift, psum_scalar  # noqa: F401
+from .split import split_grid_solve  # noqa: F401
+from .schwarz import additive_schwarz, make_domain_shift  # noqa: F401

@@ -169,6 +169,18 @@ def test_smear_flow_fix_roundtrip():
     assert theta < 1e-7
 
 
+def test_anisotropy_folds_into_spatial_links():
+    """GaugeParam.anisotropy divides spatial links at load (QUDA
+    convention); temporal links untouched."""
+    gauge = GaugeField.random(jax.random.PRNGKey(55), GEOM).data
+    api.load_gauge_quda(gauge, GaugeParam(X=(6, 6, 6, 6), anisotropy=2.0))
+    got = api._ctx["gauge"]
+    assert np.allclose(np.asarray(got[0]), np.asarray(gauge[0]) / 2.0)
+    assert np.allclose(np.asarray(got[3]), np.asarray(gauge[3]))
+    # restore the module fixture's resident gauge for any later test
+    api.load_gauge_quda(np.asarray(gauge), GaugeParam(X=(6, 6, 6, 6)))
+
+
 def test_param_validation():
     with pytest.raises(QudaError):
         InvertParam(dslash_type="nope").validate()
