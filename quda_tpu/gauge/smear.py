@@ -210,3 +210,54 @@ def wilson_flow(gauge: jnp.ndarray, eps: float, n_steps: int,
         if measure is not None:
             history.append(measure(gauge, (i + 1) * eps))
     return gauge, history
+
+
+def fermion_flow_step(gauge: jnp.ndarray, phi: jnp.ndarray, eps: float,
+                      action_fn: Callable = None):
+    """One Luscher RK3 step of the JOINT gauge + fermion gradient flow
+    (performGFlowQuda, quda.h:1695): the fermion field flows with the
+    4-d covariant Laplacian of the flowing gauge field,
+
+        d phi / dt = Delta(V(t)) phi,
+
+    integrated with the third-order scheme matched to the gauge RK3
+    stages (Luscher, arXiv:1302.5246 appendix; QUDA's gflow kernels):
+        phi1 = phi0 + (eps/4) D0 phi0
+        phi2 = phi0 + (8 eps/9) D1 phi1 - (2 eps/9) D0 phi0
+        phi3 = phi1 + (3 eps/4) D2 phi2
+    with D_i the Laplacian on the i-th gauge flow stage W_i.
+
+    Returns (flowed gauge, flowed fermion).
+    """
+    from ..ops.laplace import laplace
+
+    act = action_fn or (lambda u: wilson_action(u, 6.0))
+
+    def lap(w, p):
+        return -laplace(w, p, ndim=4, mass=0.0)  # laplace returns -Delta
+
+    w0 = gauge
+    d0 = lap(w0, phi)
+    phi1 = phi + (eps / 4.0) * d0
+    z0 = eps * _flow_z(w0, act)
+    w1 = mat_mul(expm_su3(0.25 * z0), w0)
+
+    d1 = lap(w1, phi1)
+    phi2 = phi + (8.0 * eps / 9.0) * d1 - (2.0 * eps / 9.0) * d0
+    z1 = eps * _flow_z(w1, act)
+    w2 = mat_mul(expm_su3((8.0 / 9.0) * z1 - (17.0 / 36.0) * z0), w1)
+
+    d2 = lap(w2, phi2)
+    phi3 = phi1 + (3.0 * eps / 4.0) * d2
+    z2 = eps * _flow_z(w2, act)
+    w3 = mat_mul(expm_su3(0.75 * z2 - (8.0 / 9.0) * z1
+                          + (17.0 / 36.0) * z0), w2)
+    return w3, phi3
+
+
+def fermion_flow(gauge: jnp.ndarray, phi: jnp.ndarray, eps: float,
+                 n_steps: int):
+    """Integrate the joint gauge+fermion flow n_steps (performGFlowQuda)."""
+    for _ in range(n_steps):
+        gauge, phi = fermion_flow_step(gauge, phi, eps)
+    return gauge, phi
