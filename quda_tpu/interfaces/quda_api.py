@@ -523,6 +523,39 @@ def mom_action_quda(mom):
     return float(mom_action(mom))
 
 
+def perform_wuppertal_n_step(psi, n_steps: int, alpha: float = 3.0):
+    """performWuppertalnStep (interface_quda.cpp:4935)."""
+    from ..gauge.quark_smear import wuppertal_smear
+    _require_init()
+    return wuppertal_smear(_ctx["gauge"], jnp.asarray(psi), alpha, n_steps)
+
+
+def perform_two_link_gaussian_smear(psi, n_steps: int, omega: float = 2.0):
+    """performTwoLinkGaussianSmearNStep: two-link staggered smearing."""
+    from ..gauge.hisq import two_link
+    from ..gauge.quark_smear import gaussian_smear
+    _require_init()
+    tl = two_link(_ctx["gauge"])
+    return gaussian_smear(_ctx["gauge"], jnp.asarray(psi), omega, n_steps,
+                          two_link_gauge=tl)
+
+
+def laph_sink_project_quda(evecs, psi):
+    """laphSinkProject (quda.h:1859)."""
+    from ..ops.contract import laph_sink_project
+    return laph_sink_project(jnp.asarray(evecs), jnp.asarray(psi))
+
+
+def perform_gflow_quda(phi, n_steps: int, eps: float):
+    """performGFlowQuda: joint gauge+fermion gradient flow; updates the
+    resident gauge and returns the flowed fermion."""
+    from ..gauge.smear import fermion_flow
+    _require_init()
+    g, p = fermion_flow(_ctx["gauge"], jnp.asarray(phi), eps, n_steps)
+    _ctx["gauge"] = g
+    return p
+
+
 def contract_quda(x, y, contract_type: str = "open", momenta=None):
     from ..ops.contract import contract_dr, contract_ft, contract_open_spin
     if contract_type == "open":

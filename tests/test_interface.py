@@ -169,6 +169,22 @@ def test_smear_flow_fix_roundtrip():
     assert theta < 1e-7
 
 
+def test_quark_smear_and_gflow_api(source):
+    sm = api.perform_wuppertal_n_step(source, 2)
+    assert sm.shape == source.shape
+    src1 = ColorSpinorField.gaussian(jax.random.PRNGKey(19), GEOM,
+                                     nspin=1).data
+    sm2 = api.perform_two_link_gaussian_smear(src1, 2)
+    assert sm2.shape == src1.shape
+    ev = (jax.random.normal(jax.random.PRNGKey(20),
+                            (2,) + GEOM.lattice_shape + (3,))
+          + 0j)
+    proj = api.laph_sink_project_quda(ev, source)
+    assert proj.shape == (2, GEOM.T, 4)
+    flowed = api.perform_gflow_quda(source, n_steps=1, eps=0.005)
+    assert np.isfinite(float(jnp.sum(jnp.abs(flowed))))
+
+
 def test_anisotropy_folds_into_spatial_links():
     """GaugeParam.anisotropy divides spatial links at load (QUDA
     convention); temporal links untouched."""
