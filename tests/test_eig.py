@@ -97,8 +97,14 @@ def test_iram_nonhermitian(setup):
         ).reshape(dim),
         dtype=np.complex128)
     k = 4
-    want = ssl.eigs(linop, k=k, which="LR", return_eigenvectors=False)
-    want = np.sort(want.real)[::-1]
+    # Oracle: ask ARPACK for 3x the wanted pairs with a fixed start vector
+    # and keep the top k.  With k=4 exactly and a random v0, ARPACK itself
+    # intermittently misses the leading conjugate pair on this clustered
+    # spectrum (observed in round 1); the over-request makes it reliable.
+    v0 = np.full(dim, 1.0 + 0.5j, dtype=np.complex128)
+    want = ssl.eigs(linop, k=3 * k, which="LR", v0=v0,
+                    return_eigenvectors=False)
+    want = np.sort(want.real)[::-1][:k]
     param = EigParam(n_ev=k, n_kr=30, tol=1e-7, max_restarts=300,
                      spectrum="LR")
     res = iram(dpc.M, example, param)
@@ -106,6 +112,28 @@ def test_iram_nonhermitian(setup):
     got = np.sort(np.asarray(res.evals).real)[::-1]
     assert np.allclose(got, want, rtol=1e-6)
     assert np.all(res.residua < 1e-5)
+
+
+def test_iram_clustered_nonnormal():
+    """IRAM on a deliberately non-normal dense operator with a clustered
+    leading spectrum (the regime where naive restarting mis-routes pairs:
+    reference lib/eig_iram.cpp keeps locked pairs through restarts)."""
+    rng = np.random.default_rng(7)
+    n = 192
+    lam = np.concatenate([
+        [2.0, 1.9995, 1.999, 1.9985],              # tight lead cluster
+        rng.uniform(-1.0, 1.5, n - 4)])            # bulk
+    S = np.eye(n) + 0.3 * rng.standard_normal((n, n)) / np.sqrt(n)
+    A = jnp.asarray(S @ np.diag(lam) @ np.linalg.inv(S),
+                    dtype=jnp.complex128)
+    example = jnp.zeros((n,), jnp.complex128)
+    param = EigParam(n_ev=4, n_kr=40, tol=1e-9, max_restarts=400,
+                     spectrum="LR")
+    res = iram(lambda v: A @ v, example, param)
+    assert res.converged
+    got = np.sort(np.asarray(res.evals).real)[::-1]
+    assert np.allclose(got, np.sort(lam)[::-1][:4], rtol=1e-7)
+    assert np.all(res.residua < 1e-6)
 
 
 def test_deflation_cuts_iterations(setup):
