@@ -15,7 +15,8 @@ from typing import Optional, Sequence, Tuple
 # enum value sets (enum_quda.h analogs)
 DSLASH_TYPES = ("wilson", "clover", "twisted-mass", "twisted-clover",
                 "ndeg-twisted-mass", "staggered", "asqtad", "hisq",
-                "domain-wall", "domain-wall-4d", "mobius", "laplace")
+                "domain-wall", "domain-wall-4d", "mobius", "mobius-eofa",
+                "laplace")
 INVERTER_TYPES = ("cg", "cg3", "cgne", "cgnr", "pcg", "bicgstab",
                   "bicgstab-l", "gcr", "mr", "sd", "ca-cg", "ca-gcr",
                   "multi-shift-cg", "gcr-mg")
@@ -74,6 +75,12 @@ class InvertParam:
     Ls: int = 8
     b5: float = 1.5
     c5: float = 0.5
+    # EOFA (QudaInvertParam eofa_pm/eofa_shift/mq1-3, quda.h)
+    eofa_pm: bool = True
+    eofa_shift: float = 0.0
+    eofa_mq1: float = None
+    eofa_mq2: float = None
+    eofa_mq3: float = None
     laplace3D: int = 3
     tol: float = 1e-10
     tol_hq: float = 0.0
@@ -83,7 +90,9 @@ class InvertParam:
     num_offset: int = 0               # multi-shift
     offset: Sequence[float] = ()
     cuda_prec: str = "double"
-    cuda_prec_sloppy: str = "single"
+    # "auto" resolves at solve time: bf16 ("half") on TPU, = cuda_prec on
+    # CPU.  Pinning any explicit value opts out of the TPU default.
+    cuda_prec_sloppy: str = "auto"
     cuda_prec_precondition: str = "half"
     gcrNkrylov: int = 16
     verbosity: str = "summarize"

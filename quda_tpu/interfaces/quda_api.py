@@ -128,6 +128,16 @@ def _build_dirac(p: InvertParam, pc: bool):
             return mdw.DiracMobiusPC(g, geom, p.Ls, m5, p.mass, b5, c5, ap,
                                      matpc)
         return mdw.DiracMobius(g, geom, p.Ls, m5, p.mass, b5, c5, ap)
+    if t == "mobius-eofa":
+        m5 = -p.m5
+        kw = dict(mq1=p.eofa_mq1, mq2=p.eofa_mq2, mq3=p.eofa_mq3,
+                  eofa_pm=p.eofa_pm, eofa_shift=p.eofa_shift)
+        if pc:
+            return mdw.DiracMobiusEofaPC(g, geom, p.Ls, m5, p.mass, p.b5,
+                                         p.c5, antiperiodic_t=ap,
+                                         matpc=matpc, **kw)
+        return mdw.DiracMobiusEofa(g, geom, p.Ls, m5, p.mass, p.b5, p.c5,
+                                   antiperiodic_t=ap, **kw)
     if t == "laplace":
         from ..ops.laplace import laplace
 
@@ -144,9 +154,12 @@ def _build_dirac(p: InvertParam, pc: bool):
     qlog.errorq(f"dslash_type {t} not wired into invert yet")
 
 
+_DWF_TYPES = ("domain-wall", "domain-wall-4d", "mobius", "mobius-eofa")
+
+
 def _split(b, p):
     geom = _ctx["geom"]
-    if p.dslash_type in ("domain-wall", "domain-wall-4d", "mobius"):
+    if p.dslash_type in _DWF_TYPES:
         be = jax.vmap(lambda v: even_odd_split(v, geom)[0])(b)
         bo = jax.vmap(lambda v: even_odd_split(v, geom)[1])(b)
         return be, bo
@@ -155,9 +168,42 @@ def _split(b, p):
 
 def _join(xe, xo, p):
     geom = _ctx["geom"]
-    if p.dslash_type in ("domain-wall", "domain-wall-4d", "mobius"):
+    if p.dslash_type in _DWF_TYPES:
         return jax.vmap(lambda e, o: even_odd_join(e, o, geom))(xe, xo)
     return even_odd_join(xe, xo, geom)
+
+
+def _resolve_sloppy(param: InvertParam) -> str:
+    """Resolve cuda_prec_sloppy="auto": bf16 ("half") on TPU — where
+    "single/single" would never mix and the bf16 HBM/MXU path would go
+    unused — and = cuda_prec elsewhere.  Any explicitly pinned value
+    (including sloppy == prec for a pure-precision solve) is honored."""
+    if param.cuda_prec_sloppy != "auto":
+        return param.cuda_prec_sloppy
+    if jax.default_backend() == "tpu":
+        qlog.printq("cuda_prec_sloppy=auto -> half (bf16) on TPU",
+                    qlog.VERBOSE)
+        return "half"
+    return param.cuda_prec
+
+
+def _pair_refined_solve(mv, sys_rhs, dtype, param, inner_solver,
+                        max_cycles: int = 10):
+    """Shared defect-correction harness for the pair-sloppy bicgstab/gcr
+    paths: run the sloppy inner solver per cycle, track TOTAL inner
+    iterations (so param.iter_count/gflops reflect real work, not cycle
+    count)."""
+    from .. import solvers
+    inner_iters = []
+
+    def inner(r):
+        ri = inner_solver(r)
+        inner_iters.append(int(ri.iters))
+        return ri.x
+
+    res = solvers.solve_refined(mv, inner, sys_rhs, dtype, tol=param.tol,
+                                max_cycles=max_cycles)
+    return res._replace(iters=jnp.int32(sum(inner_iters)))
 
 
 def invert_quda(source, param: InvertParam):
@@ -186,9 +232,18 @@ def invert_quda(source, param: InvertParam):
     if param.num_offset:
         qlog.errorq("use invert_multishift_quda for shifted solves")
 
-    mixed = (param.cuda_prec_sloppy != param.cuda_prec
-             and param.inv_type == "cg"
-             and param.cuda_prec == "double")
+    # Mixed-precision gate.  QUDA threads matSloppy through every solver
+    # (include/invert_quda.h:369); the TPU ladder (utils/precision.py) has
+    # two genuinely distinct sloppy levels: a lower complex dtype
+    # (double->single, CPU only) and bf16/int8 pair storage
+    # ("half"/"quarter" — real TPU fast path, ops/pair.py).
+    sloppy_prec = _resolve_sloppy(param)
+    pair_sloppy = (sloppy_prec in ("half", "quarter")
+                   and param.dslash_type == "wilson" and pc)
+    dtype_sloppy = (sloppy_prec != param.cuda_prec
+                    and complex_dtype(sloppy_prec) != complex_dtype(
+                        param.cuda_prec))
+    mixed = (param.inv_type == "cg" and (pair_sloppy or dtype_sloppy))
 
     if hermitian_pc:           # staggered PC: already the normal operator
         mv = d.M
@@ -210,29 +265,66 @@ def invert_quda(source, param: InvertParam):
         sys_rhs = d.Mdag(rhs)
 
     if mixed and inv == "cg":
-        sl = _build_sloppy(param, pc)
-        if hermitian_pc:
-            mv_lo = sl.M
+        if pair_sloppy:
+            sl = d.sloppy(sloppy_prec)
+            codec = solvers.pair_codec(sl.store_dtype, dtype)
+            res = solvers.cg_reliable(
+                mv, sl.MdagM_pairs, sys_rhs, tol=param.tol,
+                maxiter=param.maxiter, delta=param.reliable_delta,
+                codec=codec)
         else:
-            mv_lo = lambda v: sl.Mdag(sl.M(v))
-        res = solvers.cg_reliable(
-            mv, mv_lo, sys_rhs, complex_dtype(param.cuda_prec_sloppy),
-            tol=param.tol, maxiter=param.maxiter,
-            delta=param.reliable_delta)
+            sl = _build_sloppy(param, pc, sloppy_prec)
+            if hermitian_pc:
+                mv_lo = sl.M
+            else:
+                mv_lo = lambda v: sl.Mdag(sl.M(v))
+            res = solvers.cg_reliable(
+                mv, mv_lo, sys_rhs, complex_dtype(sloppy_prec),
+                tol=param.tol, maxiter=param.maxiter,
+                delta=param.reliable_delta)
     elif inv in ("cg", "pcg", "cg3"):
         fn = solvers.create(inv)
         res = fn(mv, sys_rhs, tol=param.tol, maxiter=param.maxiter)
     elif inv == "bicgstab":
-        res = solvers.bicgstab(mv, sys_rhs, tol=param.tol,
-                               maxiter=param.maxiter)
+        if pair_sloppy:
+            # defect-correction outer at precise, bf16-internal BiCGStab
+            # inner (QUDA's sloppy-solve + reliable-residual pattern for
+            # non-Hermitian systems).  The inner operator must match the
+            # OUTER system: MdagM when solving the normal equations.
+            sl = d.sloppy(sloppy_prec)
+            mv_in = sl.MdagM if normop else sl.M
+            res = _pair_refined_solve(
+                mv, sys_rhs, dtype, param,
+                jax.jit(lambda r: solvers.bicgstab(
+                    mv_in, r, tol=1e-3, maxiter=param.maxiter)))
+        else:
+            res = solvers.bicgstab(mv, sys_rhs, tol=param.tol,
+                                   maxiter=param.maxiter)
     elif inv == "bicgstab-l":
         res = solvers.bicgstab_l(mv, sys_rhs, L=4, tol=param.tol,
                                  maxiter=param.maxiter)
     elif inv == "gcr":
-        res = solvers.gcr(mv, sys_rhs, tol=param.tol,
-                          nkrylov=param.gcrNkrylov,
-                          max_restarts=max(1, param.maxiter
-                                           // param.gcrNkrylov))
+        if pair_sloppy:
+            sl = d.sloppy(sloppy_prec)
+            mv_in = sl.MdagM if normop else sl.M
+            # NOTE: gcr is a host-driven restart loop (it jits its own
+            # cycles internally) — wrapping it in jax.jit would trace the
+            # float() convergence checks.  The inner budget honors
+            # param.maxiter across the refinement cycles.
+            cycles = 10
+            inner_budget = max(1, param.maxiter
+                               // (cycles * param.gcrNkrylov))
+            res = _pair_refined_solve(
+                mv, sys_rhs, dtype, param,
+                lambda r: solvers.gcr(
+                    mv_in, r, tol=1e-3, nkrylov=param.gcrNkrylov,
+                    max_restarts=inner_budget),
+                max_cycles=cycles)
+        else:
+            res = solvers.gcr(mv, sys_rhs, tol=param.tol,
+                              nkrylov=param.gcrNkrylov,
+                              max_restarts=max(1, param.maxiter
+                                               // param.gcrNkrylov))
     elif inv in ("ca-cg", "ca-gcr"):
         fn = solvers.create(inv)
         res = fn(mv, sys_rhs, tol=param.tol,
@@ -268,11 +360,12 @@ def invert_quda(source, param: InvertParam):
     return x_full
 
 
-def _build_sloppy(p: InvertParam, pc: bool):
+def _build_sloppy(p: InvertParam, pc: bool, sloppy_prec: str = None):
     import copy
+    sloppy_prec = sloppy_prec or _resolve_sloppy(p)
     sl = copy.copy(p)
-    sl.cuda_prec = p.cuda_prec_sloppy
-    dt = complex_dtype(p.cuda_prec_sloppy)
+    sl.cuda_prec = sloppy_prec
+    dt = complex_dtype(sloppy_prec)
     saved = {k: _ctx[k] for k in ("gauge", "fat", "long")}
     for k, v in saved.items():
         if v is not None:
@@ -336,7 +429,31 @@ def invert_multishift_quda(source, param: InvertParam):
         mv = lambda v: d.Mdag(d.M(v))
         rhs = d.Mdag(rhs)
     t0 = time.perf_counter()
-    res = multishift_cg(mv, rhs, tuple(param.offset), tol=param.tol,
+    shifts = tuple(param.offset)
+    sloppy_prec = _resolve_sloppy(param)
+    pair_sloppy = (sloppy_prec in ("half", "quarter")
+                   and param.dslash_type == "wilson")
+    if pair_sloppy:
+        # QUDA's multi-shift strategy (lib/inv_multi_cg_quda.cpp final
+        # phase): run the shared-Krylov solve at sloppy precision, then
+        # polish each shift with a short precise-level CG seeded by the
+        # sloppy solution.
+        from ..solvers.cg import cg as cg_solve
+        sl = d.sloppy(sloppy_prec)
+        res = multishift_cg(sl.MdagM, rhs.astype(jnp.complex64),
+                            shifts, tol=max(param.tol, 1e-4),
+                            maxiter=param.maxiter)
+        xs, iters = [], int(res.iters)
+        for i, s in enumerate(shifts):
+            mv_s = (lambda sig: lambda v: mv(v) + sig * v)(s)
+            ref = cg_solve(mv_s, rhs, x0=res.x[i].astype(rhs.dtype),
+                           tol=param.tol, maxiter=param.maxiter)
+            xs.append(ref.x)
+            iters += int(ref.iters)
+        param.iter_count = iters
+        param.secs = time.perf_counter() - t0
+        return jnp.stack(xs)
+    res = multishift_cg(mv, rhs, shifts, tol=param.tol,
                         maxiter=param.maxiter)
     param.iter_count = int(res.iters)
     param.secs = time.perf_counter() - t0
@@ -376,8 +493,7 @@ def eigensolve_quda(eig_param: EigParamAPI, invert_param: InvertParam):
     shape = (geom.half_lattice_shape if pc else geom.lattice_shape) + (4, 3)
     if invert_param.dslash_type in ("staggered", "asqtad", "hisq"):
         shape = shape[:-2] + (1, 3)
-    if invert_param.dslash_type in ("domain-wall", "domain-wall-4d",
-                                    "mobius"):
+    if invert_param.dslash_type in _DWF_TYPES:
         shape = (invert_param.Ls,) + shape
     example = jnp.zeros(shape, dtype)
     p = EigParam(n_ev=eig_param.n_ev, n_kr=eig_param.n_kr,

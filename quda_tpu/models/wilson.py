@@ -105,3 +105,130 @@ class DiracWilsonPC(DiracPC):
 
     def flops_per_site_M(self) -> int:
         return 2 * 1320 + 48
+
+    def sloppy(self, prec: str = "half") -> "DiracWilsonPCSloppy":
+        """Build the low-precision companion operator (QUDA matSloppy,
+        include/invert_quda.h:369): same links, bf16-pair ('half') or
+        int8 block-float ('quarter') storage."""
+        return DiracWilsonPCSloppy(self, prec)
+
+    def packed(self) -> "DiracWilsonPCPacked":
+        """Build the TPU-native packed-layout companion (QUDA native
+        FloatN field order analog, ops/wilson_packed.py)."""
+        return DiracWilsonPCPacked(self)
+
+
+class DiracWilsonPCPacked:
+    """PC Wilson operator on the TPU-native packed half-lattice layout.
+
+    ``prepare`` takes canonical (T,Z,Y,Xh,4,3) parity fields and returns a
+    PACKED rhs; ``M`` acts packed->packed (the whole Krylov loop stays in
+    the device-native order); ``reconstruct`` takes the packed solution and
+    canonical sources and returns canonical parity fields.  This mirrors
+    how QUDA keeps solver fields in native order and converts only at the
+    interface boundary (lib/interface_quda.cpp loadGauge/invert flow).
+    """
+
+    def __init__(self, dpc: DiracWilsonPC):
+        from ..ops import wilson_packed as wpk
+        self.geom = dpc.geom
+        self.kappa = dpc.kappa
+        self.matpc = dpc.matpc
+        self._dpc = dpc
+        self.dims = dpc.geom.lattice_shape      # (T, Z, Y, X)
+        self.gauge_eo_p = wpk.pack_gauge_eo(dpc.gauge_eo)
+
+    def D_to(self, psi_p, target_parity):
+        from ..ops import wilson_packed as wpk
+        return wpk.dslash_eo_packed(self.gauge_eo_p, psi_p, self.dims,
+                                    target_parity)
+
+    def M(self, x_p):
+        p = self.matpc
+        tmp = self.D_to(x_p, 1 - p)
+        return x_p - (self.kappa ** 2) * self.D_to(tmp, p)
+
+    def Mdag(self, x_p):
+        sign = jnp.asarray([1.0, 1.0, -1.0, -1.0], x_p.real.dtype)
+        g5 = sign[:, None, None, None, None].astype(x_p.dtype)
+        return g5 * self.M(g5 * x_p)
+
+    def MdagM(self, x_p):
+        return self.Mdag(self.M(x_p))
+
+    def prepare(self, b_even, b_odd):
+        from ..ops import wilson_packed as wpk
+        return wpk.pack_spinor(self._dpc.prepare(b_even, b_odd))
+
+    def reconstruct(self, x_p_packed, b_even, b_odd):
+        from ..ops import wilson_packed as wpk
+        T, Z, Y, X = self.dims
+        x_p = wpk.unpack_spinor(x_p_packed, (T, Z, Y, X // 2))
+        return self._dpc.reconstruct(x_p, b_even, b_odd)
+
+    def flops_per_site_M(self) -> int:
+        return self._dpc.flops_per_site_M()
+
+
+class DiracWilsonPCSloppy:
+    """Low-precision PC Wilson operator on pair-format storage.
+
+    Two entry points:
+
+    * ``M_pairs`` / ``MdagM_pairs`` — act on (T,Z,Y,X//2,4,3,2) pair
+      arrays in the storage dtype; the whole sloppy CG loop stays in
+      half storage (QUDA's half sloppy solve).
+    * ``M`` / ``MdagM`` — complex64 in/out with bf16 internals: usable
+      as a drop-in sloppy operator inside any complex-arithmetic solver
+      (gauge traffic halved, einsums on the bf16 MXU path).
+    """
+
+    def __init__(self, dpc: DiracWilsonPC, prec: str = "half"):
+        from ..ops import pair as pops
+        self.geom = dpc.geom
+        self.kappa = float(dpc.kappa)
+        self.matpc = dpc.matpc
+        self.prec = prec
+        self.store_dtype = jnp.bfloat16
+        # links are already boundary-phase folded in the precise operator
+        self.gauge_eo_st = tuple(
+            pops.encode_gauge(dpc.gauge_eo[p], prec) for p in (0, 1))
+
+    # -- pair-storage path ---------------------------------------------
+    def _d_to(self, psi_pairs, target_parity, out_dtype):
+        from ..ops import pair as pops
+        return pops.dslash_eo_pairs(self.gauge_eo_st, psi_pairs, self.geom,
+                                    target_parity, out_dtype=out_dtype)
+
+    def M_pairs(self, x):
+        p = self.matpc
+        tmp = self._d_to(x, 1 - p, self.store_dtype)
+        dd = self._d_to(tmp, p, jnp.float32)
+        out = x.astype(jnp.float32) - (self.kappa ** 2) * dd
+        return out.astype(self.store_dtype)
+
+    def _g5_pairs(self, x):
+        sign = jnp.asarray([1.0, 1.0, -1.0, -1.0], jnp.float32)
+        return (x.astype(jnp.float32)
+                * sign[:, None, None]).astype(x.dtype)
+
+    def Mdag_pairs(self, x):
+        return self._g5_pairs(self.M_pairs(self._g5_pairs(x)))
+
+    def MdagM_pairs(self, x):
+        return self.Mdag_pairs(self.M_pairs(x))
+
+    # -- complex in/out path -------------------------------------------
+    def M(self, x):
+        from ..ops import pair as pops
+        out = self.M_pairs(pops.to_pairs(x, self.store_dtype))
+        return pops.from_pairs(out, x.dtype)
+
+    def Mdag(self, x):
+        from .dirac import apply_gamma5
+        return apply_gamma5(self.M(apply_gamma5(x)))
+
+    def MdagM(self, x):
+        from ..ops import pair as pops
+        out = self.MdagM_pairs(pops.to_pairs(x, self.store_dtype))
+        return pops.from_pairs(out, x.dtype)

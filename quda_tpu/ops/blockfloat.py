@@ -28,8 +28,8 @@ class Bf16Field(NamedTuple):
 
 
 def to_bf16(x: jnp.ndarray) -> Bf16Field:
-    return Bf16Field(jnp.stack([x.real, x.imag],
-                               axis=-1).astype(jnp.bfloat16))
+    from .pair import to_pairs
+    return Bf16Field(to_pairs(x, jnp.bfloat16))
 
 
 def from_bf16(f: Bf16Field, dtype=jnp.complex64) -> jnp.ndarray:

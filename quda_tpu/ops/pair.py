@@ -1,0 +1,189 @@
+"""Pair-format (real/imag last-axis) complex field ops for low precision.
+
+JAX has no complex-bfloat16 dtype, so sloppy fields are stored as real
+``(..., 2)`` pair arrays in bfloat16 (QUDA "half") or int8 block-float
+(QUDA "quarter", via ops/blockfloat.py).  This module provides the pair
+algebra plus Wilson stencils in pair form, so an entire sloppy CG loop can
+run on half-storage vectors:
+
+* All CG scalar coefficients (alpha, beta) are REAL, so axpy-family updates
+  on pair arrays are plain real arithmetic — no complex emulation needed.
+* Re<x,y> and |x|^2 of a complex field equal the plain real dot / sum of
+  squares of its pair array, so reductions are single real einsums (f32
+  accumulation).
+* The color multiply uses 4 real einsums with
+  ``preferred_element_type=float32`` — on TPU this is exactly the native
+  bf16-in/f32-accumulate MXU path.
+
+Reference behavior: QUDA's half/quarter sloppy fields + accessors
+(include/color_spinor_field_order.h, include/gauge_field_order.h
+block-float machinery) and the sloppy-operator threading of
+include/invert_quda.h:369.  bf16 shares f32's exponent range, so the
+per-site norm array of QUDA's fp16 path is unnecessary (see
+ops/blockfloat.py); int8 keeps a per-link scale.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..fields.geometry import LatticeGeometry
+from . import gamma as g
+from .shift import shift, shift_eo
+
+F32 = jnp.float32
+
+
+# -- conversions ------------------------------------------------------------
+
+def to_pairs(x: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """complex (...,) -> real pairs (..., 2) in the storage dtype."""
+    return jnp.stack([x.real, x.imag], axis=-1).astype(dtype)
+
+
+def from_pairs(p: jnp.ndarray, dtype=jnp.complex64) -> jnp.ndarray:
+    f = p.astype(F32)
+    return (f[..., 0] + 1j * f[..., 1]).astype(dtype)
+
+
+# -- reductions (valid because pairs are just the real view) ---------------
+
+def pair_norm2(x: jnp.ndarray) -> jnp.ndarray:
+    f = x.astype(F32)
+    return jnp.sum(f * f)
+
+
+def pair_redot(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(x.astype(F32) * y.astype(F32))
+
+
+def pair_cdot(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """<x, y> = sum conj(x) y as a complex64 scalar."""
+    xr, xi = x[..., 0].astype(F32), x[..., 1].astype(F32)
+    yr, yi = y[..., 0].astype(F32), y[..., 1].astype(F32)
+    re = jnp.sum(xr * yr + xi * yi)
+    im = jnp.sum(xr * yi - xi * yr)
+    return (re + 1j * im).astype(jnp.complex64)
+
+
+def pair_caxpy(a, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """y + a*x for complex scalar a on pair arrays (storage dtype kept)."""
+    ar = jnp.real(a).astype(F32)
+    ai = jnp.imag(a).astype(F32)
+    xr, xi = x[..., 0].astype(F32), x[..., 1].astype(F32)
+    out = jnp.stack([ar * xr - ai * xi, ar * xi + ai * xr], axis=-1)
+    return (y.astype(F32) + out).astype(y.dtype)
+
+
+# -- link algebra -----------------------------------------------------------
+
+def dagger_pairs(u: jnp.ndarray) -> jnp.ndarray:
+    """(..., a, b, 2) -> (..., b, a, 2) with conjugation."""
+    ut = jnp.swapaxes(u, -3, -2)
+    return jnp.stack([ut[..., 0], -ut[..., 1]], axis=-1)
+
+
+def color_mul_pairs(u: jnp.ndarray, p: jnp.ndarray,
+                    out_dtype=F32) -> jnp.ndarray:
+    """(..., a, b, 2) x (..., s, b, 2) -> (..., s, a, 2).
+
+    Four real einsums with f32 accumulation — the TPU-native complex
+    multiply for low-precision storage.
+    """
+    ein = functools.partial(jnp.einsum, "...ab,...sb->...sa",
+                            preferred_element_type=F32)
+    ur, ui = u[..., 0], u[..., 1]
+    pr, pi = p[..., 0], p[..., 1]
+    re = ein(ur, pr) - ein(ui, pi)
+    im = ein(ur, pi) + ein(ui, pr)
+    return jnp.stack([re, im], axis=-1).astype(out_dtype)
+
+
+def spin_mul_pairs(m, p: jnp.ndarray, out_dtype=F32) -> jnp.ndarray:
+    """Constant complex (4,4) spin matrix on (..., s, c, 2) pairs."""
+    import numpy as np
+    m = np.asarray(m)
+    mr = jnp.asarray(m.real, F32)
+    mi = jnp.asarray(m.imag, F32)
+    ein = functools.partial(jnp.einsum, "st,...tc->...sc",
+                            preferred_element_type=F32)
+    pr, pi = p[..., 0].astype(F32), p[..., 1].astype(F32)
+    re = ein(mr, pr) - ein(mi, pi)
+    im = ein(mr, pi) + ein(mi, pr)
+    return jnp.stack([re, im], axis=-1).astype(out_dtype)
+
+
+# -- gauge codecs -----------------------------------------------------------
+
+def encode_gauge(gauge: jnp.ndarray, prec: str):
+    """complex link array -> pair storage ('half' bf16, 'quarter' int8
+    block-float via ops/blockfloat.py — one f32 scale per link)."""
+    if prec == "half":
+        return to_pairs(gauge, jnp.bfloat16)
+    if prec == "quarter":
+        from .blockfloat import to_int8
+        return to_int8(gauge, n_internal=2)   # scale over (a, b) per link
+    raise ValueError(prec)
+
+
+def decode_gauge(stored) -> jnp.ndarray:
+    """Decompress to bf16 pairs on the fly (inside the stencil jit, so XLA
+    fuses the dequantise into the consuming einsum chain)."""
+    from .blockfloat import Int8Field
+    if isinstance(stored, Int8Field):
+        return (stored.data.astype(F32) * stored.scale).astype(jnp.bfloat16)
+    return stored
+
+
+# -- Wilson stencils in pair form ------------------------------------------
+
+def _proj_pair_consts():
+    return g.PROJ_MINUS, g.PROJ_PLUS
+
+
+def dslash_full_pairs(gauge_st, psi: jnp.ndarray,
+                      out_dtype=None) -> jnp.ndarray:
+    """Full-lattice Wilson hop term on pair arrays.
+
+    gauge_st: encoded (4,T,Z,Y,X,3,3,2) links (bf16 pairs or int8 tuple);
+    psi: (T,Z,Y,X,4,3,2) pairs.  Mirrors ops/wilson.dslash_full.
+    """
+    pm, pp = _proj_pair_consts()
+    out_dtype = out_dtype or psi.dtype
+    gauge = decode_gauge(gauge_st)
+    out = None
+    for mu in range(4):
+        u = gauge[mu]
+        fwd = color_mul_pairs(u, shift(psi, mu, +1))
+        term = spin_mul_pairs(pm[mu], fwd)
+        ub = shift(dagger_pairs(u), mu, -1)
+        bwd = color_mul_pairs(ub, shift(psi, mu, -1))
+        term = term + spin_mul_pairs(pp[mu], bwd)
+        out = term if out is None else out + term
+    return out.astype(out_dtype)
+
+
+def dslash_eo_pairs(gauge_eo_st, psi: jnp.ndarray, geom: LatticeGeometry,
+                    target_parity: int, out_dtype=None) -> jnp.ndarray:
+    """Checkerboarded Wilson hop on pair arrays (mirrors ops/wilson.dslash_eo).
+
+    gauge_eo_st: (even_st, odd_st) encoded half-site links
+    (4,T,Z,Y,X//2,3,3,2 each); psi: (T,Z,Y,X//2,4,3,2) of parity 1-p.
+    """
+    pm, pp = _proj_pair_consts()
+    out_dtype = out_dtype or psi.dtype
+    u_here = decode_gauge(gauge_eo_st[target_parity])
+    u_there = decode_gauge(gauge_eo_st[1 - target_parity])
+    out = None
+    for mu in range(4):
+        fwd = color_mul_pairs(
+            u_here[mu], shift_eo(psi, geom, mu, +1, target_parity))
+        term = spin_mul_pairs(pm[mu], fwd)
+        ub = shift_eo(dagger_pairs(u_there[mu]), geom, mu, -1, target_parity)
+        bwd = color_mul_pairs(ub, shift_eo(psi, geom, mu, -1, target_parity))
+        term = term + spin_mul_pairs(pp[mu], bwd)
+        out = term if out is None else out + term
+    return out.astype(out_dtype)

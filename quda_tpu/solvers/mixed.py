@@ -29,22 +29,68 @@ from ..ops import blas
 from .cg import SolverResult, cg
 
 
+class StorageCodec(NamedTuple):
+    """How the sloppy iterates are stored and operated on.
+
+    ``down``/``up`` convert between the precise representation (complex
+    array) and the sloppy storage; ``norm2``/``redot`` reduce in storage;
+    ``axpy(a, x, y) = y + a*x`` for REAL scalar a, computed at f32 and
+    rounded back to storage.  Two instances cover the TPU ladder:
+    a plain dtype cast (single sloppy) and bf16/int8 pair storage
+    (half/quarter — see ops/pair.py).
+    """
+    down: Callable
+    up: Callable
+    norm2: Callable
+    redot: Callable
+    axpy: Callable
+
+
+def dtype_codec(sloppy_dtype, precise_dtype) -> StorageCodec:
+    return StorageCodec(
+        down=lambda x: x.astype(sloppy_dtype),
+        up=lambda x: x.astype(precise_dtype),
+        norm2=blas.norm2,
+        redot=blas.redot,
+        axpy=lambda a, x, y: y + a.astype(sloppy_dtype) * x)
+
+
+def pair_codec(store_dtype, precise_dtype) -> StorageCodec:
+    from ..ops import pair as pops
+    f32 = jnp.float32
+    return StorageCodec(
+        down=lambda x: pops.to_pairs(x, store_dtype),
+        up=lambda x: pops.from_pairs(x, precise_dtype),
+        norm2=pops.pair_norm2,
+        redot=pops.pair_redot,
+        axpy=lambda a, x, y: (y.astype(f32)
+                              + a.astype(f32) * x.astype(f32)
+                              ).astype(store_dtype))
+
+
 def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
-                sloppy_dtype, tol: float = 1e-10, maxiter: int = 2000,
-                delta: float = 0.1) -> SolverResult:
+                sloppy_dtype=None, tol: float = 1e-10, maxiter: int = 2000,
+                delta: float = 0.1,
+                codec: Optional[StorageCodec] = None) -> SolverResult:
     """Mixed-precision CG with reliable updates.
 
-    matvec_hi acts at b.dtype; matvec_lo at sloppy_dtype.  Convergence is
-    judged on the TRUE residual norm maintained through reliable updates,
-    so the returned r2 is trustworthy at the precise level.
+    matvec_hi acts on the precise (complex) representation; matvec_lo acts
+    on the SLOPPY STORAGE (a complex array for a dtype codec, a (...,2)
+    pair array for the bf16/int8 codec).  Convergence is judged on the
+    TRUE residual norm maintained through reliable updates, so the
+    returned r2 is trustworthy at the precise level.
     """
+    if codec is None:
+        if sloppy_dtype is None:
+            raise ValueError("cg_reliable needs sloppy_dtype or codec")
+        codec = dtype_codec(sloppy_dtype, b.dtype)
     b2 = blas.norm2(b)
     stop = (tol ** 2) * b2
 
     x = jnp.zeros_like(b)          # precise accumulated solution
     r = b                          # precise residual
     r2 = b2
-    r_lo = r.astype(sloppy_dtype)
+    r_lo = codec.down(r)
     p = r_lo
     x_lo = jnp.zeros_like(r_lo)    # sloppy partial solution since last update
     rdt = jnp.zeros((), b.dtype).real.dtype
@@ -54,28 +100,28 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
 
     def body(c):
         Ap = matvec_lo(c["p"])
-        pAp = blas.redot(c["p"], Ap).astype(rdt)
+        pAp = codec.redot(c["p"], Ap).astype(rdt)
         alpha = c["r2_lo"] / jnp.maximum(pAp, jnp.finfo(rdt).tiny)
-        x_lo = c["x_lo"] + alpha.astype(c["p"].dtype) * c["p"]
-        r_lo = c["r_lo"] - alpha.astype(c["p"].dtype) * Ap
-        r2_new = blas.norm2(r_lo).astype(rdt)
+        x_lo = codec.axpy(alpha, c["p"], c["x_lo"])
+        r_lo = codec.axpy(-alpha, Ap, c["r_lo"])
+        r2_new = codec.norm2(r_lo).astype(rdt)
         beta = r2_new / c["r2_lo"]
-        p = r_lo + beta.astype(c["p"].dtype) * c["p"]
+        p = codec.axpy(beta, c["p"], r_lo)
         r2max = jnp.maximum(c["r2max"], r2_new)
 
         do_reliable = jnp.logical_or(r2_new < (delta ** 2) * r2max,
                                      r2_new < stop)
 
         def reliable(_):
-            x_new = c["x"] + x_lo.astype(c["x"].dtype)
+            x_new = c["x"] + codec.up(x_lo)
             r_true = c["b"] - matvec_hi(x_new)
             r2_true = blas.norm2(r_true).astype(rdt)
             return dict(
                 c, x=x_new, r=r_true, r2=r2_true,
-                r_lo=r_true.astype(sloppy_dtype),
+                r_lo=codec.down(r_true),
                 # restart the direction at the true residual (QUDA resets
                 # beta using the new residual after a reliable update)
-                p=r_true.astype(sloppy_dtype),
+                p=codec.down(r_true),
                 x_lo=jnp.zeros_like(x_lo),
                 r2_lo=r2_true, r2max=r2_true, k=c["k"] + 1)
 
@@ -89,7 +135,7 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
                 r2_lo=r2.astype(rdt), r2max=r2.astype(rdt), k=jnp.int32(0))
     out = jax.lax.while_loop(cond, body, init)
     # final fold of any un-injected sloppy contribution
-    x_fin = out["x"] + out["x_lo"].astype(out["x"].dtype)
+    x_fin = out["x"] + codec.up(out["x_lo"])
     r_fin = b - matvec_hi(x_fin)
     r2_fin = blas.norm2(r_fin)
     return SolverResult(x_fin, out["k"], r2_fin, r2_fin <= stop)

@@ -71,3 +71,158 @@ def test_reliable_iters_comparable_to_pure_double(problem):
     res_m = cg_reliable(dpc.MdagM, dpc_lo.MdagM, rhs, jnp.complex64,
                         tol=TOL, maxiter=2000)
     assert int(res_m.iters) < 3 * int(res_d.iters)
+
+
+# -- bf16/int8 pair-storage sloppy path (ops/pair.py) ----------------------
+
+def test_pair_stencil_matches_complex(problem):
+    """bf16 pair-form PC Wilson matvec tracks the exact operator to the
+    bf16 rounding level (and int8 block-float to its scale)."""
+    dpc, _, rhs = problem
+    v = rhs.astype(jnp.complex64)
+    exact = dpc.M(rhs)
+    for prec, bound in (("half", 0.02), ("quarter", 0.05)):
+        sl = dpc.sloppy(prec)
+        err = blas.norm2(exact - sl.M(v).astype(rhs.dtype))
+        assert float(jnp.sqrt(err / blas.norm2(exact))) < bound
+
+
+def test_cg_reliable_bf16_pairs_reaches_double_tol(problem):
+    """The whole sloppy loop runs on bf16 pair storage (QUDA half) and
+    still reaches a precise-level 1e-10 true residual, at a comparable
+    iteration count to pure precise CG."""
+    from quda_tpu.solvers.mixed import pair_codec
+    dpc, _, rhs = problem
+    sl = dpc.sloppy("half")
+    codec = pair_codec(jnp.bfloat16, rhs.dtype)
+    res = cg_reliable(dpc.MdagM, sl.MdagM_pairs, rhs, tol=TOL,
+                      maxiter=2000, codec=codec)
+    assert bool(res.converged)
+    r2 = blas.norm2(rhs - dpc.MdagM(res.x))
+    assert float(jnp.sqrt(r2 / blas.norm2(rhs))) < 2 * TOL
+    res_d = cg(dpc.MdagM, rhs, tol=TOL, maxiter=2000)
+    assert int(res.iters) < 2 * int(res_d.iters)
+
+
+def test_cg_reliable_int8_pairs_converges(problem):
+    """Quarter (int8 block-float gauge) sloppy operator still converges
+    under reliable updates."""
+    from quda_tpu.solvers.mixed import pair_codec
+    dpc, _, rhs = problem
+    sl = dpc.sloppy("quarter")
+    codec = pair_codec(jnp.bfloat16, rhs.dtype)
+    res = cg_reliable(dpc.MdagM, sl.MdagM_pairs, rhs, tol=TOL,
+                      maxiter=4000, codec=codec)
+    assert bool(res.converged)
+    r2 = blas.norm2(rhs - dpc.MdagM(res.x))
+    assert float(jnp.sqrt(r2 / blas.norm2(rhs))) < 2 * TOL
+
+
+def test_api_mixed_bicgstab_refined(problem):
+    """BiCGStab with bf16-internal inner solves through the API-level
+    defect-correction path converges on the non-Hermitian PC system."""
+    from quda_tpu.solvers.bicgstab import bicgstab
+    from quda_tpu.solvers.mixed import solve_refined
+    dpc, _, _ = problem
+    key = jax.random.PRNGKey(5)
+    b = even_odd_split(ColorSpinorField.gaussian(key, GEOM).data, GEOM)[0]
+    sl = dpc.sloppy("half")
+    inner = jax.jit(lambda r: bicgstab(sl.M, r, tol=1e-3, maxiter=500).x)
+    res = solve_refined(dpc.M, inner, b, jnp.complex64, tol=1e-9)
+    assert bool(res.converged)
+    r2 = blas.norm2(b - dpc.M(res.x))
+    assert float(jnp.sqrt(r2 / blas.norm2(b))) < 2e-9
+
+
+def test_pair_complex_algebra_and_full_stencil(problem):
+    """pair_cdot / pair_caxpy match the complex BLAS, and the full-lattice
+    pair stencil matches the canonical full dslash at bf16 accuracy."""
+    from quda_tpu.models.wilson import DiracWilson
+    from quda_tpu.ops import pair as pops
+    from quda_tpu.ops import wilson as wops
+    key = jax.random.PRNGKey(9)
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = (jax.random.normal(k1, (5, 7)) + 1j * jax.random.normal(k2, (5, 7))
+         ).astype(jnp.complex64)
+    y = (jax.random.normal(k3, (5, 7)) + 0.5j).astype(jnp.complex64)
+    xp = pops.to_pairs(x, jnp.float32)
+    yp = pops.to_pairs(y, jnp.float32)
+    assert np.allclose(complex(pops.pair_cdot(xp, yp)),
+                       complex(blas.cdot(x, y)), rtol=1e-5)
+    a = 0.3 - 1.7j
+    got = pops.from_pairs(pops.pair_caxpy(a, xp, yp), jnp.complex64)
+    assert np.allclose(np.asarray(got), np.asarray(y + a * x), rtol=1e-5)
+
+    geom = GEOM
+    gauge = GaugeField.random(jax.random.PRNGKey(1), geom).data
+    d = DiracWilson(gauge, geom, KAPPA)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(2), geom).data
+    ref = wops.dslash_full(d.gauge, psi.astype(jnp.complex64))
+    gst = pops.encode_gauge(d.gauge.astype(jnp.complex64), "half")
+    out = pops.from_pairs(
+        pops.dslash_full_pairs(gst, pops.to_pairs(psi, jnp.bfloat16)),
+        jnp.complex64)
+    rel = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert rel < 0.02
+
+
+@pytest.fixture(scope="module")
+def api_ctx():
+    from quda_tpu.interfaces.params import GaugeParam
+    from quda_tpu.interfaces.quda_api import init_quda, load_gauge_quda
+    key = jax.random.PRNGKey(21)
+    k1, k2 = jax.random.split(key)
+    gauge = GaugeField.random(k1, GEOM).data
+    b = ColorSpinorField.gaussian(k2, GEOM).data
+    init_quda()
+    load_gauge_quda(gauge, GaugeParam(X=GEOM.lattice_shape,
+                                      cuda_prec="double"))
+    return gauge, b
+
+
+def test_invert_multishift_half_sloppy(api_ctx):
+    """Multishift with bf16 sloppy + per-shift precise polish (the TPU
+    default path via cuda_prec_sloppy='auto') reaches the tolerance on
+    every shifted system."""
+    from quda_tpu.fields.spinor import even_odd_split
+    from quda_tpu.interfaces.params import InvertParam
+    from quda_tpu.interfaces.quda_api import invert_multishift_quda
+    from quda_tpu.models.wilson import DiracWilsonPC
+    gauge, b = api_ctx
+    shifts = (0.01, 0.05, 0.2)
+    p = InvertParam(dslash_type="wilson", kappa=KAPPA, inv_type="cg",
+                    solve_type="normop-pc", tol=1e-9, maxiter=2000,
+                    cuda_prec="double", cuda_prec_sloppy="half",
+                    num_offset=len(shifts), offset=shifts)
+    xs = invert_multishift_quda(b, p)
+    dpc = DiracWilsonPC(gauge, GEOM, KAPPA)
+    be, bo = even_odd_split(b, GEOM)
+    rhs = dpc.Mdag(dpc.prepare(be, bo))
+    for i, s in enumerate(shifts):
+        r = rhs - (dpc.MdagM(xs[i]) + s * xs[i])
+        assert float(jnp.sqrt(blas.norm2(r) / blas.norm2(rhs))) < 1e-8
+    assert p.iter_count > 0
+
+
+@pytest.mark.parametrize("inv,solve", [
+    ("bicgstab", "direct-pc"),
+    ("gcr", "normop-pc"),        # inner operator must be MdagM here
+    ("cg", "normop-pc"),
+])
+def test_invert_quda_half_sloppy_branches(api_ctx, inv, solve):
+    """invert_quda with cuda_prec_sloppy='half' exercises the pair-sloppy
+    branches (cg_reliable codec path / defect-correction bicgstab+gcr),
+    including the normop case where the inner operator is MdagM."""
+    from quda_tpu.interfaces.params import InvertParam
+    from quda_tpu.interfaces.quda_api import invert_quda
+    from quda_tpu.models.wilson import DiracWilson
+    gauge, b = api_ctx
+    tol = 1e-9
+    p = InvertParam(dslash_type="wilson", kappa=KAPPA, inv_type=inv,
+                    solve_type=solve, tol=tol, maxiter=2000,
+                    cuda_prec="double", cuda_prec_sloppy="half")
+    x = invert_quda(b, p)
+    d = DiracWilson(gauge, GEOM, KAPPA)
+    r2 = blas.norm2(b - d.M(jnp.asarray(x)))
+    assert float(jnp.sqrt(r2 / blas.norm2(b))) < 10 * tol
+    assert p.true_res < 10 * tol
