@@ -1,8 +1,10 @@
 """Headline benchmark: Wilson dslash GFLOPS on one chip.
 
-Prints ONE JSON line:
+Prints ONE JSON line, e.g.:
   {"metric": "wilson_dslash_gflops_chip", "value": N, "unit": "GFLOPS",
-   "vs_baseline": N}
+   "vs_baseline": N, "platform": "axon", "lattice": [24,24,24,24],
+   "path": "xla_packed", "chain": 30, "reps": 5, "dispatch_ms": M,
+   "paths": {...per-path GFLOPS...}}
 
 Baseline: 1400 GFLOPS — the order of public A100 single-precision Wilson
 dslash results (BASELINE.md: target is "within 2x of A100", so
@@ -11,6 +13,12 @@ vs_baseline >= 0.5 meets the target).
 Flop model: 1320 flops/site (Dslash::flops(), reference include/dslash.h:475).
 Runs complex64 (TPU has no f64); the dslash is HBM-bandwidth bound so c64 is
 the honest precision to compare against single-precision GPU numbers.
+
+Paths benchmarked (best wins):
+  xla_canonical — host-order (T,Z,Y,X,4,3) roll+einsum stencil (ops/wilson.py)
+  xla_packed    — TPU-native packed order (4,3,T,Z,Y*X) unrolled stencil
+                  (ops/wilson_packed.py); pack/unpack excluded from timing,
+                  as fields stay packed across a whole solve
 """
 
 from __future__ import annotations
@@ -18,6 +26,28 @@ from __future__ import annotations
 import json
 import sys
 import time
+
+
+def _time_chain(fn, args, chain: int, reps: int) -> float:
+    """Best per-application seconds for a scan-chained fn."""
+    import jax
+
+    @jax.jit
+    def apply_chain(*a):
+        def body(v, _):
+            return fn(*a[:-1], v), None
+        out, _ = jax.lax.scan(body, a[-1], None, length=chain)
+        return out
+
+    out = apply_chain(*args)
+    out.block_until_ready()  # compile + warmup
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = apply_chain(*args)
+        out.block_until_ready()
+        best = min(best, (time.perf_counter() - t0) / chain)
+    return best
 
 
 def main():
@@ -45,7 +75,7 @@ def main():
 
     th = threading.Thread(target=_probe, daemon=True)
     th.start()
-    th.join(timeout=float(os.environ.get("QUDA_TPU_BENCH_PROBE_S", "120")))
+    th.join(timeout=float(os.environ.get("QUDA_TPU_BENCH_PROBE_S", "240")))
     if "platform" in probe:
         platform = probe["platform"]
     else:
@@ -60,67 +90,63 @@ def main():
     from quda_tpu.fields.gauge import GaugeField
     from quda_tpu.fields.spinor import ColorSpinorField
     from quda_tpu.ops import wilson as wops
+    from quda_tpu.ops import wilson_packed as wpk
     from quda_tpu.ops.boundary import apply_t_boundary
 
     # 24^4: ~64 MB spinor + 96 MB gauge at c64 — big enough to be
     # bandwidth-bound, small enough to compile fast over the tunnel.
-    L = 24 if platform != "cpu" else 8
+    L = int(os.environ.get("QUDA_TPU_BENCH_L",
+                           "24" if platform != "cpu" else "8"))
     geom = LatticeGeometry((L, L, L, L))
     key = jax.random.PRNGKey(0)
     k1, k2 = jax.random.split(key)
     gauge = apply_t_boundary(
         GaugeField.random(k1, geom, dtype=jnp.complex64).data, geom, -1)
     psi = ColorSpinorField.gaussian(k2, geom, dtype=jnp.complex64).data
+    gauge_p = wpk.pack_gauge(gauge)
+    psi_p = wpk.pack_spinor(psi)
+    for a in (gauge, psi, gauge_p, psi_p):
+        a.block_until_ready()
 
-    # autotune the stencil implementation (XLA fusion vs Pallas kernel)
-    # once; the winner is cached in $QUDA_TPU_RESOURCE_PATH
-    from quda_tpu.ops.wilson_pallas import dslash_pallas
-    from quda_tpu.utils import tune as qtune
+    # dispatch latency: a trivial jitted op, timed round-trip (attributes
+    # how much of any slow number is tunnel/executable launch overhead)
+    tiny = jax.jit(lambda x: x + 1.0)
+    t = jnp.zeros((8, 128), jnp.float32)
+    tiny(t).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(10):
+        tiny(t).block_until_ready()
+    dispatch_ms = (time.perf_counter() - t0) / 10 * 1e3
 
-    stencil = wops.dslash_full
-    if platform not in ("cpu",):
-        candidates = {
-            "xla": jax.jit(wops.dslash_full),
-            "pallas": jax.jit(lambda g, p: dslash_pallas(g, p)),
-        }
-        try:
-            winner = qtune.tune("wilson_dslash", (L, L, L, L), candidates,
-                                (gauge, psi), aux="c64")
-            stencil = {"xla": wops.dslash_full,
-                       "pallas": dslash_pallas}[winner]
-        except Exception:
-            stencil = wops.dslash_full
-
-    # steady-state form: chain dslash applications so timing covers the
-    # fused stencil, not dispatch
-    CHAIN = 10
-
-    @jax.jit
-    def apply_chain(g, p):
-        def body(v, _):
-            return stencil(g, v), None
-        out, _ = jax.lax.scan(body, p, None, length=CHAIN)
-        return out
-
-    out = apply_chain(gauge, psi)
-    out.block_until_ready()  # compile + warmup
-
-    reps = 5
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = apply_chain(gauge, psi)
-        out.block_until_ready()
-        best = min(best, (time.perf_counter() - t0) / CHAIN)
-
+    chain = int(os.environ.get("QUDA_TPU_BENCH_CHAIN", "30"))
+    reps = int(os.environ.get("QUDA_TPU_BENCH_REPS", "5"))
     flops = 1320 * geom.volume
-    gflops = flops / best / 1e9
+
+    paths = {}
+    secs = {}
+    secs["xla_canonical"] = _time_chain(
+        wops.dslash_full, (gauge, psi), chain, reps)
+    secs["xla_packed"] = _time_chain(
+        lambda g, p: wpk.dslash_packed(g, p, L, L), (gauge_p, psi_p),
+        chain, reps)
+    for name, s in secs.items():
+        paths[name] = round(flops / s / 1e9, 1)
+
+    best_path = min(secs, key=secs.get)
+    gflops = flops / secs[best_path] / 1e9
     baseline = 1400.0
     print(json.dumps({
         "metric": "wilson_dslash_gflops_chip",
         "value": round(gflops, 1),
         "unit": "GFLOPS",
         "vs_baseline": round(gflops / baseline, 3),
+        "platform": platform,
+        "lattice": [L, L, L, L],
+        "path": best_path,
+        "chain": chain,
+        "reps": reps,
+        "dispatch_ms": round(dispatch_ms, 2),
+        "paths": paths,
     }))
 
 

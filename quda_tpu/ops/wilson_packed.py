@@ -1,0 +1,242 @@
+"""Wilson dslash on the TPU-native packed field order.
+
+The canonical layout (T,Z,Y,X,4,3) is the HOST order (QUDA's QDP-like
+order).  On TPU, XLA tiles the two minormost axes to (sublane, lane) =
+(8, 128) for f32 — so trailing (4, 3) dof axes waste ~97% of every vector
+lane and inflate HBM traffic by the same factor.  This module is the
+analog of QUDA's *native* device orders (FloatN, include/gauge_field_order.h,
+include/color_spinor_field_order.h): a layout chosen for the hardware plus
+pack/unpack conversions at the boundary.
+
+Packed order:
+    spinor  (4, 3, T, Z, Y*X)    complex
+    gauge   (4, 3, 3, T, Z, Y*X) complex   [direction, row, col, ...]
+
+so the minor-two axes are (Z, Y*X): Z is a multiple of 8 for any even
+lattice, Y*X is within 11% of a 128 multiple at 24^4 and exact at 16^4 —
+near-full lane utilisation, and every spin/color component is its own
+(T,Z,YX) plane so the stencil algebra is pure elementwise VPU work.
+
+Shifts on the packed layout:
+  t, z : jnp.roll on their own axes.
+  y    : roll by X on the fused Y*X axis — EXACT including the periodic
+         wrap, because (y*X + x ± X) mod (Y*X) is the correct neighbour
+         index for every site.
+  x    : roll by 1 is correct except at the x-boundary column; a second
+         roll by (1-X) and a lane mask select fix the wrap (branch-free,
+         same trick as ops/shift.py's checkerboard masks).
+
+The spin algebra uses the derived projection tables of ops/wilson_pallas
+(project to 2 half-spinors, one 3x3 color multiply each, reconstruct) —
+1320 flops/site, matching Dslash::flops() (include/dslash.h:475; kernel
+reference include/kernels/dslash_wilson.cuh:84-162).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+from .wilson_pallas import TABLES
+
+
+# -- pack / unpack (host order <-> native order) ---------------------------
+
+def pack_spinor(psi: jnp.ndarray) -> jnp.ndarray:
+    """(T,Z,Y,X,4,3) -> (4,3,T,Z,Y*X)."""
+    T, Z, Y, X = psi.shape[:4]
+    return jnp.transpose(psi, (4, 5, 0, 1, 2, 3)).reshape(4, 3, T, Z, Y * X)
+
+
+def unpack_spinor(pp: jnp.ndarray, lattice_shape) -> jnp.ndarray:
+    T, Z, Y, X = lattice_shape
+    return jnp.transpose(pp.reshape(4, 3, T, Z, Y, X), (2, 3, 4, 5, 0, 1))
+
+
+def pack_gauge(gauge: jnp.ndarray) -> jnp.ndarray:
+    """(4,T,Z,Y,X,3,3) -> (4,3,3,T,Z,Y*X)."""
+    _, T, Z, Y, X = gauge.shape[:5]
+    return jnp.transpose(gauge, (0, 5, 6, 1, 2, 3, 4)).reshape(
+        4, 3, 3, T, Z, Y * X)
+
+
+def unpack_gauge(gp: jnp.ndarray, lattice_shape) -> jnp.ndarray:
+    T, Z, Y, X = lattice_shape
+    return jnp.transpose(gp.reshape(4, 3, 3, T, Z, Y, X),
+                         (0, 3, 4, 5, 6, 1, 2))
+
+
+# -- packed shifts ----------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _x_wrap_masks(Y: int, X: int):
+    """Lane masks (numpy, see ops/shift.py tracer-cache note) marking the
+    x-boundary columns of the fused Y*X axis."""
+    x = np.arange(Y * X) % X
+    return (x == X - 1), (x == 0)
+
+
+def shift_packed(arr: jnp.ndarray, mu: int, sign: int, X: int,
+                 Y: int) -> jnp.ndarray:
+    """result[site] = arr[site + sign * mu_hat] on packed layout; lattice
+    axes are the LAST three (T, Z, Y*X); mu = 0,1,2,3 = x,y,z,t."""
+    if mu == 3:
+        return jnp.roll(arr, -sign, axis=-3)
+    if mu == 2:
+        return jnp.roll(arr, -sign, axis=-2)
+    if mu == 1:
+        return jnp.roll(arr, -sign * X, axis=-1)
+    last, first = _x_wrap_masks(Y, X)
+    if sign > 0:
+        interior = jnp.roll(arr, -1, axis=-1)
+        wrapped = jnp.roll(arr, X - 1, axis=-1)
+        return jnp.where(jnp.asarray(last), wrapped, interior)
+    interior = jnp.roll(arr, 1, axis=-1)
+    wrapped = jnp.roll(arr, -(X - 1), axis=-1)
+    return jnp.where(jnp.asarray(first), wrapped, interior)
+
+
+# -- the stencil ------------------------------------------------------------
+
+def _hop_packed(psi_s, u, table, adjoint: bool):
+    """One direction: project -> 3x3 color multiply on 2 spins ->
+    reconstruct.  psi_s: (4,3,T,Z,YX) shifted spinor; u: (3,3,T,Z,YX).
+    Returns a length-4 list of (3,T,Z,YX) spin components (unrolled —
+    every op is elementwise over the site planes)."""
+    t = table
+    # project to half spinor h[a][b_color]
+    h = [psi_s[a] + t[f"c{a}"] * psi_s[t[f"j{a}"]] for a in (0, 1)]
+    # color multiply (u or u^dag), unrolled 3x3
+    uh = []
+    for s in (0, 1):
+        rows = []
+        for a in range(3):
+            if adjoint:
+                acc = (jnp.conjugate(u[0, a]) * h[s][0]
+                       + jnp.conjugate(u[1, a]) * h[s][1]
+                       + jnp.conjugate(u[2, a]) * h[s][2])
+            else:
+                acc = (u[a, 0] * h[s][0] + u[a, 1] * h[s][1]
+                       + u[a, 2] * h[s][2])
+            rows.append(acc)
+        uh.append(jnp.stack(rows))
+    # reconstruct spins 2,3 from the half spinor
+    return [uh[0], uh[1], t["d2"] * uh[t["k2"] ], t["d3"] * uh[t["k3"]]]
+
+
+def dslash_packed(gauge_p: jnp.ndarray, psi_p: jnp.ndarray, X: int,
+                  Y: int) -> jnp.ndarray:
+    """Wilson hop sum D psi on packed arrays.
+
+    gauge_p: (4,3,3,T,Z,Y*X) with boundary phases folded;
+    psi_p: (4,3,T,Z,Y*X).  X, Y are static ints (the fused-axis split).
+    """
+    acc = None
+    for mu in range(4):
+        u = gauge_p[mu]
+        # forward: (1 - gamma_mu) U_mu(x) psi(x+mu)
+        fwd = _hop_packed(shift_packed(psi_p, mu, +1, X, Y), u,
+                          TABLES[(mu, +1)], adjoint=False)
+        # backward: (1 + gamma_mu) U_mu(x-mu)^dag psi(x-mu)
+        ub = shift_packed(u, mu, -1, X, Y)
+        bwd = _hop_packed(shift_packed(psi_p, mu, -1, X, Y), ub,
+                          TABLES[(mu, -1)], adjoint=True)
+        term = [f + b for f, b in zip(fwd, bwd)]
+        acc = term if acc is None else [a + t for a, t in zip(acc, term)]
+    return jnp.stack(acc)
+
+
+def matvec_packed(gauge_p, psi_p, kappa: float, X: int, Y: int):
+    """M psi = psi - kappa D psi on packed arrays."""
+    return psi_p - kappa * dslash_packed(gauge_p, psi_p, X, Y)
+
+
+# ---------------------------------------------------------------------------
+# Checkerboarded (even/odd) packed stencil
+# ---------------------------------------------------------------------------
+#
+# Half-lattice packed order: (4, 3, T, Z, Y*Xh) with Xh = X//2 and the
+# same slot-parity convention as ops/shift.py: physical
+# x = 2*xh + ((t+z+y+p) % 2).  The x-direction shift needs two masks:
+# the slot-parity mask over (T, Z, Y*Xh) and the xh wrap columns.
+
+def pack_spinor_eo(psi: jnp.ndarray) -> jnp.ndarray:
+    """(T,Z,Y,Xh,4,3) -> (4,3,T,Z,Y*Xh)."""
+    return pack_spinor(psi)
+
+
+def unpack_spinor_eo(pp: jnp.ndarray, half_shape) -> jnp.ndarray:
+    return unpack_spinor(pp, half_shape)
+
+
+def pack_gauge_eo(gauge_eo) -> tuple:
+    """((4,T,Z,Y,Xh,3,3) even, odd) -> packed pair ((4,3,3,T,Z,Y*Xh) x2)."""
+    return tuple(pack_gauge(g) for g in gauge_eo)
+
+
+@lru_cache(maxsize=None)
+def _slot_mask_packed(T: int, Z: int, Y: int, Xh: int, parity: int):
+    """(T, Z, Y*Xh) numpy bool: True where the parity-p half-site occupies
+    the even x slot (r == 0) — fused-axis version of shift.py's mask."""
+    t = np.arange(T)[:, None, None]
+    z = np.arange(Z)[None, :, None]
+    y = (np.arange(Y * Xh) // Xh)[None, None, :]
+    return ((t + z + y + parity) % 2) == 0
+
+
+def shift_eo_packed(arr: jnp.ndarray, dims, mu: int, sign: int,
+                    target_parity: int) -> jnp.ndarray:
+    """Checkerboarded shift on the packed half lattice.
+
+    arr: (..., T, Z, Y*Xh) holding a parity-(1-p) field; result indexed by
+    parity-p half-sites is arr evaluated at x + sign*mu_hat.  ``dims`` is
+    the full (T, Z, Y, X).
+    """
+    T, Z, Y, X = dims
+    Xh = X // 2
+    if mu == 3:
+        return jnp.roll(arr, -sign, axis=-3)
+    if mu == 2:
+        return jnp.roll(arr, -sign, axis=-2)
+    if mu == 1:
+        return jnp.roll(arr, -sign * Xh, axis=-1)
+    # x direction: same-xh or neighbouring-xh depending on slot parity
+    last, first = _x_wrap_masks(Y, Xh)
+    if sign > 0:
+        interior = jnp.roll(arr, -1, axis=-1)
+        wrapped = jnp.roll(arr, Xh - 1, axis=-1)
+        moved = jnp.where(jnp.asarray(last), wrapped, interior)
+    else:
+        interior = jnp.roll(arr, 1, axis=-1)
+        wrapped = jnp.roll(arr, -(Xh - 1), axis=-1)
+        moved = jnp.where(jnp.asarray(first), wrapped, interior)
+    mask_r0 = jnp.asarray(_slot_mask_packed(T, Z, Y, Xh, target_parity))
+    if sign > 0:
+        return jnp.where(mask_r0, arr, moved)
+    return jnp.where(mask_r0, moved, arr)
+
+
+def dslash_eo_packed(gauge_eo_p, psi_p: jnp.ndarray, dims,
+                     target_parity: int) -> jnp.ndarray:
+    """Checkerboarded Wilson hop on packed half-lattice arrays (mirrors
+    ops/wilson.dslash_eo).
+
+    gauge_eo_p: (even_p, odd_p) packed half-site links; psi_p of parity
+    1-p; result indexed by parity-p sites.
+    """
+    u_here = gauge_eo_p[target_parity]
+    u_there = gauge_eo_p[1 - target_parity]
+    acc = None
+    for mu in range(4):
+        fwd = _hop_packed(
+            shift_eo_packed(psi_p, dims, mu, +1, target_parity),
+            u_here[mu], TABLES[(mu, +1)], adjoint=False)
+        ub = shift_eo_packed(u_there[mu], dims, mu, -1, target_parity)
+        bwd = _hop_packed(
+            shift_eo_packed(psi_p, dims, mu, -1, target_parity),
+            ub, TABLES[(mu, -1)], adjoint=True)
+        term = [f + b for f, b in zip(fwd, bwd)]
+        acc = term if acc is None else [a + t for a, t in zip(acc, term)]
+    return jnp.stack(acc)
