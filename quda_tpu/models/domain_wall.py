@@ -140,3 +140,96 @@ class DiracMobiusPC(DiracPC):
         t = self._hop_to(apply_sop(self.s_m5p, x_p), 1 - p)
         x_q = apply_sop(self.s_m5i, b_q + 0.5 * t)
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+
+# ---------------------------------------------------------------------------
+# Möbius EOFA (exact one-flavor algorithm)
+# ---------------------------------------------------------------------------
+
+def eofa_rank_one(ls: int, b5: float, c5: float, m5: float,
+                  mq1: float, mq2: float, mq3: float, eofa_pm: bool,
+                  eofa_shift: float):
+    """EOFA rank-one s-space correction in this module's normalisation.
+
+    Reference math: lib/dirac_mobius.cpp:460-520 (DiracMobiusEofa ctor) —
+    the u-vector of the one-flavor shift term Delta_pm = u (x) e_j on the
+    pm chirality (j = Ls-1 for plus, 0 for minus).  QUDA's m5 is the
+    negative of ours, so its (m5 + 4) is our dw_diag = 4 - m5; QUDA's
+    kernel operator is ours divided by alpha = b5*dw_diag + 1, so the
+    correction enters our M5 block scaled by alpha.  QUDA's eofa_x/eofa_y
+    Sherman-Morrison closed-form inverse (include/kernels/
+    dslash_mobius_eofa.cuh:232 eofa_dslash5inv) is unnecessary here: the
+    (Ls,Ls) chirality blocks are inverted densely.
+    """
+    import numpy as np
+    dw = 4.0 - m5
+    al = b5 + c5
+    eofa_norm = (al * (mq3 - mq2) * (al + 1.0) ** (2 * ls)
+                 / ((al + 1.0) ** ls + mq2 * (al - 1.0) ** ls)
+                 / ((al + 1.0) ** ls + mq3 * (al - 1.0) ** ls))
+    N = ((+1.0 if eofa_pm else -1.0) * (2.0 * eofa_shift * eofa_norm)
+         * ((al + 1.0) ** ls + mq1 * (al - 1.0) ** ls) / (b5 * dw + 1.0))
+    u = np.zeros(ls)
+    for s in range(ls):
+        u[s if eofa_pm else ls - 1 - s] = (
+            N * (-1.0) ** s * (al - 1.0) ** s / (al + 1.0) ** (ls + s + 1))
+    alpha_m5 = b5 * dw + 1.0
+    rank1 = np.zeros((ls, ls))
+    j = ls - 1 if eofa_pm else 0
+    rank1[:, j] = alpha_m5 * u
+    return rank1
+
+
+def _eofa_corrected_m5(obj, ls, b5, c5, m5, mf, mq1, mq2, mq3, eofa_pm,
+                       eofa_shift) -> SOp:
+    """Shared EOFA setup: default the mq's to mf, record the eofa params
+    on ``obj``, and return obj.s_m5 with the rank-one correction added on
+    the eofa_pm chirality block."""
+    mq1 = mf if mq1 is None else mq1
+    mq2 = mf if mq2 is None else mq2
+    mq3 = mf if mq3 is None else mq3
+    obj.eofa_pm = eofa_pm
+    obj.eofa_shift = eofa_shift
+    r1 = eofa_rank_one(ls, b5, c5, m5, mq1, mq2, mq3, eofa_pm, eofa_shift)
+    if eofa_pm:
+        return SOp(obj.s_m5.ap + r1, obj.s_m5.am)
+    return SOp(obj.s_m5.ap, obj.s_m5.am + r1)
+
+
+class DiracMobiusEofa(DiracMobius):
+    """Full Möbius EOFA operator: Möbius at mass mf plus the one-flavor
+    rank-one shift term on the eofa_pm chirality.
+
+    Reference behavior: lib/dirac_mobius.cpp:546 (DiracMobiusEofa::M =
+    M5_EOFA - kappa_b D4 D5pre), kernel include/kernels/
+    dslash_mobius_eofa.cuh:154-168 (M5_EOFA = M5 + u (x) e_j P_pm).
+    """
+
+    def __init__(self, gauge, geom, ls, m5, mf, b5=1.0, c5=0.0,
+                 mq1=None, mq2=None, mq3=None, eofa_pm=True,
+                 eofa_shift=0.0, antiperiodic_t=True):
+        super().__init__(gauge, geom, ls, m5, mf, b5, c5, antiperiodic_t)
+        self.s_m5 = _eofa_corrected_m5(self, ls, b5, c5, m5, mf, mq1, mq2,
+                                       mq3, eofa_pm, eofa_shift)
+        # M() / Mdag() of DiracMobius use self.s_m5 — nothing else changes
+
+
+class DiracMobiusEofaPC(DiracMobiusPC):
+    """4d-even/odd preconditioned Möbius EOFA (symmetric form).
+
+    Reference behavior: lib/dirac_mobius.cpp:626-704 — the Möbius PC
+    composition with every M5 / M5^{-1} replaced by the EOFA-corrected
+    block; QUDA's m5inv_eofa Sherman-Morrison kernel becomes a dense
+    inverse of the corrected chirality blocks.
+    """
+
+    def __init__(self, gauge, geom, ls, m5, mf, b5=1.0, c5=0.0,
+                 mq1=None, mq2=None, mq3=None, eofa_pm=True,
+                 eofa_shift=0.0, antiperiodic_t=True,
+                 matpc: int = MATPC_EVEN_EVEN):
+        super().__init__(gauge, geom, ls, m5, mf, b5, c5, antiperiodic_t,
+                         matpc)
+        self.s_m5 = _eofa_corrected_m5(self, ls, b5, c5, m5, mf, mq1, mq2,
+                                       mq3, eofa_pm, eofa_shift)
+        self.s_m5i = self.s_m5.inv()
+        self.s_mix = self.s_m5p @ self.s_m5i

@@ -9,7 +9,9 @@ from quda_tpu.fields.geometry import EVEN, ODD, LatticeGeometry
 from quda_tpu.fields.spinor import ColorSpinorField, even_odd_join, even_odd_split
 from quda_tpu.fields.gauge import GaugeField
 from quda_tpu.models.domain_wall import (DiracDomainWall, DiracMobius,
-                                         DiracMobiusPC)
+                                         DiracMobiusEofa,
+                                         DiracMobiusEofaPC, DiracMobiusPC,
+                                         eofa_rank_one)
 from quda_tpu.ops import blas
 from quda_tpu.ops.dwf import apply_sop, identity_sop, m5_sop
 from quda_tpu.solvers.cg import cg
@@ -98,3 +100,99 @@ def test_shamir_class(cfg):
     d1 = DiracDomainWall(gauge, GEOM, LS, M5, MF)
     d2 = DiracMobius(gauge, GEOM, LS, M5, MF, 1.0, 0.0)
     assert np.allclose(np.asarray(d1.M(psi)), np.asarray(d2.M(psi)))
+
+
+# -- Möbius EOFA (lib/dirac_mobius.cpp:460, dslash_mobius_eofa.cuh) --------
+
+EOFA_KW = dict(mq1=0.04, mq2=0.5, mq3=1.0, eofa_shift=0.3)
+
+
+def test_eofa_shift_zero_is_mobius(cfg):
+    gauge, psi = cfg
+    d0 = DiracMobius(gauge, GEOM, LS, M5, MF, B5, C5)
+    de = DiracMobiusEofa(gauge, GEOM, LS, M5, MF, B5, C5,
+                         mq1=0.04, mq2=0.5, mq3=1.0, eofa_shift=0.0)
+    assert np.allclose(np.asarray(d0.M(psi)), np.asarray(de.M(psi)))
+
+
+def test_eofa_mq2_eq_mq3_vanishes():
+    """eofa_norm carries (mq3 - mq2): equal masses -> no correction."""
+    r1 = eofa_rank_one(LS, B5, C5, M5, 0.04, 0.7, 0.7, True, 0.3)
+    assert np.allclose(r1, 0.0)
+    r1b = eofa_rank_one(LS, B5, C5, M5, 0.04, 0.5, 1.0, True, 0.3)
+    assert np.abs(r1b).max() > 0
+
+
+@pytest.mark.parametrize("pm", [True, False])
+def test_eofa_rank_one_structure(pm):
+    """The correction is a single column on the pm chirality block
+    (kernel: out += 0.5 u[s] P_pm psi(pm ? Ls-1 : 0))."""
+    r1 = eofa_rank_one(LS, B5, C5, M5, 0.04, 0.5, 1.0, pm, 0.3)
+    j = LS - 1 if pm else 0
+    mask = np.zeros((LS, LS), bool)
+    mask[:, j] = True
+    assert np.all(r1[~mask] == 0.0)
+    assert np.abs(r1[:, j]).max() > 0
+
+
+@pytest.mark.parametrize("pm", [True, False])
+def test_eofa_mdag_adjointness(cfg, pm):
+    gauge, psi = cfg
+    d = DiracMobiusEofa(gauge, GEOM, LS, M5, MF, B5, C5, eofa_pm=pm,
+                        **EOFA_KW)
+    chi = jnp.stack([
+        ColorSpinorField.gaussian(jax.random.PRNGKey(500 + s), GEOM).data
+        for s in range(LS)])
+    lhs = blas.cdot(chi, d.M(psi))
+    rhs = jnp.conjugate(blas.cdot(psi, d.Mdag(chi)))
+    assert np.allclose(complex(lhs), complex(rhs), atol=1e-10)
+
+
+@pytest.mark.parametrize("pm", [True, False])
+def test_eofa_pc_solve_matches_full(cfg, pm):
+    """prepare -> PC normal-equation CG -> reconstruct solves the FULL
+    EOFA system (the same consistency contract as plain Möbius PC)."""
+    gauge, psi = cfg
+    d = DiracMobiusEofa(gauge, GEOM, LS, M5, MF, B5, C5, eofa_pm=pm,
+                        **EOFA_KW)
+    dpc = DiracMobiusEofaPC(gauge, GEOM, LS, M5, MF, B5, C5, eofa_pm=pm,
+                            **EOFA_KW)
+    be = jax.vmap(lambda v: even_odd_split(v, GEOM)[0])(psi)
+    bo = jax.vmap(lambda v: even_odd_split(v, GEOM)[1])(psi)
+    b_pc = dpc.prepare(be, bo)
+    res = cg(lambda v: dpc.Mdag(dpc.M(v)), dpc.Mdag(b_pc), tol=1e-11,
+             maxiter=4000)
+    assert bool(res.converged)
+    xe, xo = dpc.reconstruct(res.x, be, bo)
+    x = jax.vmap(lambda e, o: even_odd_join(e, o, GEOM))(xe, xo)
+    rel = float(jnp.sqrt(blas.norm2(psi - d.M(x)) / blas.norm2(psi)))
+    assert rel < 1e-8
+
+
+def test_eofa_through_api():
+    """invert_quda with dslash_type='mobius-eofa' solves the full EOFA
+    system through prepare/PC-solve/reconstruct."""
+    from quda_tpu.interfaces.params import GaugeParam, InvertParam
+    from quda_tpu.interfaces.quda_api import init_quda, invert_quda, \
+        load_gauge_quda
+    key = jax.random.PRNGKey(77)
+    k1, k2 = jax.random.split(key)
+    gauge = GaugeField.random(k1, GEOM).data
+    b = jnp.stack([
+        ColorSpinorField.gaussian(jax.random.fold_in(k2, s), GEOM).data
+        for s in range(LS)])
+    init_quda()
+    load_gauge_quda(gauge, GaugeParam(X=GEOM.lattice_shape,
+                                      cuda_prec="double"))
+    p = InvertParam(dslash_type="mobius-eofa", mass=MF, m5=-M5, Ls=LS,
+                    b5=B5, c5=C5, eofa_pm=False, eofa_shift=0.2,
+                    eofa_mq1=MF, eofa_mq2=0.5, eofa_mq3=1.0,
+                    inv_type="cg", solve_type="normop-pc", tol=1e-10,
+                    maxiter=4000, cuda_prec="double",
+                    cuda_prec_sloppy="single")
+    x = invert_quda(b, p)
+    d = DiracMobiusEofa(gauge, GEOM, LS, M5, MF, B5, C5, mq1=MF, mq2=0.5,
+                        mq3=1.0, eofa_pm=False, eofa_shift=0.2)
+    rel = float(jnp.sqrt(blas.norm2(b - d.M(jnp.asarray(x)))
+                         / blas.norm2(b)))
+    assert rel < 1e-8
