@@ -14,9 +14,9 @@ from typing import Optional, Sequence, Tuple
 
 # enum value sets (enum_quda.h analogs)
 DSLASH_TYPES = ("wilson", "clover", "twisted-mass", "twisted-clover",
-                "ndeg-twisted-mass", "staggered", "asqtad", "hisq",
-                "domain-wall", "domain-wall-4d", "mobius", "mobius-eofa",
-                "laplace")
+                "ndeg-twisted-mass", "ndeg-twisted-clover", "staggered",
+                "asqtad", "hisq", "domain-wall", "domain-wall-4d", "mobius",
+                "mobius-eofa", "laplace")
 INVERTER_TYPES = ("cg", "cg3", "cgne", "cgnr", "pcg", "bicgstab",
                   "bicgstab-l", "gcr", "mr", "sd", "ca-cg", "ca-gcr",
                   "multi-shift-cg", "gcr-mg")

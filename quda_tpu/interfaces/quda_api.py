@@ -108,8 +108,17 @@ def _build_dirac(p: InvertParam, pc: bool):
                 else mtw.DiracTwistedClover(g, geom, p.kappa, p.mu, p.csw,
                                             ap))
     if t == "ndeg-twisted-mass":
-        return mtw.DiracNdegTwistedMass(g, geom, p.kappa, p.mu, p.epsilon,
-                                        ap)
+        return (mtw.DiracNdegTwistedMassPC(g, geom, p.kappa, p.mu,
+                                           p.epsilon, ap, matpc)
+                if pc else
+                mtw.DiracNdegTwistedMass(g, geom, p.kappa, p.mu, p.epsilon,
+                                         ap))
+    if t == "ndeg-twisted-clover":
+        return (mtw.DiracNdegTwistedCloverPC(g, geom, p.kappa, p.mu,
+                                             p.epsilon, p.csw, ap, matpc)
+                if pc else
+                mtw.DiracNdegTwistedClover(g, geom, p.kappa, p.mu,
+                                           p.epsilon, p.csw, ap))
     if t in ("staggered", "asqtad", "hisq"):
         improved = t != "staggered"
         fat = _ctx["fat"] if improved else g
@@ -125,6 +134,11 @@ def _build_dirac(p: InvertParam, pc: bool):
         b5, c5 = (1.0, 0.0) if t != "mobius" else (p.b5, p.c5)
         m5 = -p.m5  # QUDA passes m5 negative
         if pc:
+            if t == "domain-wall":
+                # QUDA convention: plain "domain-wall" preconditions with
+                # the 5-d checkerboard (lib/dirac_domain_wall.cpp:124)
+                return mdw.DiracDomainWall5DPC(g, geom, p.Ls, m5, p.mass,
+                                               ap, matpc)
             return mdw.DiracMobiusPC(g, geom, p.Ls, m5, p.mass, b5, c5, ap,
                                      matpc)
         return mdw.DiracMobius(g, geom, p.Ls, m5, p.mass, b5, c5, ap)
@@ -157,8 +171,10 @@ def _build_dirac(p: InvertParam, pc: bool):
 _DWF_TYPES = ("domain-wall", "domain-wall-4d", "mobius", "mobius-eofa")
 
 
-def _split(b, p):
+def _split(b, p, d=None):
     geom = _ctx["geom"]
+    if d is not None and hasattr(d, "split5"):
+        return d.split5(b)      # 5d checkerboard (slice-aligned layout)
     if p.dslash_type in _DWF_TYPES:
         be = jax.vmap(lambda v: even_odd_split(v, geom)[0])(b)
         bo = jax.vmap(lambda v: even_odd_split(v, geom)[1])(b)
@@ -166,8 +182,10 @@ def _split(b, p):
     return even_odd_split(b, geom)
 
 
-def _join(xe, xo, p):
+def _join(xe, xo, p, d=None):
     geom = _ctx["geom"]
+    if d is not None and hasattr(d, "join5"):
+        return d.join5(xe, xo)
     if p.dslash_type in _DWF_TYPES:
         return jax.vmap(lambda e, o: even_odd_join(e, o, geom))(xe, xo)
     return even_odd_join(xe, xo, geom)
@@ -221,7 +239,7 @@ def invert_quda(source, param: InvertParam):
     d_full = _build_dirac(param, False)
 
     if pc:
-        be, bo = _split(b, param)
+        be, bo = _split(b, param, d)
         rhs = d.prepare(be, bo)
     else:
         rhs = b
@@ -343,7 +361,7 @@ def invert_quda(source, param: InvertParam):
     x_sys = back(res.x)
     if pc:
         xe, xo = d.reconstruct(x_sys, be, bo)
-        x_full = _join(xe, xo, param)
+        x_full = _join(xe, xo, param, d)
     else:
         x_full = x_sys
 
@@ -421,7 +439,7 @@ def invert_multishift_quda(source, param: InvertParam):
     from ..solvers.multishift import multishift_cg
     b = jnp.asarray(source, complex_dtype(param.cuda_prec))
     d = _build_dirac(param, True)
-    be, bo = _split(b, param)
+    be, bo = _split(b, param, d)
     rhs = d.prepare(be, bo)
     if getattr(d, "hermitian", False):
         mv = d.M
@@ -493,6 +511,9 @@ def eigensolve_quda(eig_param: EigParamAPI, invert_param: InvertParam):
     shape = (geom.half_lattice_shape if pc else geom.lattice_shape) + (4, 3)
     if invert_param.dslash_type in ("staggered", "asqtad", "hisq"):
         shape = shape[:-2] + (1, 3)
+    if invert_param.dslash_type in ("ndeg-twisted-mass",
+                                    "ndeg-twisted-clover"):
+        shape = shape[:-2] + (2, 4, 3)   # flavor doublet axis
     if invert_param.dslash_type in _DWF_TYPES:
         shape = (invert_param.Ls,) + shape
     example = jnp.zeros(shape, dtype)
@@ -622,6 +643,30 @@ def compute_gauge_force_quda(beta: float, c1: float = 0.0):
     act = (lambda u: wilson_action(u, beta)) if c1 == 0.0 else \
         (lambda u: improved_action(u, beta, c1))
     return gauge_force(act, _ctx["gauge"])
+
+
+def compute_gauge_force_paths_quda(mom, input_path_buf, loop_coeff,
+                                   dt: float):
+    """computeGaugeForceQuda (quda.h:1393): arbitrary user path tables.
+
+    input_path_buf[mu][i] = i-th path (MILC encoding, backward = 7-mu)
+    completing a loop with the initial U_mu; loop_coeff the per-path
+    coefficients.  Returns mom - dt * F with F the su(3)-projected force
+    of the path action (AD; staple math of gauge_force.cuh subsumed).
+    """
+    from ..gauge.paths import gauge_path_force
+    _require_init()
+    f = gauge_path_force(_ctx["gauge"], input_path_buf, loop_coeff)
+    return jnp.asarray(mom) - dt * f
+
+
+def gauge_loop_trace_quda(paths, coeffs, factor: float = 1.0):
+    """gaugeLoopTraceQuda (quda.h:1420, lib/gauge_loop_trace.cu:74):
+    returns one complex trace per loop, factor * c_i * sum_x tr W_i(x),
+    as a (num_paths,) array (matching the C API's traces[] output)."""
+    from ..gauge.paths import gauge_loop_trace
+    _require_init()
+    return factor * gauge_loop_trace(_ctx["gauge"], paths, coeffs)
 
 
 def update_gauge_field_quda(mom, dt: float, reunitarize: bool = True):

@@ -233,3 +233,131 @@ class DiracMobiusEofaPC(DiracMobiusPC):
                                        mq3, eofa_pm, eofa_shift)
         self.s_m5i = self.s_m5.inv()
         self.s_mix = self.s_m5p @ self.s_m5i
+
+
+# ---------------------------------------------------------------------------
+# 5d-preconditioned (Shamir) domain wall
+# ---------------------------------------------------------------------------
+
+class DiracDomainWall5DPC(DiracPC):
+    """5d-even/odd preconditioned Shamir domain wall.
+
+    Reference behavior: lib/dirac_domain_wall.cpp:124-176 and
+    lib/dslash_domain_wall_5d.cu (QUDA_5D_PC coords): the checkerboard
+    parity includes the 5th coordinate, so BOTH the 4-d hops and the
+    s-hops flip parity and the single hop operator
+
+        D_5d = hop4 + 2 (P_- S^-(mf) + P_+ S^+(mf))
+
+    appears in a standard Schur complement M_pc = 1 - kappa5^2 D_eo D_oe,
+    kappa5 = 1/(2(5 - m5)) (our m5 sign; QUDA's 0.5/(5 + m5)).
+
+    Layout: a 5d-parity-p field is stored (Ls, T, Z, Y, X//2, 4, 3) where
+    slice s holds the 4d-parity (p + s) % 2 half-lattice in the standard
+    checkerboard slot convention — s-neighbours of the other 5d parity
+    then share the slot layout, so the s-hop is elementwise.
+    """
+
+    g5_hermitian = False
+
+    def __init__(self, gauge: jnp.ndarray, geom: LatticeGeometry, ls: int,
+                 m5: float, mf: float, antiperiodic_t: bool = True,
+                 matpc: int = MATPC_EVEN_EVEN):
+        self.geom = geom
+        self.ls = ls
+        self.mf = mf
+        self.matpc = matpc
+        self.kappa5 = 0.5 / (5.0 - m5)
+        self.m5 = m5
+        g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.gauge_eo = wops.split_gauge_eo(g, geom)
+
+    @staticmethod
+    def _p_minus(v):
+        """(1 - gamma5)/2 v: lower chirality (spins 2,3)."""
+        return v.at[..., 0:2, :].set(0.0)
+
+    @staticmethod
+    def _p_plus(v):
+        return v.at[..., 2:4, :].set(0.0)
+
+    def _shop(self, psi5, swap_pm: bool):
+        """2 (P_- S^- + P_+ S^+) psi (swap_pm: the adjoint's P-swap)."""
+        ls, mf = self.ls, self.mf
+        up = jnp.roll(psi5, -1, axis=0)    # psi(s+1)
+        dn = jnp.roll(psi5, +1, axis=0)    # psi(s-1)
+        wrap_up = jnp.asarray([1.0] * (ls - 1) + [-mf], psi5.real.dtype)
+        wrap_dn = jnp.asarray([-mf] + [1.0] * (ls - 1), psi5.real.dtype)
+        sh = (1,) * 0 + (ls,) + (1,) * (psi5.ndim - 1)
+        up = up * wrap_up.reshape(sh).astype(psi5.dtype)
+        dn = dn * wrap_dn.reshape(sh).astype(psi5.dtype)
+        if swap_pm:
+            return 2.0 * (self._p_plus(up) + self._p_minus(dn))
+        return 2.0 * (self._p_minus(up) + self._p_plus(dn))
+
+    def _hop4(self, psi5, target_p5: int):
+        outs = [wops.dslash_eo(self.gauge_eo, psi5[s], self.geom,
+                               (target_p5 + s) % 2)
+                for s in range(self.ls)]
+        return jnp.stack(outs)
+
+    def D_to(self, psi5, target_p5: int):
+        """D_5d from 5d-parity (1-p) to p."""
+        return self._hop4(psi5, target_p5) + self._shop(psi5, False)
+
+    def _Ddag_to(self, chi5, target_p5: int):
+        g5 = jnp.asarray([1.0, 1.0, -1.0, -1.0], chi5.real.dtype)
+        g5 = g5[:, None].astype(chi5.dtype)
+        h4 = g5 * self._hop4(g5 * chi5, target_p5)
+        return h4 + self._shop(chi5, True)
+
+    def M(self, x_p):
+        p = self.matpc
+        return x_p - (self.kappa5 ** 2) * self.D_to(
+            self.D_to(x_p, 1 - p), p)
+
+    def Mdag(self, x_p):
+        p = self.matpc
+        return x_p - (self.kappa5 ** 2) * self._Ddag_to(
+            self._Ddag_to(x_p, 1 - p), p)
+
+    # -- full-system interface (fields (Ls,T,Z,Y,X,4,3)) ----------------
+    def split5(self, psi5_full):
+        """Full 5d field -> (even5, odd5) in the slice-aligned layout."""
+        from ..fields.spinor import even_odd_split
+        ev, od = [], []
+        for s in range(self.ls):
+            e4, o4 = even_odd_split(psi5_full[s], self.geom)
+            if s % 2 == 0:
+                ev.append(e4)
+                od.append(o4)
+            else:
+                ev.append(o4)
+                od.append(e4)
+        return jnp.stack(ev), jnp.stack(od)
+
+    def join5(self, x_even5, x_odd5):
+        from ..fields.spinor import even_odd_join
+        outs = []
+        for s in range(self.ls):
+            if s % 2 == 0:
+                outs.append(even_odd_join(x_even5[s], x_odd5[s], self.geom))
+            else:
+                outs.append(even_odd_join(x_odd5[s], x_even5[s], self.geom))
+        return jnp.stack(outs)
+
+    def prepare(self, b_even5, b_odd5):
+        """Schur rhs for the normalised system (1 - kappa5 D) x = b/(5-m5):
+        src = b_p/(5-m5) + kappa5 D_pq b_q/(5-m5)."""
+        p = self.matpc
+        b_p, b_q = ((b_even5, b_odd5) if p == EVEN
+                    else (b_odd5, b_even5))
+        scale = 1.0 / (5.0 - self.m5)
+        return scale * (b_p + self.kappa5 * self.D_to(b_q, p))
+
+    def reconstruct(self, x_p, b_even5, b_odd5):
+        p = self.matpc
+        b_q = b_odd5 if p == EVEN else b_even5
+        scale = 1.0 / (5.0 - self.m5)
+        x_q = scale * b_q + self.kappa5 * self.D_to(x_p, 1 - p)
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)

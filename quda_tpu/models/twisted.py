@@ -254,3 +254,205 @@ class DiracTwistedCloverPC(DiracPC):
         b_q = b_odd if p == EVEN else b_even
         x_q = self._Ainv_q(b_q + self.kappa * self.D_to(x_p, 1 - p))
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+
+class DiracNdegTwistedClover(Dirac):
+    """Non-degenerate twisted clover on flavor-doublet fields
+    (T,Z,Y,X,2,4,3):  M = (A + i a g5 tau3 - b tau1) - kappa D.
+
+    Reference behavior: lib/dirac_twisted_clover.cpp (ndeg path) and
+    lib/dslash_ndeg_twisted_clover.cu — the clover term A is flavor
+    diagonal; the twist is +i a g5 on the up flavor, -i a g5 on down;
+    -b tau1 swaps flavors.
+    """
+
+    g5_hermitian = False
+
+    def __init__(self, gauge: jnp.ndarray, geom: LatticeGeometry,
+                 kappa: float, mu: float, epsilon: float, csw: float,
+                 antiperiodic_t: bool = True):
+        self.geom = geom
+        self.kappa = kappa
+        self.a = 2.0 * kappa * mu
+        self.b = 2.0 * kappa * epsilon
+        self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.clover = clover_blocks(gauge, kappa * csw / 2.0)
+
+    def D(self, psi):
+        out = jnp.stack([wops.dslash_full(self.gauge, psi[..., f, :, :])
+                         for f in range(2)])
+        return jnp.moveaxis(out, 0, 4)
+
+    def _diag(self, psi, sign=+1):
+        up = psi[..., 0, :, :]
+        dn = psi[..., 1, :, :]
+        up_out = (apply_clover(self.clover, up)
+                  + (1j * sign * self.a) * apply_gamma5(up) - self.b * dn)
+        dn_out = (apply_clover(self.clover, dn)
+                  - (1j * sign * self.a) * apply_gamma5(dn) - self.b * up)
+        return jnp.stack([up_out, dn_out], axis=-3)
+
+    def M(self, psi):
+        return self._diag(psi) - self.kappa * self.D(psi)
+
+    def Mdag(self, psi):
+        # M(mu)^dag = g5 M(-mu) g5 flavor-wise (A Hermitian, tau1 real)
+        d5 = apply_gamma5(self.D(apply_gamma5(psi)))
+        return self._diag(psi, -1) - self.kappa * d5
+
+
+class DiracNdegTwistedCloverPC(DiracPC):
+    """Even/odd preconditioned non-degenerate twisted clover (asymmetric):
+
+        M_pc = Diag_p - kappa^2 D Diag_q^{-1} D
+
+    with Diag = A + i a g5 tau3 - b tau1.  Because A commutes with g5
+    (both chirality-block structured) the flavor 2x2 inverse closes over
+    commuting 6x6 blocks:
+
+        Diag^{-1} = [[A_s - i s a, b], [b, A_s + i s a]] (A_s^2 + a^2 - b^2)^{-1}
+
+    per chirality s = +-1 — batched 6x6 inverses instead of QUDA's
+    Cholesky-on-A^dag-A kernels (lib/clover_invert.cu ndeg path).
+    """
+
+    g5_hermitian = False
+
+    def __init__(self, gauge: jnp.ndarray, geom: LatticeGeometry,
+                 kappa: float, mu: float, epsilon: float, csw: float,
+                 antiperiodic_t: bool = True, matpc: int = MATPC_EVEN_EVEN):
+        self.geom = geom
+        self.kappa = kappa
+        self.a = 2.0 * kappa * mu
+        self.b = 2.0 * kappa * epsilon
+        self.matpc = matpc
+        g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.gauge_eo = wops.split_gauge_eo(g, geom)
+        blocks = clover_blocks(gauge, kappa * csw / 2.0)
+        a_e, a_o = even_odd_split(blocks, geom)
+        self.clover = (a_e, a_o)
+        q = 1 - matpc
+        aq = self.clover[q]
+        eye = jnp.eye(6, dtype=aq.dtype)
+        denom = (jnp.einsum("...ij,...jk->...ik", aq, aq)
+                 + (self.a ** 2 - self.b ** 2) * eye)
+        self.dinv_q = jnp.linalg.inv(denom)
+
+    def D_to(self, psi, target_parity):
+        out = jnp.stack([
+            wops.dslash_eo(self.gauge_eo, psi[..., f, :, :], self.geom,
+                           target_parity) for f in range(2)])
+        return jnp.moveaxis(out, 0, 4)
+
+    def _diag_p(self, x, sign=+1):
+        up = x[..., 0, :, :]
+        dn = x[..., 1, :, :]
+        ap = self.clover[self.matpc]
+        up_out = (apply_clover(ap, up)
+                  + (1j * sign * self.a) * apply_gamma5(up) - self.b * dn)
+        dn_out = (apply_clover(ap, dn)
+                  - (1j * sign * self.a) * apply_gamma5(dn) - self.b * up)
+        return jnp.stack([up_out, dn_out], axis=-3)
+
+    def _diag_inv_q(self, x, sign=+1):
+        """Apply Diag_q^{-1}(sign * a) to a flavor-doublet parity field."""
+        aq = self.clover[1 - self.matpc]
+        up = x[..., 0, :, :]
+        dn = x[..., 1, :, :]
+        # numerator: [[A - i s a g5, b], [b, A + i s a g5]]
+        nu = (apply_clover(aq, up)
+              - (1j * sign * self.a) * apply_gamma5(up) + self.b * dn)
+        nd = (self.b * up + apply_clover(aq, dn)
+              + (1j * sign * self.a) * apply_gamma5(dn))
+        out = jnp.stack([apply_clover(self.dinv_q, nu),
+                         apply_clover(self.dinv_q, nd)], axis=-3)
+        return out
+
+    def _M_sign(self, x_p, sign):
+        p = self.matpc
+        tmp = self._diag_inv_q(self.D_to(x_p, 1 - p), sign)
+        return self._diag_p(x_p, sign) - (self.kappa ** 2) * self.D_to(tmp, p)
+
+    def M(self, x_p):
+        return self._M_sign(x_p, +1)
+
+    def Mdag(self, x_p):
+        return apply_gamma5(self._M_sign(apply_gamma5(x_p), -1))
+
+    def prepare(self, b_even, b_odd):
+        p = self.matpc
+        b_p, b_q = (b_even, b_odd) if p == EVEN else (b_odd, b_even)
+        return b_p + self.kappa * self.D_to(self._diag_inv_q(b_q), p)
+
+    def reconstruct(self, x_p, b_even, b_odd):
+        p = self.matpc
+        b_q = b_odd if p == EVEN else b_even
+        x_q = self._diag_inv_q(b_q + self.kappa * self.D_to(x_p, 1 - p))
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+
+class DiracNdegTwistedMassPC(DiracPC):
+    """Even/odd preconditioned non-degenerate twisted mass (asymmetric):
+    the flavor-diagonal inverse is closed-form elementwise,
+
+        Diag^{-1} = [[1 - i a g5, b], [b, 1 + i a g5]] / (1 + a^2 - b^2)
+
+    (lib/dslash_ndeg_twisted_mass_preconditioned.cu behavior; no clover
+    machinery needed)."""
+
+    g5_hermitian = False
+
+    def __init__(self, gauge: jnp.ndarray, geom: LatticeGeometry,
+                 kappa: float, mu: float, epsilon: float,
+                 antiperiodic_t: bool = True, matpc: int = MATPC_EVEN_EVEN):
+        self.geom = geom
+        self.kappa = kappa
+        self.a = 2.0 * kappa * mu
+        self.b = 2.0 * kappa * epsilon
+        self.matpc = matpc
+        g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.gauge_eo = wops.split_gauge_eo(g, geom)
+
+    def D_to(self, psi, target_parity):
+        out = jnp.stack([
+            wops.dslash_eo(self.gauge_eo, psi[..., f, :, :], self.geom,
+                           target_parity) for f in range(2)])
+        return jnp.moveaxis(out, 0, 4)
+
+    def _diag(self, x, sign=+1):
+        up = x[..., 0, :, :]
+        dn = x[..., 1, :, :]
+        return jnp.stack(
+            [up + (1j * sign * self.a) * apply_gamma5(up) - self.b * dn,
+             dn - (1j * sign * self.a) * apply_gamma5(dn) - self.b * up],
+            axis=-3)
+
+    def _diag_inv(self, x, sign=+1):
+        up = x[..., 0, :, :]
+        dn = x[..., 1, :, :]
+        det = 1.0 + self.a ** 2 - self.b ** 2
+        nu = up - (1j * sign * self.a) * apply_gamma5(up) + self.b * dn
+        nd = self.b * up + dn + (1j * sign * self.a) * apply_gamma5(dn)
+        return jnp.stack([nu, nd], axis=-3) / det
+
+    def _M_sign(self, x_p, sign):
+        p = self.matpc
+        tmp = self._diag_inv(self.D_to(x_p, 1 - p), sign)
+        return self._diag(x_p, sign) - (self.kappa ** 2) * self.D_to(tmp, p)
+
+    def M(self, x_p):
+        return self._M_sign(x_p, +1)
+
+    def Mdag(self, x_p):
+        return apply_gamma5(self._M_sign(apply_gamma5(x_p), -1))
+
+    def prepare(self, b_even, b_odd):
+        p = self.matpc
+        b_p, b_q = (b_even, b_odd) if p == EVEN else (b_odd, b_even)
+        return b_p + self.kappa * self.D_to(self._diag_inv(b_q), p)
+
+    def reconstruct(self, x_p, b_even, b_odd):
+        p = self.matpc
+        b_q = b_odd if p == EVEN else b_even
+        x_q = self._diag_inv(b_q + self.kappa * self.D_to(x_p, 1 - p))
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)

@@ -1,0 +1,51 @@
+"""Host reference for spin-taste phases: the literal case table of
+include/kernels/spin_taste.cuh transcribed as a site loop (independent of
+the XOR-mask construction used by quda_tpu.ops.spin_taste)."""
+
+import numpy as np
+
+
+def sign_table(gamma_bits: int, lattice_shape):
+    """(T,Z,Y,X) array of +-1; x[0..3] = (x,y,z,t) per the kernel."""
+    T, Z, Y, X = lattice_shape
+    out = np.ones((T, Z, Y, X))
+    for t in range(T):
+        for z in range(Z):
+            for y in range(Y):
+                for x in range(X):
+                    c = [x, y, z, t]
+                    g = gamma_bits
+                    if g == 1:
+                        s = (c[1] + c[2] + c[3]) % 2
+                    elif g == 2:
+                        s = (c[0] + c[2] + c[3]) % 2
+                    elif g == 4:
+                        s = (c[0] + c[1] + c[3]) % 2
+                    elif g == 8:
+                        s = (c[0] + c[1] + c[2]) % 2
+                    elif g == 15:
+                        s = (c[0] + c[1] + c[2] + c[3]) % 2
+                    elif g == 6:
+                        s = (c[1] + c[2]) % 2
+                    elif g == 5:
+                        s = (c[2] + c[0]) % 2
+                    elif g == 3:
+                        s = (c[0] + c[1]) % 2
+                    elif g == 9:
+                        s = (c[0] + c[3]) % 2
+                    elif g == 10:
+                        s = (c[1] + c[3]) % 2
+                    elif g == 12:
+                        s = (c[2] + c[3]) % 2
+                    elif g == 14:
+                        s = c[0] % 2
+                    elif g == 13:
+                        s = c[1] % 2
+                    elif g == 11:
+                        s = c[2] % 2
+                    elif g == 7:
+                        s = c[3] % 2
+                    else:
+                        s = 0
+                    out[t, z, y, x] = 1.0 - 2.0 * s
+    return out

@@ -137,3 +137,118 @@ def test_pc_adjoint(cfg):
     lhs = blas.cdot(ce, dpc.M(pe))
     rhs = jnp.conjugate(blas.cdot(pe, dpc.Mdag(ce)))
     assert np.allclose(complex(lhs), complex(rhs), atol=1e-10)
+
+
+# -- non-degenerate twisted clover (lib/dslash_ndeg_twisted_clover*.cu) ----
+
+def _doublet(key):
+    k1, k2 = jax.random.split(key)
+    up = ColorSpinorField.gaussian(k1, GEOM).data
+    dn = ColorSpinorField.gaussian(k2, GEOM).data
+    return jnp.stack([up, dn], axis=-3)
+
+
+def test_ndeg_tc_eps_zero_is_two_twisted_clovers(cfg):
+    """epsilon=0 decouples the doublet into TC(+mu) and TC(-mu)."""
+    from quda_tpu.models.twisted import DiracNdegTwistedClover
+    gauge, _ = cfg
+    psi = _doublet(jax.random.PRNGKey(90))
+    d = DiracNdegTwistedClover(gauge, GEOM, KAPPA, MU, 0.0, CSW)
+    up_ref = DiracTwistedClover(gauge, GEOM, KAPPA, MU, CSW).M(
+        psi[..., 0, :, :])
+    dn_ref = DiracTwistedClover(gauge, GEOM, KAPPA, -MU, CSW).M(
+        psi[..., 1, :, :])
+    out = d.M(psi)
+    assert np.allclose(np.asarray(out[..., 0, :, :]), np.asarray(up_ref))
+    assert np.allclose(np.asarray(out[..., 1, :, :]), np.asarray(dn_ref))
+
+
+def test_ndeg_tc_csw_zero_is_ndeg_twisted_mass(cfg):
+    from quda_tpu.models.twisted import DiracNdegTwistedClover
+    gauge, _ = cfg
+    psi = _doublet(jax.random.PRNGKey(91))
+    d0 = DiracNdegTwistedClover(gauge, GEOM, KAPPA, MU, EPS, 0.0)
+    dref = DiracNdegTwistedMass(gauge, GEOM, KAPPA, MU, EPS)
+    assert np.allclose(np.asarray(d0.M(psi)), np.asarray(dref.M(psi)),
+                       atol=1e-12)
+
+
+def test_ndeg_tc_adjoint(cfg):
+    from quda_tpu.models.twisted import DiracNdegTwistedClover
+    gauge, _ = cfg
+    psi = _doublet(jax.random.PRNGKey(92))
+    chi = _doublet(jax.random.PRNGKey(93))
+    d = DiracNdegTwistedClover(gauge, GEOM, KAPPA, MU, EPS, CSW)
+    lhs = blas.cdot(chi, d.M(psi))
+    rhs = jnp.conjugate(blas.cdot(psi, d.Mdag(chi)))
+    assert np.allclose(complex(lhs), complex(rhs), atol=1e-10)
+
+
+@pytest.mark.parametrize("matpc", [EVEN, ODD])
+def test_ndeg_tc_pc_solve_matches_full(cfg, matpc):
+    from quda_tpu.models.twisted import (DiracNdegTwistedClover,
+                                         DiracNdegTwistedCloverPC)
+    gauge, _ = cfg
+    b = _doublet(jax.random.PRNGKey(94))
+    d = DiracNdegTwistedClover(gauge, GEOM, KAPPA, MU, EPS, CSW)
+    dpc = DiracNdegTwistedCloverPC(gauge, GEOM, KAPPA, MU, EPS, CSW,
+                                   matpc=matpc)
+    sp = lambda v, par: jnp.stack(
+        [even_odd_split(v[..., f, :, :], GEOM)[par] for f in range(2)],
+        axis=-3)
+    be, bo = sp(b, 0), sp(b, 1)
+    b_pc = dpc.prepare(be, bo)
+    res = cg(lambda v: dpc.Mdag(dpc.M(v)), dpc.Mdag(b_pc), tol=1e-11,
+             maxiter=4000)
+    assert bool(res.converged)
+    xe, xo = dpc.reconstruct(res.x, be, bo)
+    x = jnp.stack([
+        even_odd_join(xe[..., f, :, :], xo[..., f, :, :], GEOM)
+        for f in range(2)], axis=-3)
+    rel = float(jnp.sqrt(blas.norm2(b - d.M(x)) / blas.norm2(b)))
+    assert rel < 1e-8
+
+
+def test_ndeg_tc_pc_adjoint(cfg):
+    from quda_tpu.models.twisted import DiracNdegTwistedCloverPC
+    gauge, _ = cfg
+    dpc = DiracNdegTwistedCloverPC(gauge, GEOM, KAPPA, MU, EPS, CSW)
+    sp = lambda v: jnp.stack(
+        [even_odd_split(v[..., f, :, :], GEOM)[0] for f in range(2)],
+        axis=-3)
+    pe = sp(_doublet(jax.random.PRNGKey(95)))
+    ce = sp(_doublet(jax.random.PRNGKey(96)))
+    lhs = blas.cdot(ce, dpc.M(pe))
+    rhs = jnp.conjugate(blas.cdot(pe, dpc.Mdag(ce)))
+    assert np.allclose(complex(lhs), complex(rhs), atol=1e-10)
+
+
+@pytest.mark.parametrize("matpc", [EVEN, ODD])
+def test_ndeg_tm_pc_solve_matches_full(cfg, matpc):
+    """Dedicated ndeg twisted-mass PC (closed-form twist inverse) solves
+    the full doublet system, and equals the csw=0 clover-PC route."""
+    from quda_tpu.models.twisted import (DiracNdegTwistedCloverPC,
+                                         DiracNdegTwistedMassPC)
+    gauge, _ = cfg
+    b = _doublet(jax.random.PRNGKey(97))
+    d = DiracNdegTwistedMass(gauge, GEOM, KAPPA, MU, EPS)
+    dpc = DiracNdegTwistedMassPC(gauge, GEOM, KAPPA, MU, EPS, matpc=matpc)
+    sp = lambda v, par: jnp.stack(
+        [even_odd_split(v[..., f, :, :], GEOM)[par] for f in range(2)],
+        axis=-3)
+    be, bo = sp(b, 0), sp(b, 1)
+    res = cg(lambda v: dpc.Mdag(dpc.M(v)), dpc.Mdag(dpc.prepare(be, bo)),
+             tol=1e-11, maxiter=4000)
+    assert bool(res.converged)
+    xe, xo = dpc.reconstruct(res.x, be, bo)
+    x = jnp.stack([
+        even_odd_join(xe[..., f, :, :], xo[..., f, :, :], GEOM)
+        for f in range(2)], axis=-3)
+    rel = float(jnp.sqrt(blas.norm2(b - d.M(x)) / blas.norm2(b)))
+    assert rel < 1e-8
+    # the M applications agree with the csw=0 clover-PC implementation
+    dref = DiracNdegTwistedCloverPC(gauge, GEOM, KAPPA, MU, EPS, 0.0,
+                                    matpc=matpc)
+    v = dpc.prepare(be, bo)
+    assert np.allclose(np.asarray(dpc.M(v)), np.asarray(dref.M(v)),
+                       atol=1e-11)
