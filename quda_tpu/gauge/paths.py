@@ -1,0 +1,124 @@
+"""Generic Wilson-line path tables: loop traces, path actions, and forces.
+
+Reference behavior: include/gauge_path_helper.cuh:88 (computeGaugePath —
+walk a direction list, forwards d<4 multiplies U_d at the current offset,
+backwards d>=4 steps back then multiplies U(7-d)^dag), kernels
+gauge_force.cuh:100 / gauge_loop_trace.cuh:84, drivers lib/gauge_force.cu
+and lib/gauge_loop_trace.cu, API computeGaugeForceQuda /
+computeGaugePathQuda / gaugeLoopTraceQuda (include/quda.h:1393-1420).
+
+TPU-native: the per-thread walk becomes whole-lattice link products with
+jnp.roll shifts (one shifted link array per step), and the FORCE comes
+from jax.grad of the path action with su(3) (traceless anti-Hermitian)
+projection — the hand-derived staple insertions of gauge_force.cuh are
+unnecessary, while the API semantics (arbitrary user path tables, MILC /
+Chroma style) are preserved.
+
+Path encoding (QUDA/MILC): entries 0,1,2,3 step forward in x,y,z,t; the
+backward step along direction mu is encoded as 7 - mu (so 7,6,5,4 =
+backward x,y,z,t).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.shift import shift
+from ..ops.su3 import dagger, mat_mul, trace
+
+
+def _shift_by(arr: jnp.ndarray, disp) -> jnp.ndarray:
+    """Shift so result(x) = arr(x + disp), disp in mu units (x,y,z,t)."""
+    out = arr
+    for mu, n in enumerate(disp):
+        if n:
+            out = shift(out, mu, +1 if n > 0 else -1, nhop=abs(n))
+    return out
+
+
+def wilson_line(gauge: jnp.ndarray, path: Sequence[int],
+                start_disp=(0, 0, 0, 0)):
+    """Product of links along ``path`` starting at x + start_disp.
+
+    Returns (W, end_disp): W(x) is the (3,3) product at every site;
+    end_disp the net displacement (for closure checks).
+    """
+    disp = list(start_disp)
+    W = None
+    for d in path:
+        d = int(d)
+        if d < 4:
+            link = _shift_by(gauge[d], disp)
+            W = link if W is None else mat_mul(W, link)
+            disp[d] += 1
+        else:
+            mu = 7 - d
+            disp[mu] -= 1
+            link = dagger(_shift_by(gauge[mu], disp))
+            W = link if W is None else mat_mul(W, link)
+    if W is None:
+        eye = jnp.eye(3, dtype=gauge.dtype)
+        W = jnp.broadcast_to(eye, gauge.shape[1:])
+    return W, tuple(disp)
+
+
+def gauge_loop_trace(gauge: jnp.ndarray, paths: Sequence[Sequence[int]],
+                     coeffs: Sequence[float]):
+    """Per-path volume-summed traces c_i sum_x tr W_i(x)
+    (gaugeLoopTraceQuda, lib/gauge_loop_trace.cu:74, which returns one
+    complex trace per loop).  Returns a (num_paths,) complex array."""
+    out = []
+    for path, c in zip(paths, coeffs):
+        W, disp = wilson_line(gauge, path)
+        if any(disp):
+            raise ValueError(f"path {path} does not close: {disp}")
+        out.append(c * jnp.sum(trace(W)))
+    return jnp.stack(out)
+
+
+def gauge_path_action(gauge: jnp.ndarray,
+                      input_path_buf: Sequence[Sequence[Sequence[int]]],
+                      coeffs: Sequence[float]):
+    """S = sum_mu sum_i c_i sum_x Re tr[U_mu(x) P_i^mu(x + mu)].
+
+    ``input_path_buf[mu][i]`` is the i-th path for direction mu in the
+    computeGaugeForceQuda input format (the path starts at x + mu, i.e.
+    pre-shifted by the initial link, gauge_force.cuh:76 ``dx[dir]++``).
+    """
+    s = 0.0
+    for mu in range(4):
+        start = [0, 0, 0, 0]
+        start[mu] = 1
+        for path, c in zip(input_path_buf[mu], coeffs):
+            W, _ = wilson_line(gauge, path, start)
+            s = s + c * jnp.sum(trace(mat_mul(gauge[mu], W)).real)
+    return s
+
+
+def gauge_path_force(gauge: jnp.ndarray, input_path_buf, coeffs):
+    """su(3)-projected force of the path action (the makeAntiHerm'd
+    staple sum of gauge_force.cuh, via AD — see gauge/action.py force
+    conventions)."""
+    from .action import gauge_force
+    return gauge_force(
+        lambda g: gauge_path_action(g, input_path_buf, coeffs), gauge)
+
+
+def plaquette_paths():
+    """The 6-staple table of the Wilson action for each direction
+    (the standard computeGaugeForceQuda input for beta/3 coefficients)."""
+    buf = []
+    for mu in range(4):
+        paths_mu = []
+        for nu in range(4):
+            if nu == mu:
+                continue
+            # forward staple: nu, mu-back, nu-back
+            paths_mu.append([nu, 7 - mu, 7 - nu])
+            # backward staple: nu-back, mu-back, nu
+            paths_mu.append([7 - nu, 7 - mu, nu])
+        buf.append(paths_mu)
+    return buf
