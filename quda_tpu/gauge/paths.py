@@ -45,23 +45,31 @@ def wilson_line(gauge: jnp.ndarray, path: Sequence[int],
 
     Returns (W, end_disp): W(x) is the (3,3) product at every site;
     end_disp the net displacement (for closure checks).
+
+    Built tail-first — W_k(x) = L_k(x) @ W_{k+1}(x + step_k) — so each
+    step costs ONE whole-lattice shift of the partial product (O(length)
+    rolls total, not O(length^2) as origin-relative shifting would).
     """
-    disp = list(start_disp)
+    disp = [0, 0, 0, 0]
     W = None
-    for d in path:
-        d = int(d)
+    for d in reversed([int(d) for d in path]):
         if d < 4:
-            link = _shift_by(gauge[d], disp)
-            W = link if W is None else mat_mul(W, link)
+            if W is not None:
+                W = shift(W, d, +1)
+            link = gauge[d]
             disp[d] += 1
         else:
             mu = 7 - d
+            if W is not None:
+                W = shift(W, mu, -1)
+            link = dagger(shift(gauge[mu], mu, -1))
             disp[mu] -= 1
-            link = dagger(_shift_by(gauge[mu], disp))
-            W = link if W is None else mat_mul(W, link)
+        W = link if W is None else mat_mul(link, W)
     if W is None:
         eye = jnp.eye(3, dtype=gauge.dtype)
         W = jnp.broadcast_to(eye, gauge.shape[1:])
+    if any(start_disp):
+        W = _shift_by(W, start_disp)
     return W, tuple(disp)
 
 
@@ -70,10 +78,15 @@ def gauge_loop_trace(gauge: jnp.ndarray, paths: Sequence[Sequence[int]],
     """Per-path volume-summed traces c_i sum_x tr W_i(x)
     (gaugeLoopTraceQuda, lib/gauge_loop_trace.cu:74, which returns one
     complex trace per loop).  Returns a (num_paths,) complex array."""
+    if len(paths) != len(coeffs):
+        raise ValueError(f"{len(paths)} paths but {len(coeffs)} coeffs")
+    # extents in mu order (x,y,z,t) from (4,T,Z,Y,X,3,3)
+    ext = (gauge.shape[4], gauge.shape[3], gauge.shape[2], gauge.shape[1])
     out = []
     for path, c in zip(paths, coeffs):
         W, disp = wilson_line(gauge, path)
-        if any(disp):
+        if any(d % e for d, e in zip(disp, ext)):
+            # loops may close through the torus (Polyakov lines)
             raise ValueError(f"path {path} does not close: {disp}")
         out.append(c * jnp.sum(trace(W)))
     return jnp.stack(out)
@@ -90,6 +103,10 @@ def gauge_path_action(gauge: jnp.ndarray,
     """
     s = 0.0
     for mu in range(4):
+        if len(input_path_buf[mu]) != len(coeffs):
+            raise ValueError(
+                f"dir {mu}: {len(input_path_buf[mu])} paths but "
+                f"{len(coeffs)} coeffs")
         start = [0, 0, 0, 0]
         start[mu] = 1
         for path, c in zip(input_path_buf[mu], coeffs):

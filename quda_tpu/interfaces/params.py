@@ -39,6 +39,9 @@ class GaugeParam:
     t_boundary: str = "antiperiodic"               # periodic|antiperiodic
     cpu_prec: str = "double"
     cuda_prec: str = "double"                      # device precision
+    # host layout of the array passed to load_gauge_quda
+    # (QudaGaugeFieldOrder: canonical | qdp | milc | cps)
+    gauge_order: str = "canonical"
     reconstruct: int = 18
     anisotropy: float = 1.0
     tadpole_coeff: float = 1.0
@@ -51,6 +54,8 @@ class GaugeParam:
         _check(self.t_boundary in ("periodic", "antiperiodic"),
                f"bad t_boundary {self.t_boundary}")
         _check(self.cuda_prec in PRECISIONS, f"bad prec {self.cuda_prec}")
+        _check(self.gauge_order in ("canonical", "qdp", "milc", "cps"),
+               f"bad gauge_order {self.gauge_order}")
         return self
 
     def describe(self) -> str:

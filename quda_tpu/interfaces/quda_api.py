@@ -59,6 +59,13 @@ def load_gauge_quda(gauge, param: GaugeParam):
     param.validate()
     geom = LatticeGeometry(tuple(param.X))
     dtype = complex_dtype(param.cuda_prec)
+    if param.gauge_order != "canonical":
+        from ..utils import host_order as ho
+        conv = {"qdp": ho.gauge_from_qdp, "milc": ho.gauge_from_milc}
+        if param.gauge_order == "cps":
+            gauge = ho.gauge_from_cps(gauge, geom, param.anisotropy)
+        else:
+            gauge = conv[param.gauge_order](gauge, geom)
     g = jnp.asarray(gauge, dtype)
     if g.shape != (4,) + geom.lattice_shape + (3, 3):
         qlog.errorq(f"gauge shape {g.shape} != expected for {param.X}")
@@ -635,6 +642,41 @@ def load_fat_long_quda(fat, long_links):
     dtype = _ctx["gauge"].dtype if _ctx["gauge"] is not None else None
     _ctx["fat"] = jnp.asarray(fat, dtype)
     _ctx["long"] = jnp.asarray(long_links, dtype)
+
+
+def save_gauge_field_quda(path: str, precision: int = 64):
+    """Write the resident gauge as a SciDAC/ILDG lime file
+    (lib/qio_field.cpp write path analog).  The anisotropy folded in at
+    load time is UNDONE so the file holds the original links (QUDA
+    saveGaugeQuda semantics)."""
+    from ..utils.lime import save_gauge_lime
+    _require_init()
+    if _ctx["gauge"] is None:
+        qlog.errorq("no resident gauge to save")
+    g = _ctx["gauge"]
+    gp = _ctx["gauge_param"]
+    if gp is not None and gp.anisotropy != 1.0:
+        scale = jnp.ones((4, 1, 1, 1, 1, 1, 1), g.real.dtype)
+        scale = scale.at[:3].set(gp.anisotropy)
+        g = g * scale.astype(g.dtype)
+    save_gauge_lime(path, g, _ctx["geom"], precision=precision)
+
+
+def load_gauge_field_quda(path: str, param: GaugeParam = None):
+    """Read a SciDAC/ILDG lime file and make it the resident gauge
+    (lib/qio_field.cpp read path analog).  Returns the gauge array.
+
+    The caller's param is copied, its X replaced by the file geometry,
+    and gauge_order forced canonical (file data is always canonical)."""
+    import dataclasses
+
+    from ..utils.lime import load_gauge_lime
+    _require_init()
+    gauge, meta = load_gauge_lime(path)
+    gp = dataclasses.replace(param or GaugeParam(), X=meta["dims"],
+                             gauge_order="canonical")
+    load_gauge_quda(gauge, gp)
+    return gauge
 
 
 def compute_gauge_force_quda(beta: float, c1: float = 0.0):

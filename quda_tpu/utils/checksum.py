@@ -12,13 +12,12 @@ import zlib
 import numpy as np
 
 
-def gauge_checksum(gauge) -> dict:
-    """ILDG-style (suma, sumb) over per-site CRC32s."""
-    g = np.asarray(gauge)
-    # site-major copy: (T,Z,Y,X, mu,3,3)
-    site = np.ascontiguousarray(np.moveaxis(g, 0, 4))
-    T, Z, Y, X = site.shape[:4]
-    flat = site.reshape(T * Z * Y * X, -1)
+def site_crc_pair(site_rows: np.ndarray):
+    """QIO/ILDG combination rule over per-site byte rows: (suma, sumb)
+    with suma ^= rotl32(crc_r, r % 29), sumb ^= rotl32(crc_r, r % 31),
+    r the lexicographic site rank (x fastest).  The single source of the
+    rule — lime.py's scidac-checksum records use it too."""
+    flat = np.ascontiguousarray(site_rows)
     suma = 0
     sumb = 0
     for rank in range(flat.shape[0]):
@@ -27,4 +26,14 @@ def gauge_checksum(gauge) -> dict:
         r31 = rank % 31
         suma ^= ((crc << r29) | (crc >> (32 - r29))) & 0xFFFFFFFF
         sumb ^= ((crc << r31) | (crc >> (32 - r31))) & 0xFFFFFFFF
-    return {"suma": suma & 0xFFFFFFFF, "sumb": sumb & 0xFFFFFFFF}
+    return suma & 0xFFFFFFFF, sumb & 0xFFFFFFFF
+
+
+def gauge_checksum(gauge) -> dict:
+    """ILDG-style (suma, sumb) over per-site CRC32s."""
+    g = np.asarray(gauge)
+    # site-major copy: (T,Z,Y,X, mu,3,3)
+    site = np.ascontiguousarray(np.moveaxis(g, 0, 4))
+    T, Z, Y, X = site.shape[:4]
+    suma, sumb = site_crc_pair(site.reshape(T * Z * Y * X, -1))
+    return {"suma": suma, "sumb": sumb}

@@ -1,0 +1,126 @@
+"""Host (application) field orders <-> canonical device layout.
+
+Reference behavior: the host-order accessors of
+include/gauge_field_order.h (QDPOrder:1852, MILCOrder:1948, CPSOrder:2068)
+and include/color_spinor_field_order.h (SpaceSpinorColorOrder:1608 — the
+QDP convention, SpaceColorSpinorOrder:1524 — CPS/QLA).  These are what
+loadGaugeQuda / invertQuda accept from MILC, Chroma(QDP) and CPS.
+
+Common structure: host fields use EVEN-ODD site ordering — all even
+sites then all odd, each ordered lexicographically with x fastest; the
+checkerboard index is (((t*Z + z)*Y + y)*X + x) // 2.
+
+Per-site data:
+  QDP gauge:   4 separate per-direction arrays, each [2][volCB][3][3]
+               row-major (row = "to" color index as in canonical).
+  MILC gauge:  one array [2][volCB][4][3][3] (dirs interleaved per site).
+  CPS gauge:   like MILC but the 3x3 is TRANSPOSED (column-major) and
+               scaled by the anisotropy.
+  QDP spinor:  [2][volCB][4 spin][3 color].
+  CPS spinor:  [2][volCB][3 color][4 spin].
+
+Canonical layout here: gauge (4,T,Z,Y,X,3,3), spinor (T,Z,Y,X,4,3).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields.geometry import LatticeGeometry
+
+
+@lru_cache(maxsize=None)
+def _eo_site_perm(geom: LatticeGeometry):
+    """Permutation: even-odd host rank -> lexicographic site rank.
+
+    perm[k] = lexicographic rank of the k-th host-ordered site (host
+    order = all even sites then all odd, x fastest within each)."""
+    T, Z, Y, X = geom.lattice_shape
+    t, z, y, x = np.meshgrid(np.arange(T), np.arange(Z), np.arange(Y),
+                             np.arange(X), indexing="ij")
+    parity = ((t + z + y + x) % 2).reshape(-1)
+    lex = np.arange(geom.volume)
+    return np.concatenate([lex[parity == 0], lex[parity == 1]])
+
+
+def _to_host_sites(arr_lex: np.ndarray, geom) -> np.ndarray:
+    """(volume, ...) lexicographic -> even-odd host ordering."""
+    return arr_lex[_eo_site_perm(geom)]
+
+
+def _from_host_sites(arr_host: np.ndarray, geom) -> np.ndarray:
+    perm = _eo_site_perm(geom)
+    out = np.empty_like(arr_host)
+    out[perm] = arr_host
+    return out
+
+
+# -- gauge ------------------------------------------------------------------
+
+def gauge_to_qdp(gauge, geom: LatticeGeometry):
+    """canonical (4,T,Z,Y,X,3,3) -> list of 4 arrays [2*volCB, 3, 3]."""
+    g = np.asarray(gauge)
+    out = []
+    for mu in range(4):
+        lex = g[mu].reshape(geom.volume, 3, 3)
+        out.append(_to_host_sites(lex, geom))
+    return out
+
+
+def gauge_from_qdp(arrays, geom: LatticeGeometry):
+    g = np.stack([
+        _from_host_sites(np.asarray(a).reshape(geom.volume, 3, 3), geom)
+        for a in arrays])
+    return jnp.asarray(g.reshape((4,) + geom.lattice_shape + (3, 3)))
+
+
+def gauge_to_milc(gauge, geom: LatticeGeometry):
+    """canonical -> [2*volCB, 4, 3, 3] (MILCOrder site-major dirs)."""
+    g = np.asarray(gauge)
+    lex = np.moveaxis(g, 0, 4).reshape(geom.volume, 4, 3, 3)
+    return _to_host_sites(lex, geom)
+
+
+def gauge_from_milc(array, geom: LatticeGeometry):
+    lex = _from_host_sites(
+        np.asarray(array).reshape(geom.volume, 4, 3, 3), geom)
+    full = lex.reshape(geom.lattice_shape + (4, 3, 3))
+    return jnp.asarray(np.moveaxis(full, 4, 0))
+
+
+def gauge_to_cps(gauge, geom: LatticeGeometry, anisotropy: float = 1.0):
+    """canonical -> CPS order: MILC layout with transposed 3x3 scaled by
+    the anisotropy (gauge_field_order.h CPSOrder::save)."""
+    m = gauge_to_milc(gauge, geom)
+    return np.swapaxes(m, -1, -2) * anisotropy
+
+
+def gauge_from_cps(array, geom: LatticeGeometry, anisotropy: float = 1.0):
+    a = np.swapaxes(np.asarray(array), -1, -2) / anisotropy
+    return gauge_from_milc(a, geom)
+
+
+# -- color spinors ----------------------------------------------------------
+
+def spinor_to_qdp(psi, geom: LatticeGeometry):
+    """canonical (T,Z,Y,X,4,3) -> [2*volCB, 4, 3] (SpaceSpinorColor)."""
+    lex = np.asarray(psi).reshape(geom.volume, 4, 3)
+    return _to_host_sites(lex, geom)
+
+
+def spinor_from_qdp(array, geom: LatticeGeometry):
+    lex = _from_host_sites(np.asarray(array).reshape(geom.volume, 4, 3),
+                           geom)
+    return jnp.asarray(lex.reshape(geom.lattice_shape + (4, 3)))
+
+
+def spinor_to_cps(psi, geom: LatticeGeometry):
+    """canonical -> [2*volCB, 3, 4] (SpaceColorSpinor)."""
+    return np.swapaxes(spinor_to_qdp(psi, geom), -1, -2)
+
+
+def spinor_from_cps(array, geom: LatticeGeometry):
+    return spinor_from_qdp(np.swapaxes(np.asarray(array), -1, -2), geom)

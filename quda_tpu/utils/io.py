@@ -54,12 +54,9 @@ def load_field(path: str, verify: bool = True):
 def save_gauge_ildg(path: str, gauge, geom: LatticeGeometry):
     """(4,T,Z,Y,X,3,3) -> ILDG binary: site-major (t slowest, x fastest),
     per site mu=0..3 (x,y,z,t), row-major 3x3, big-endian complex128."""
-    g = np.asarray(gauge).astype(np.complex128)
-    # (T,Z,Y,X,mu,3,3)
-    site_major = np.moveaxis(g, 0, 4)
-    be = site_major.astype(">c16")
+    from .lime import _gauge_to_ildg_bytes
     with open(path, "wb") as fh:
-        fh.write(be.tobytes())
+        fh.write(_gauge_to_ildg_bytes(gauge, 64).tobytes())
     side = {"dims": list(geom.dims), "checksum": gauge_checksum(gauge)}
     with open(path + ".meta.json", "w") as fh:
         json.dump(side, fh)

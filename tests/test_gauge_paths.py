@@ -90,3 +90,18 @@ def test_random_path_force_matches_finite_difference(gauge):
     # dS/dt = 2 tr(Q F) summed (force convention of gauge/action.py)
     ds_ad = 2.0 * float(jnp.sum(trace(mm(q, f)).real))
     assert np.isclose(ds_fd, ds_ad, rtol=1e-5, atol=1e-7)
+
+
+def test_polyakov_loop_closes_through_torus(gauge):
+    """Straight T-direction line of full extent is a valid loop
+    (closure via periodicity, gaugeLoopTraceQuda computes it)."""
+    T = gauge.shape[1]
+    tr = gauge_loop_trace(gauge, [[3] * T], [1.0])
+    assert np.isfinite(complex(tr[0]).real)
+
+
+def test_path_coeff_length_mismatch_raises(gauge):
+    with pytest.raises(ValueError):
+        gauge_loop_trace(gauge, [[0, 1, 7, 6], [0, 3, 7, 4]], [1.0])
+    with pytest.raises(ValueError):
+        gauge_path_action(gauge, plaquette_paths(), [1.0] * 5)

@@ -1,0 +1,174 @@
+"""Format-true I/O: LIME/SciDAC/ILDG containers + host field orders."""
+
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.geometry import LatticeGeometry
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.fields.spinor import ColorSpinorField
+from quda_tpu.ops import blas
+from quda_tpu.utils import host_order as ho
+from quda_tpu.utils.lime import (find_record, load_gauge_lime,
+                                 load_spinor_lime, read_lime,
+                                 save_gauge_lime, save_spinor_lime,
+                                 scidac_checksum, write_lime)
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+
+
+@pytest.fixture(scope="module")
+def gauge():
+    return GaugeField.random(jax.random.PRNGKey(71), GEOM).data
+
+
+def test_lime_record_framing(tmp_path):
+    p = str(tmp_path / "t.lime")
+    recs = [("first-type", b"hello"), ("second-type", b"x" * 13)]
+    write_lime(p, recs)
+    # header structure: magic/version/flags/length/type, 8-byte padding
+    raw = open(p, "rb").read()
+    magic, ver, flags, length = struct.unpack(">IHHQ", raw[:16])
+    assert magic == 0x456789AB and ver == 1 and length == 5
+    assert flags & (1 << 15)                      # MB on first record
+    assert raw[16:144].rstrip(b"\0") == b"first-type"
+    assert len(raw) == 144 + 8 + 144 + 16         # padded data
+    got = read_lime(p)
+    assert got == recs
+
+
+@pytest.mark.parametrize("precision", [64, 32])
+def test_gauge_lime_round_trip(tmp_path, gauge, precision):
+    p = str(tmp_path / "cfg.lime")
+    save_gauge_lime(p, gauge, GEOM, precision=precision)
+    g2, meta = load_gauge_lime(p)
+    assert meta["dims"] == GEOM.dims
+    assert meta["precision"] == precision
+    tol = 1e-14 if precision == 64 else 1e-6
+    err = float(jnp.sqrt(blas.norm2(gauge - g2) / blas.norm2(gauge)))
+    assert err < tol
+
+
+def test_gauge_lime_has_community_records(tmp_path, gauge):
+    """The file carries the record set QIO/ILDG tools expect."""
+    p = str(tmp_path / "cfg.lime")
+    save_gauge_lime(p, gauge, GEOM)
+    types = [t for t, _ in read_lime(p)]
+    for want in ("scidac-private-file-xml", "ildg-format",
+                 "ildg-binary-data", "scidac-checksum"):
+        assert want in types, types
+    fmt = find_record(read_lime(p), "ildg-format")
+    assert b"su3gauge" in fmt and b"<lx>4</lx>" in fmt
+
+
+def test_gauge_lime_checksum_detects_corruption(tmp_path, gauge):
+    p = str(tmp_path / "cfg.lime")
+    save_gauge_lime(p, gauge, GEOM)
+    raw = bytearray(open(p, "rb").read())
+    # flip one byte inside the binary payload (well past the headers)
+    raw[4000] ^= 0xFF
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(IOError):
+        load_gauge_lime(p)
+
+
+def test_load_external_minimal_ildg(tmp_path, gauge):
+    """A minimal 2-record ILDG file (format + binary only, as some
+    community tools write) still loads."""
+    from quda_tpu.utils.lime import (_gauge_to_ildg_bytes,
+                                     _ildg_format_xml)
+    p = str(tmp_path / "ext.lime")
+    write_lime(p, [
+        ("ildg-format", _ildg_format_xml(GEOM, 64)),
+        ("ildg-binary-data", _gauge_to_ildg_bytes(gauge, 64).tobytes()),
+    ])
+    g2, meta = load_gauge_lime(p)
+    assert np.allclose(np.asarray(g2), np.asarray(gauge))
+
+
+def test_spinor_lime_round_trip(tmp_path):
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(5), GEOM).data
+    p = str(tmp_path / "prop.lime")
+    save_spinor_lime(p, psi, GEOM)
+    psi2, meta = load_spinor_lime(p)
+    assert meta["spins"] == 4
+    assert np.allclose(np.asarray(psi2), np.asarray(psi))
+
+
+def test_scidac_checksum_rotation_rule():
+    """Pin the QIO combination rule on a tiny crafted input."""
+    import zlib
+    sites = np.arange(3 * 4, dtype=np.uint8).reshape(3, 4)
+    suma, sumb = scidac_checksum(sites)
+    ea = eb = 0
+    for r in range(3):
+        crc = zlib.crc32(sites[r].tobytes()) & 0xFFFFFFFF
+        ea ^= ((crc << (r % 29)) | (crc >> (32 - (r % 29)))) & 0xFFFFFFFF
+        eb ^= ((crc << (r % 31)) | (crc >> (32 - (r % 31)))) & 0xFFFFFFFF
+    assert (suma, sumb) == (ea, eb)
+
+
+# -- host orders ------------------------------------------------------------
+
+def test_qdp_milc_cps_gauge_round_trips(gauge):
+    q = ho.gauge_to_qdp(gauge, GEOM)
+    assert len(q) == 4 and q[0].shape == (GEOM.volume, 3, 3)
+    assert np.allclose(np.asarray(ho.gauge_from_qdp(q, GEOM)),
+                       np.asarray(gauge))
+    m = ho.gauge_to_milc(gauge, GEOM)
+    assert m.shape == (GEOM.volume, 4, 3, 3)
+    assert np.allclose(np.asarray(ho.gauge_from_milc(m, GEOM)),
+                       np.asarray(gauge))
+    c = ho.gauge_to_cps(gauge, GEOM, anisotropy=2.5)
+    assert np.allclose(np.asarray(ho.gauge_from_cps(c, GEOM, 2.5)),
+                       np.asarray(gauge))
+
+
+def test_eo_ordering_structure(gauge):
+    """First half of a MILC-order array is the even sites: site 0 is the
+    origin, site 1 is (x=2,...) — not (x=1), which is odd."""
+    m = ho.gauge_to_milc(gauge, GEOM)
+    g = np.asarray(gauge)
+    assert np.allclose(m[0], g[:, 0, 0, 0, 0])          # origin (even)
+    assert np.allclose(m[1], g[:, 0, 0, 0, 2])          # x=2 (even)
+    assert np.allclose(m[GEOM.volume // 2], g[:, 0, 0, 0, 1])  # first odd
+
+
+def test_spinor_host_orders():
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(6), GEOM).data
+    q = ho.spinor_to_qdp(psi, GEOM)
+    assert q.shape == (GEOM.volume, 4, 3)
+    assert np.allclose(np.asarray(ho.spinor_from_qdp(q, GEOM)),
+                       np.asarray(psi))
+    c = ho.spinor_to_cps(psi, GEOM)
+    assert c.shape == (GEOM.volume, 3, 4)
+    assert np.allclose(np.asarray(ho.spinor_from_cps(c, GEOM)),
+                       np.asarray(psi))
+
+
+def test_milc_order_load_and_invert():
+    """VERDICT done-criterion: load a MILC-order host array through the
+    API and invert on it."""
+    from quda_tpu.interfaces.params import GaugeParam, InvertParam
+    from quda_tpu.interfaces.quda_api import (init_quda, invert_quda,
+                                              load_gauge_quda)
+    from quda_tpu.models.wilson import DiracWilson
+    key = jax.random.PRNGKey(8)
+    k1, k2 = jax.random.split(key)
+    gauge = GaugeField.random(k1, GEOM).data
+    milc_host = ho.gauge_to_milc(gauge, GEOM)
+    init_quda()
+    load_gauge_quda(milc_host, GaugeParam(X=GEOM.dims, cuda_prec="double",
+                                          gauge_order="milc"))
+    b = ColorSpinorField.gaussian(k2, GEOM).data
+    p = InvertParam(dslash_type="wilson", kappa=0.12, inv_type="cg",
+                    solve_type="normop-pc", tol=1e-10, maxiter=2000,
+                    cuda_prec="double", cuda_prec_sloppy="single")
+    x = invert_quda(b, p)
+    d = DiracWilson(gauge, GEOM, 0.12)
+    rel = float(jnp.sqrt(blas.norm2(b - d.M(jnp.asarray(x)))
+                         / blas.norm2(b)))
+    assert rel < 1e-8
