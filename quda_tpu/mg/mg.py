@@ -42,16 +42,29 @@ class MGLevelParam:
     setup_iters: int = 150          # inverse-iteration count per null vector
     pre_smooth: int = 0             # QUDA default: no pre-smoothing
     post_smooth: int = 4
+    smoother: str = "mr"            # "mr" | "ca-gcr" (QUDA smoother types)
     smoother_omega: float = 0.85
     coarse_solver_iters: int = 8    # GCR iterations on the bottom level
+    coarse_solver_cycles: int = 2
 
 
 class _LevelOp:
-    """Adapter giving every level the same face: M/diag/hop in CHIRAL
-    layout for fine Dirac operators; CoarseOperator already is."""
+    """Fine-level adapter for WILSON-LIKE (nspin=4) operators: chirality
+    is the gamma5 spin split, K = 6 (2 spins x 3 colors per chirality).
+    Also exposes diag/hop for the coarse probing (FineOpParts face)."""
+
+    k_fine = 6
 
     def __init__(self, dirac):
         self.dirac = dirac
+        self.dtype = dirac.gauge.dtype if hasattr(dirac, "gauge") \
+            else jnp.complex128
+
+    def to_chiral(self, v):
+        return to_chiral(v)
+
+    def from_chiral(self, v):
+        return from_chiral(v)
 
     def M(self, v):
         return to_chiral(self.dirac.M(from_chiral(v)))
@@ -59,18 +72,126 @@ class _LevelOp:
     def MdagM(self, v):
         return to_chiral(self.dirac.MdagM(from_chiral(v)))
 
+    def diag(self, v):
+        return to_chiral(self.dirac.diag(from_chiral(v)))
+
+    def hop(self, v, mu, sign):
+        return to_chiral(self.dirac.hop(from_chiral(v), mu, sign))
+
+
+class _StaggeredLevelOp:
+    """Fine-level adapter for STAGGERED (nspin=1) operators: chirality is
+    the site parity epsilon(x) = (-1)^{x+y+z+t} (the staggered gamma5),
+    K = 3 colors; the (lat, 2, 3) chiral field holds the even-site part
+    in component 0 and the odd-site part in component 1.
+
+    With ``kd=True`` the adapted operator is the Kaehler-Dirac
+    right-preconditioned A = M . Xinv (mg/staggered_kd.py; QUDA
+    dirac_staggered_kd.cpp) — the "level 0.5" of staggered MG
+    (lib/multigrid.cpp:215 staggered-KD reset).  Xinv is block-local on
+    2^4 blocks, so with level-0 aggregates of (2,2,2,2) the composed
+    hops still couple only adjacent aggregates and the Galerkin probing
+    stays exact.  For improved staggered the stencil uses the fat links
+    only (standard preconditioner simplification).
+    """
+
+    k_fine = 3
+
+    def __init__(self, dirac, kd: bool = False):
+        from functools import lru_cache
+
+        import numpy as np
+        self.dirac = dirac
+        self.geom = dirac.geom
+        self.dtype = dirac.fat.dtype
+        T, Z, Y, X = self.geom.lattice_shape
+        t = np.arange(T)[:, None, None, None]
+        z = np.arange(Z)[None, :, None, None]
+        y = np.arange(Y)[None, None, :, None]
+        x = np.arange(X)[None, None, None, :]
+        self._eps = ((t + z + y + x) % 2)[..., None, None]  # (lat,1,1)
+        self.kd = kd
+        if kd:
+            from .staggered_kd import build_kd_xinv
+            self.xinv = build_kd_xinv(self._m_fat_std, self.geom,
+                                      self.dtype)
+            self.xinv_dag = jnp.conjugate(jnp.swapaxes(self.xinv, -1, -2))
+
+    # -- standard-layout operator pieces -------------------------------
+    def _m_fat_std(self, v):
+        """Fat-link-only M (the stencil the MG hierarchy represents)."""
+        return self.dirac.diag(v) + sum(
+            self.dirac.hop(v, mu, s) for mu in range(4) for s in (+1, -1))
+
+    def _xinv_std(self, v, dag=False):
+        from .staggered_kd import apply_kd_xinv
+        return apply_kd_xinv(self.xinv_dag if dag else self.xinv, v)
+
+    def apply_std(self, v):
+        """The operator the outer solver sees, standard layout."""
+        a = self._xinv_std(v) if self.kd else v
+        return self._m_fat_std(a)
+
+    def _mdag_std(self, v):
+        # fat-only staggered: Mdag = 2m - D
+        out = self.dirac.diag(v) - sum(
+            self.dirac.hop(v, mu, s) for mu in range(4) for s in (+1, -1))
+        return out
+
+    # -- chiral layout --------------------------------------------------
+    def to_chiral(self, v):
+        eps = jnp.asarray(self._eps)
+        even = jnp.where(eps == 0, v, 0)[..., 0, :]
+        odd = jnp.where(eps == 1, v, 0)[..., 0, :]
+        return jnp.stack([even, odd], axis=-2)
+
+    def from_chiral(self, vc):
+        return (vc[..., 0, :] + vc[..., 1, :])[..., None, :]
+
+    def M(self, v):
+        return self.to_chiral(self.apply_std(self.from_chiral(v)))
+
+    def MdagM(self, v):
+        s = self.from_chiral(v)
+        a = self.apply_std(s)
+        ad = self._mdag_std(a)
+        if self.kd:
+            ad = self._xinv_std(ad, dag=True)
+        return self.to_chiral(ad)
+
+    def diag(self, v):
+        s = self.from_chiral(v)
+        if self.kd:
+            s = self._xinv_std(s)
+        return self.to_chiral(self.dirac.diag(s))
+
+    def hop(self, v, mu, sign):
+        s = self.from_chiral(v)
+        if self.kd:
+            s = self._xinv_std(s)
+        return self.to_chiral(self.dirac.hop(s, mu, sign))
+
+
+def _make_fine_adapter(dirac, kd: bool = False):
+    if getattr(dirac, "nspin", 4) == 1:
+        return _StaggeredLevelOp(dirac, kd=kd)
+    return _LevelOp(dirac)
+
 
 class MG:
     """Multigrid preconditioner hierarchy."""
 
     def __init__(self, fine_dirac, geom, params: Sequence[MGLevelParam],
-                 key=None, verbosity: int = 0):
+                 key=None, verbosity: int = 0, kd: bool = False):
         self.geom = geom
         self.params = list(params)
         if key is None:
             key = jax.random.PRNGKey(2024)
         self.levels: List[dict] = []
-        self._setup(fine_dirac, key, verbosity)
+        # accept a ready adapter (has k_fine) or a Dirac operator
+        self.adapter = (fine_dirac if hasattr(fine_dirac, "k_fine")
+                        else _make_fine_adapter(fine_dirac, kd=kd))
+        self._setup(self.adapter, key, verbosity)
 
     # -- setup ---------------------------------------------------------
     def _generate_null_vectors(self, op_M, op_MdagM, example, n_vec, iters,
@@ -91,23 +212,16 @@ class MG:
             vecs.append(v)
         return jnp.stack(vecs)
 
-    def _setup(self, fine_dirac, key, verbosity):
-        level_op = _LevelOp(fine_dirac)
+    def _setup(self, adapter, key, verbosity):
+        level_op = adapter
         lat_shape = self.geom.lattice_shape
-        k_fine = 6
+        k_fine = adapter.k_fine        # 6 wilson-like, 3 staggered, n_vec coarse
         for li, p in enumerate(self.params):
-            example = jnp.zeros(lat_shape + (2, k_fine),
-                                fine_dirac.gauge.dtype
-                                if hasattr(fine_dirac, "gauge")
-                                else jnp.complex128)
-            if isinstance(level_op, _LevelOp):
-                example = example.astype(level_op.dirac.gauge.dtype)
-                MdagM = level_op.MdagM
-                parts = _FinePartsAdapter(level_op.dirac)
-            else:
-                example = example.astype(level_op.x_diag.dtype)
-                MdagM = level_op.MdagM
-                parts = level_op
+            dtype = (level_op.dtype if hasattr(level_op, "dtype")
+                     else level_op.x_diag.dtype)
+            example = jnp.zeros(lat_shape + (2, k_fine), dtype)
+            MdagM = level_op.MdagM
+            parts = level_op               # all adapters expose diag/hop
             nulls = self._generate_null_vectors(
                 level_op.M, MdagM, example, p.n_vec, p.setup_iters,
                 jax.random.fold_in(key, li))
@@ -128,37 +242,74 @@ class MG:
         """Approximately solve M_level x = b (chiral layout)."""
         lv = self.levels[level]
         op, tr, coarse, p = lv["op"], lv["transfer"], lv["coarse"], lv["param"]
+
+        def smooth(bb, n, x0):
+            if p.smoother == "ca-gcr":
+                return gcr_fixed(op.M, bb, nkrylov=n, cycles=1, x0=x0)
+            return mr_fixed(op.M, bb, n, p.smoother_omega, x0=x0)
+
         x = jnp.zeros_like(b) if x0 is None else x0
         if p.pre_smooth:
-            x = mr_fixed(op.M, b, p.pre_smooth, p.smoother_omega, x0=x)
+            x = smooth(b, p.pre_smooth, x)
         r = b - op.M(x)
         rc = tr.restrict(r)
         if level + 1 < len(self.levels):
             ec = self.vcycle(level + 1, rc)
         else:
             ec = gcr_fixed(coarse.M, rc, nkrylov=p.coarse_solver_iters,
-                           cycles=2)
+                           cycles=p.coarse_solver_cycles)
         x = x + tr.prolong(ec)
         if p.post_smooth:
-            x = mr_fixed(op.M, b, p.post_smooth, p.smoother_omega, x0=x)
+            x = smooth(b, p.post_smooth, x)
         return x
 
     def precondition(self, r_std):
-        """K(r) for an outer solver in STANDARD spin layout."""
-        return from_chiral(self.vcycle(0, to_chiral(r_std)))
+        """K(r) for an outer solver in STANDARD layout (spin for
+        wilson-like, (lat,1,3) for staggered)."""
+        a = self.adapter
+        return a.from_chiral(self.vcycle(0, a.to_chiral(r_std)))
+
+    # -- runtime verification (MG::verify, lib/multigrid.cpp:762) ------
+    def verify(self, key=None, galerkin_tol: float = 1e-10,
+               pr_tol: float = 1e-10):
+        """Check P/R bi-orthonormality and Galerkin consistency on every
+        level with a random coarse vector; returns per-level diagnostics
+        and raises on violation (QUDA MG::verify analog)."""
+        if key is None:
+            key = jax.random.PRNGKey(17)
+        report = []
+        for li, lv in enumerate(self.levels):
+            op, tr, coarse = lv["op"], lv["transfer"], lv["coarse"]
+            latc = tr.coarse_shape
+            k = jax.random.fold_in(key, li)
+            dtype = (op.dtype if hasattr(op, "dtype")
+                     else op.x_diag.dtype)
+            rdt = jnp.zeros((), dtype).real.dtype
+            shape = latc + (2, tr.n_vec)
+            vc = (jax.random.normal(k, shape, rdt)
+                  + 1j * jax.random.normal(jax.random.fold_in(k, 1),
+                                           shape, rdt)).astype(dtype)
+            # R P = I on the coarse space
+            rp = tr.restrict(tr.prolong(vc))
+            e_rp = float(jnp.sqrt(blas.norm2(rp - vc) / blas.norm2(vc)))
+            # Galerkin: coarse.M == R M P
+            lhs = coarse.M(vc)
+            rhs = tr.restrict(op.M(tr.prolong(vc)))
+            e_g = float(jnp.sqrt(blas.norm2(lhs - rhs)
+                                 / jnp.maximum(blas.norm2(rhs), 1e-30)))
+            report.append({"level": li, "rp_identity": e_rp,
+                           "galerkin": e_g})
+            if e_rp > pr_tol:
+                raise RuntimeError(
+                    f"MG verify level {li}: R P != I ({e_rp:.2e})")
+            if e_g > galerkin_tol:
+                raise RuntimeError(
+                    f"MG verify level {li}: Galerkin violated ({e_g:.2e})")
+        return report
 
 
-class _FinePartsAdapter:
-    """diag/hop of a fine Dirac operator, exposed in the chiral layout."""
-
-    def __init__(self, dirac):
-        self.dirac = dirac
-
-    def diag(self, v):
-        return to_chiral(self.dirac.diag(from_chiral(v)))
-
-    def hop(self, v, mu, sign):
-        return to_chiral(self.dirac.hop(from_chiral(v), mu, sign))
+# backwards-compat alias: diag/hop now live on the adapters themselves
+_FinePartsAdapter = _LevelOp
 
 
 def mg_solve(fine_dirac, geom, b_std, params: Sequence[MGLevelParam],
@@ -170,4 +321,34 @@ def mg_solve(fine_dirac, geom, b_std, params: Sequence[MGLevelParam],
         mg = MG(fine_dirac, geom, params, key)
     res = gcr(fine_dirac.M, b_std, precond=mg.precondition, tol=tol,
               nkrylov=nkrylov, max_restarts=max_restarts)
+    return res, mg
+
+
+def staggered_mg_solve(dirac, geom, b_std, params: Sequence[MGLevelParam],
+                       tol: float = 1e-10, nkrylov: int = 16,
+                       max_restarts: int = 100, key=None, kd: bool = False,
+                       mg: Optional[MG] = None):
+    """Staggered multigrid solve: outer GCR on M (or, with kd=True, on
+    the KD-right-preconditioned A = M Xinv, QUDA's staggered-KD path,
+    lib/multigrid.cpp:215), preconditioned by the parity-chirality MG
+    hierarchy.  Measured on random gauge at m=0.02 (8^4): the DIRECT
+    hierarchy with the ca-gcr smoother contracts ~0.36/cycle while the
+    KD-composed one stalls (~0.9) — hence kd defaults to False here; the
+    KD machinery remains available and is what QUDA composes on
+    physical configurations.
+
+    For improved staggered the hierarchy represents the fat-link stencil;
+    the outer operator here is the same fat-link M (solve the full
+    improved operator by defect correction around this, or pass the
+    fat-only Dirac)."""
+    if mg is None:
+        mg = MG(dirac, geom, params, key, kd=kd)
+    a = mg.adapter
+    # the adapter knows whether IT composes Xinv — never trust the kd
+    # argument when a prebuilt hierarchy is passed in
+    kd_active = getattr(a, "kd", False)
+    res = gcr(a.apply_std, b_std, precond=mg.precondition, tol=tol,
+              nkrylov=nkrylov, max_restarts=max_restarts)
+    x = a._xinv_std(res.x) if kd_active else res.x
+    res = res._replace(x=x)
     return res, mg

@@ -36,6 +36,7 @@ class Dirac:
     geom: LatticeGeometry
     hermitian = False        # True for operators where M == Mdag (e.g. MdagM wrap)
     g5_hermitian = True      # gamma5 M gamma5 == Mdag
+    nspin = 4                # spin dof per site (1 for staggered)
 
     def M(self, psi):
         raise NotImplementedError

@@ -54,6 +54,25 @@ class DiracStaggered(Dirac):
     def flops_per_site_M(self) -> int:
         return (1146 if self.improved else 570) + 24
 
+    # --- diag + per-direction hop decomposition (MG coarsening probes;
+    # fat links only: the 3-hop Naik term is dropped from the MG
+    # PRECONDITIONER stencil, the standard staggered-MG simplification —
+    # the outer solve still uses the full operator) ---
+    nspin = 1
+
+    def diag(self, psi):
+        return 2.0 * self.mass * psi
+
+    def hop(self, psi, mu, sign):
+        from ..ops.shift import shift
+        from ..ops.su3 import dagger
+        if sign > 0:
+            return 0.5 * jnp.einsum("...ab,...sb->...sa", self.fat[mu],
+                                    shift(psi, mu, +1))
+        ub = shift(dagger(self.fat[mu]), mu, -1)
+        return -0.5 * jnp.einsum("...ab,...sb->...sa", ub,
+                                 shift(psi, mu, -1))
+
 
 class DiracStaggeredPC(DiracPC):
     """Parity-restricted staggered normal operator 4m^2 - D_pq D_qp.
