@@ -1,0 +1,206 @@
+"""Performance harness: GFLOPS / GB/s per BLAS op, dslash family, solver.
+
+The per-kernel analog of the reference's runtime perf reporting
+(tests/blas_test.cpp:1194-1198 per-kernel GFLOPS+GB/s table,
+tests/dslash_test_utils.h:1048-1058 dslash GFLOPS, invert_test solver
+summary).  Prints one JSON line per measurement:
+
+  {"suite": "blas|dslash|solver", "name": ..., "gflops": ..,
+   "gbps": .., "secs_per_call": .., "platform": .., "lattice": [...]}
+
+Runs on CPU (tiny lattice) or TPU (24^4 c64).  Usage:
+  python bench_suite.py [blas] [dslash] [solver]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _best_time(fn, args, reps=3, inner=10):
+    import jax
+
+    @jax.jit
+    def chain(*a):
+        def body(v, _):
+            return fn(*a[:-1], v), None
+        out, _ = jax.lax.scan(body, a[-1], None, length=inner)
+        return out
+
+    out = chain(*args)
+    jax.tree_util.tree_leaves(out)[0].block_until_ready()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = chain(*args)
+        jax.tree_util.tree_leaves(out)[0].block_until_ready()
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best
+
+
+def _emit(suite, name, secs, flops, bytes_, platform, lattice):
+    print(json.dumps({
+        "suite": suite, "name": name,
+        "gflops": round(flops / secs / 1e9, 2),
+        "gbps": round(bytes_ / secs / 1e9, 2),
+        "secs_per_call": round(secs, 6),
+        "platform": platform, "lattice": list(lattice),
+    }), flush=True)
+
+
+def main(argv):
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("QUDA_TPU_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import threading
+    probe = {}
+
+    def _probe():
+        try:
+            probe["platform"] = jax.devices()[0].platform
+        except Exception as e:
+            probe["error"] = str(e)
+
+    th = threading.Thread(target=_probe, daemon=True)
+    th.start()
+    th.join(timeout=float(os.environ.get("QUDA_TPU_BENCH_PROBE_S", "240")))
+    if "platform" in probe:
+        platform = probe["platform"]
+    else:
+        if not os.environ.get("QUDA_TPU_BENCH_CPU"):
+            os.environ["QUDA_TPU_BENCH_CPU"] = "1"
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        platform = "cpu"
+
+    suites = set(a for a in argv if not a.startswith("-")) or {
+        "blas", "dslash", "solver"}
+
+    from quda_tpu.fields.geometry import LatticeGeometry
+    from quda_tpu.fields.gauge import GaugeField
+    from quda_tpu.fields.spinor import ColorSpinorField, even_odd_split
+    from quda_tpu.ops import blas
+    from quda_tpu.ops.boundary import apply_t_boundary
+
+    L = int(os.environ.get("QUDA_TPU_BENCH_L",
+                           "24" if platform != "cpu" else "8"))
+    geom = LatticeGeometry((L, L, L, L))
+    lat = geom.lattice_shape
+    vol = geom.volume
+    dt = jnp.complex64
+    itemsize = 8
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    gauge = apply_t_boundary(
+        GaugeField.random(k1, geom, dtype=dt).data, geom, -1)
+    psi = ColorSpinorField.gaussian(k2, geom, dtype=dt).data
+    chi = ColorSpinorField.gaussian(k3, geom, dtype=dt).data
+    spinor_bytes = vol * 24 * itemsize
+    gauge_bytes = 4 * vol * 18 * itemsize
+
+    if "blas" in suites:
+        # flop model per complex op: add=2, mul=6 flops
+        cases = [
+            ("axpy", lambda y: 0.37 * psi + y, 4 * 24 * vol,
+             3 * spinor_bytes),
+            ("caxpy", lambda y: (0.3 - 0.2j) * psi + y, 8 * 24 * vol,
+             3 * spinor_bytes),
+            ("xpay", lambda y: psi + 1.1 * y, 4 * 24 * vol,
+             3 * spinor_bytes),
+            ("norm2", lambda y: blas.norm2(y) + 0 * y,  # keep shape
+             2 * 24 * vol, spinor_bytes),
+            ("cdot", lambda y: blas.cdot(psi, y) + 0 * y, 8 * 24 * vol,
+             2 * spinor_bytes),
+            ("triple_cg_update",
+             lambda y: blas.triple_cg_update(0.4, psi, chi, y, y)[1],
+             (4 + 4 + 2) * 24 * vol, 5 * spinor_bytes),
+        ]
+        for name, fn, flops, bts in cases:
+            secs = _best_time(lambda v: fn(v), (psi,))
+            _emit("blas", name, secs, flops, bts, platform, lat)
+
+    if "dslash" in suites:
+        from quda_tpu.models.domain_wall import DiracMobius
+        from quda_tpu.models.staggered import DiracStaggered
+        from quda_tpu.models.twisted import DiracTwistedMass
+        from quda_tpu.models.clover import DiracClover
+        from quda_tpu.ops import wilson as wops
+        from quda_tpu.ops import wilson_packed as wpk
+
+        cases = []
+        cases.append(("wilson_xla_canonical",
+                      lambda p: wops.dslash_full(gauge, p), psi, 1320,
+                      gauge_bytes + 2 * spinor_bytes))
+        gp = wpk.pack_gauge(gauge)
+        pp = wpk.pack_spinor(psi)
+        cases.append(("wilson_xla_packed",
+                      lambda p: wpk.dslash_packed(gp, p, L, L), pp, 1320,
+                      gauge_bytes + 2 * spinor_bytes))
+        dcl = DiracClover(gauge, geom, 0.12, 1.0)
+        cases.append(("clover", dcl.M, psi, 1824,
+                      gauge_bytes + 2 * spinor_bytes + vol * 72 * itemsize))
+        dtm = DiracTwistedMass(gauge, geom, 0.12, 0.3)
+        cases.append(("twisted_mass", dtm.M, psi, 1416,
+                      gauge_bytes + 2 * spinor_bytes))
+        dst = DiracStaggered(gauge, geom, 0.05)
+        spsi = psi[..., :1, :]
+        cases.append(("staggered", dst.M, spsi, 594,
+                      gauge_bytes + 2 * vol * 6 * itemsize))
+        LS = 8
+        dmob = DiracMobius(gauge, geom, LS, 1.4, 0.04, 1.25, 0.25)
+        dpsi = jnp.stack([psi] * LS)
+        cases.append(("mobius", dmob.M, dpsi, (1320 + 192 * LS) * LS,
+                      LS * (gauge_bytes // 4 + 2 * spinor_bytes)))
+        for name, fn, arg, flops_total_per_4dsite, bts in cases:
+            secs = _best_time(lambda v: fn(v), (arg,))
+            _emit("dslash", name, secs, flops_total_per_4dsite * vol, bts,
+                  platform, lat)
+
+    if "solver" in suites:
+        from quda_tpu.models.wilson import DiracWilsonPC
+        from quda_tpu.solvers.cg import cg
+        from quda_tpu.solvers.mixed import cg_reliable, pair_codec
+
+        dpc = DiracWilsonPC(gauge, geom, 0.124)
+        b = even_odd_split(psi, geom)[0]
+        flops_iter = 2 * dpc.flops_per_site_M() * vol  # MdagM per iter
+
+        solve = jax.jit(lambda v: cg(dpc.MdagM, v, tol=1e-6, maxiter=500))
+        solve(b).x.block_until_ready()          # compile + warm up
+        t0 = time.perf_counter()
+        res = solve(b)
+        res.x.block_until_ready()
+        secs = time.perf_counter() - t0
+        iters = int(res.iters)
+        print(json.dumps({
+            "suite": "solver", "name": "cg_wilson_pc_c64",
+            "iters": iters, "secs": round(secs, 3),
+            "gflops": round(iters * flops_iter / secs / 1e9, 2),
+            "converged": bool(res.converged), "platform": platform,
+            "lattice": list(lat)}), flush=True)
+
+        sl = dpc.sloppy("half")
+        codec = pair_codec(jnp.bfloat16, b.dtype)
+        solve2 = jax.jit(lambda v: cg_reliable(
+            dpc.MdagM, sl.MdagM_pairs, v, tol=1e-6, maxiter=500,
+            codec=codec))
+        solve2(b).x.block_until_ready()         # compile + warm up
+        t0 = time.perf_counter()
+        res2 = solve2(b)
+        res2.x.block_until_ready()
+        secs2 = time.perf_counter() - t0
+        print(json.dumps({
+            "suite": "solver", "name": "cg_reliable_bf16_sloppy",
+            "iters": int(res2.iters), "secs": round(secs2, 3),
+            "gflops": round(int(res2.iters) * flops_iter / secs2 / 1e9, 2),
+            "converged": bool(res2.converged), "platform": platform,
+            "lattice": list(lat)}), flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

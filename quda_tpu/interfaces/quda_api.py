@@ -32,6 +32,8 @@ _ctx = {
     "fat": None,
     "long": None,
     "mg": None,
+    "mg_epoch": -1,         # gauge_epoch the resident MG was built against
+    "gauge_epoch": 0,       # bumped whenever the resident gauge changes
 }
 
 
@@ -51,6 +53,13 @@ def end_quda():
 def _require_init():
     if not _ctx["initialized"]:
         qlog.errorq("initQuda has not been called")
+
+
+def _set_resident_gauge(g):
+    """Every resident-gauge mutation goes through here so the MG
+    staleness guard (gauge_epoch) can never miss one."""
+    _ctx["gauge"] = g
+    _ctx["gauge_epoch"] += 1
 
 
 def load_gauge_quda(gauge, param: GaugeParam):
@@ -76,7 +85,7 @@ def load_gauge_quda(gauge, param: GaugeParam):
         scale = scale.at[:3].set(1.0 / param.anisotropy)
         g = g * scale.astype(dtype)
     _ctx["geom"] = geom
-    _ctx["gauge"] = g
+    _set_resident_gauge(g)
     _ctx["gauge_param"] = param
 
 
@@ -285,7 +294,11 @@ def invert_quda(source, param: InvertParam):
 
     inv = param.inv_type
     if inv == "cg" and not (hermitian_pc or normop):
-        qlog.warningq("cg on a non-normal system; switching to normal eq")
+        # QUDA's solve-type matrix (lib/solve.cpp:180): CG + direct solve
+        # is routed through the normal RESIDUAL equations (CGNR).  Users
+        # wanting the normal-ERROR form should pick inv_type="cgne".
+        qlog.warningq("cg on a non-normal system; using CGNR "
+                      "(normal-residual) semantics")
         mv = lambda v: d.Mdag(d.M(v))
         sys_rhs = d.Mdag(rhs)
 
@@ -309,7 +322,18 @@ def invert_quda(source, param: InvertParam):
                 delta=param.reliable_delta)
     elif inv in ("cg", "pcg", "cg3"):
         fn = solvers.create(inv)
-        res = fn(mv, sys_rhs, tol=param.tol, maxiter=param.maxiter)
+        kw = {"tol_hq": param.tol_hq} if inv == "cg" else {}
+        res = fn(mv, sys_rhs, tol=param.tol, maxiter=param.maxiter, **kw)
+    elif inv in ("cgne", "cgnr"):
+        # explicit normal-error / normal-residual solves on the DIRECT
+        # system (lib/solve.cpp CGNE/CGNR rows): cgne solves M Mdag y = b
+        # then x = Mdag y (error-norm minimising); cgnr solves
+        # Mdag M x = Mdag b (residual-norm minimising)
+        if hermitian_pc:
+            res = solvers.cg(d.M, rhs, tol=param.tol, maxiter=param.maxiter)
+        else:
+            fn = solvers.cgne if inv == "cgne" else solvers.cgnr
+            res = fn(d.M, d.Mdag, rhs, tol=param.tol, maxiter=param.maxiter)
     elif inv == "bicgstab":
         if pair_sloppy:
             # defect-correction outer at precise, bf16-internal BiCGStab
@@ -416,9 +440,18 @@ def _solve_mg(d_full, b, param: InvertParam, mg_param=None):
                            smoother_omega=mp.smoother_omega,
                            coarse_solver_iters=mp.coarse_solver_iters)
               for i in range(mp.n_level - 1)]
+    mg = _ctx["mg"]
+    if mg is not None and _ctx["mg_epoch"] != _ctx["gauge_epoch"]:
+        # resident hierarchy was built for a different gauge — rebuild
+        # (updateMultigridQuda semantics, interface_quda.cpp:2789; a stale
+        # hierarchy silently degrades to a wrong preconditioner)
+        qlog.printq("gauge changed since MG setup; rebuilding hierarchy",
+                    qlog.VERBOSE)
+        mg = None
     res, mg = mg_solve(d_full, _ctx["geom"], b, params, tol=param.tol,
-                       nkrylov=param.gcrNkrylov, mg=_ctx["mg"])
+                       nkrylov=param.gcrNkrylov, mg=mg)
     _ctx["mg"] = mg
+    _ctx["mg_epoch"] = _ctx["gauge_epoch"]
     return res
 
 
@@ -432,7 +465,18 @@ def new_multigrid_quda(mg_param: MultigridParamAPI, invert_param: InvertParam):
                            n_vec=mg_param.n_vec[i])
               for i in range(mg_param.n_level - 1)]
     _ctx["mg"] = MG(d, _ctx["geom"], params)
+    _ctx["mg_epoch"] = _ctx["gauge_epoch"]
     return _ctx["mg"]
+
+
+def update_multigrid_quda(mg_param: MultigridParamAPI,
+                          invert_param: InvertParam):
+    """updateMultigridQuda (interface_quda.cpp:2789): refresh the resident
+    hierarchy against the CURRENT resident gauge (after an HMC update or
+    a new configuration load)."""
+    _require_init()
+    _ctx["mg"] = None
+    return new_multigrid_quda(mg_param, invert_param)
 
 
 def destroy_multigrid_quda():
@@ -570,8 +614,8 @@ def gauss_gauge_quda(seed: int, sigma: float):
     from ..ops.su3 import random_su3
     _require_init()
     key = jax.random.PRNGKey(seed)
-    _ctx["gauge"] = random_su3(key, (4,) + _ctx["geom"].lattice_shape,
-                               _ctx["gauge"].dtype, scale=sigma)
+    _set_resident_gauge(random_su3(key, (4,) + _ctx["geom"].lattice_shape,
+                                   _ctx["gauge"].dtype, scale=sigma))
 
 
 def perform_gauge_smear_quda(smear_type: str, n_steps: int, **kw):
@@ -590,7 +634,7 @@ def perform_gauge_smear_quda(smear_type: str, n_steps: int, **kw):
         g = gsm.hyp_smear(g, n_steps=n_steps)
     else:
         qlog.errorq(f"unknown smear type {smear_type}")
-    _ctx["gauge"] = g
+    _set_resident_gauge(g)
 
 
 def perform_wflow_quda(n_steps: int, eps: float, smear_type="wilson",
@@ -604,7 +648,7 @@ def perform_wflow_quda(n_steps: int, eps: float, smear_type="wilson",
         g = step(g, eps)
         if measure:
             hist.append(measure(g, (i + 1) * eps))
-    _ctx["gauge"] = g
+    _set_resident_gauge(g)
     return hist
 
 
@@ -613,7 +657,7 @@ def compute_gauge_fixing_ovr_quda(gauge_dirs: int = 4, **kw):
     _require_init()
     g, iters, theta = gaugefix_ovr(_ctx["gauge"], _ctx["geom"],
                                    gauge_dirs=gauge_dirs, **kw)
-    _ctx["gauge"] = g
+    _set_resident_gauge(g)
     return iters, theta
 
 
@@ -622,7 +666,7 @@ def compute_gauge_fixing_fft_quda(gauge_dirs: int = 4, **kw):
     _require_init()
     g, iters, theta = gaugefix_fft(_ctx["gauge"], _ctx["geom"],
                                    gauge_dirs=gauge_dirs, **kw)
-    _ctx["gauge"] = g
+    _set_resident_gauge(g)
     return iters, theta
 
 
@@ -718,7 +762,7 @@ def update_gauge_field_quda(mom, dt: float, reunitarize: bool = True):
     g = update_gauge(_ctx["gauge"], mom, dt)
     if reunitarize:
         g = project_su3(g)
-    _ctx["gauge"] = g
+    _set_resident_gauge(g)
 
 
 def mom_action_quda(mom):
@@ -755,7 +799,7 @@ def perform_gflow_quda(phi, n_steps: int, eps: float):
     from ..gauge.smear import fermion_flow
     _require_init()
     g, p = fermion_flow(_ctx["gauge"], jnp.asarray(phi), eps, n_steps)
-    _ctx["gauge"] = g
+    _set_resident_gauge(g)
     return p
 
 

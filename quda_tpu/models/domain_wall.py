@@ -75,6 +75,11 @@ class DiracMobius(Dirac):
         return (apply_sop(self.s_m5.adj(), psi)
                 - 0.5 * apply_sop(self.s_m5p.adj(), hop_dag))
 
+    def flops_per_site_M(self) -> int:
+        # per (s, 4d-site): Wilson hop + two dense (Ls,Ls) s-contractions
+        # (12 components x Ls complex MACs x 8 flops each)
+        return 1320 + 2 * 96 * self.ls
+
 
 class DiracDomainWall(DiracMobius):
     """Shamir domain wall: Möbius with b5=1, c5=0
@@ -140,6 +145,9 @@ class DiracMobiusPC(DiracPC):
         t = self._hop_to(apply_sop(self.s_m5p, x_p), 1 - p)
         x_q = apply_sop(self.s_m5i, b_q + 0.5 * t)
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+    def flops_per_site_M(self) -> int:
+        return 2 * 1320 + 3 * 96 * self.ls
 
 
 # ---------------------------------------------------------------------------
@@ -320,6 +328,9 @@ class DiracDomainWall5DPC(DiracPC):
         p = self.matpc
         return x_p - (self.kappa5 ** 2) * self._Ddag_to(
             self._Ddag_to(x_p, 1 - p), p)
+
+    def flops_per_site_M(self) -> int:
+        return 2 * (1320 + 96) + 48  # two 5d hops (4d + s-hop) + axpy
 
     # -- full-system interface (fields (Ls,T,Z,Y,X,4,3)) ----------------
     def split5(self, psi5_full):

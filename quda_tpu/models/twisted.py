@@ -66,6 +66,9 @@ class DiracTwistedMass(Dirac):
             self.D(apply_gamma5(psi)))
         return out
 
+    def flops_per_site_M(self) -> int:
+        return 1320 + 96  # dslash + twist scale + axpy
+
 
 class DiracTwistedMassPC(DiracPC):
     """Even/odd preconditioned degenerate twisted mass.
@@ -111,6 +114,9 @@ class DiracTwistedMassPC(DiracPC):
         b_q = b_odd if p == EVEN else b_even
         x_q = _twist_inv(b_q + self.kappa * self.D_to(x_p, 1 - p), self.a)
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+    def flops_per_site_M(self) -> int:
+        return 2 * 1320 + 192  # two hops + twist apply/inverse + axpy
 
 
 class DiracNdegTwistedMass(Dirac):
@@ -300,6 +306,9 @@ class DiracNdegTwistedClover(Dirac):
         d5 = apply_gamma5(self.D(apply_gamma5(psi)))
         return self._diag(psi, -1) - self.kappa * d5
 
+    def flops_per_site_M(self) -> int:
+        return 2 * (1320 + 504) + 144  # per flavor: dslash + clover
+
 
 class DiracNdegTwistedCloverPC(DiracPC):
     """Even/odd preconditioned non-degenerate twisted clover (asymmetric):
@@ -456,3 +465,6 @@ class DiracNdegTwistedMassPC(DiracPC):
         b_q = b_odd if p == EVEN else b_even
         x_q = self._diag_inv(b_q + self.kappa * self.D_to(x_p, 1 - p))
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+    def flops_per_site_M(self) -> int:
+        return 2 * (2 * 1320) + 384  # two flavor hops each parity + twist

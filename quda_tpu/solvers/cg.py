@@ -31,16 +31,22 @@ class SolverResult(NamedTuple):
 
 def cg(matvec: Callable, b: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
        tol: float = 1e-10, maxiter: int = 1000,
-       precond: Optional[Callable] = None) -> SolverResult:
+       precond: Optional[Callable] = None,
+       tol_hq: float = 0.0) -> SolverResult:
     """Solve matvec(x) = b for Hermitian positive-definite matvec.
 
     Convergence: |r|^2 <= tol^2 * |b|^2 (QUDA's L2 relative residual,
-    lib/solver.cpp stopping condition).  With ``precond`` this is PCG
+    lib/solver.cpp stopping condition).  With ``tol_hq > 0`` the
+    heavy-quark residual (volume-averaged site-wise |r|/|x|,
+    blas.heavy_quark_residual_norm; lib/inv_cg_quda.cpp:80 hq stopping)
+    must ALSO drop below tol_hq.  With ``precond`` this is PCG
     (lib/inv_pcg_quda.cpp): K applied each iteration, Polak-Ribiere-free
     standard flexible variant with r.K(r) inner products.
     """
     b2 = blas.norm2(b)
     stop = (tol ** 2) * b2
+    use_hq = tol_hq > 0.0
+    stop_hq = tol_hq ** 2
     x = jnp.zeros_like(b) if x0 is None else x0
     r = b - matvec(x) if x0 is not None else b
 
@@ -53,9 +59,18 @@ def cg(matvec: Callable, b: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
     p = z
     r2 = blas.norm2(r)
 
+    def hq2(x, r):
+        return blas.heavy_quark_residual_norm(x, r)[2]
+
+    def not_done(x, r, r2):
+        l2 = r2 > stop
+        if not use_hq:
+            return l2
+        return jnp.logical_or(l2, hq2(x, r) > stop_hq)
+
     def cond(carry):
         x, r, p, rz, r2, k = carry
-        return jnp.logical_and(r2 > stop, k < maxiter)
+        return jnp.logical_and(not_done(x, r, r2), k < maxiter)
 
     def body(carry):
         x, r, p, rz, r2, k = carry
@@ -77,7 +92,8 @@ def cg(matvec: Callable, b: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
 
     x, r, p, rz, r2, k = jax.lax.while_loop(
         cond, body, (x, r, p, rz, r2, jnp.int32(0)))
-    return SolverResult(x, k, r2, r2 <= stop)
+    done = jnp.logical_not(not_done(x, r, r2))
+    return SolverResult(x, k, r2, done)
 
 
 def cg_fixed_iters(matvec: Callable, b: jnp.ndarray, x0, n_iters: int):

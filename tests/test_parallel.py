@@ -121,3 +121,106 @@ def test_psum_scalar_inside_shard_map(data):
     got = float(global_norm(ps))
     want = float(blas.norm2(psi))
     assert np.isclose(got, want, rtol=1e-12)
+
+
+# -- VERDICT #8: beyond Wilson — every major family under sharding ---------
+
+def test_improved_staggered_sharded_matches(data):
+    """3-hop Naik term (nhop=3 shifts) under GSPMD sharding bit-matches
+    the single-device improved staggered dslash."""
+    from quda_tpu.models.staggered import DiracStaggered
+    gauge, _ = data
+    key = jax.random.PRNGKey(40)
+    long = GaugeField.random(jax.random.fold_in(key, 1), GEOM).data
+    re = jax.random.normal(key, GEOM.lattice_shape + (1, 3))
+    im = jax.random.normal(jax.random.fold_in(key, 2),
+                           GEOM.lattice_shape + (1, 3))
+    psi = (re + 1j * im).astype(gauge.dtype)
+    d = DiracStaggered(gauge, GEOM, 0.05, improved=True, long_links=long)
+    want = np.asarray(d.M(psi))
+
+    mesh = make_lattice_mesh()
+    fat_s = shard_gauge(d.fat, mesh)
+    long_s = shard_gauge(d.long, mesh)
+    psi_s = jax.device_put(psi, NamedSharding(mesh, spinor_pspec()))
+
+    from quda_tpu.ops import staggered as sops
+    f = jax.jit(lambda ft, lg, p: 2.0 * 0.05 * p
+                + sops.dslash_full(ft, p, lg))
+    got = np.asarray(f(fat_s, long_s, psi_s))
+    assert np.allclose(got, want, atol=1e-12)
+
+
+def test_mobius_sharded_matches(data):
+    """Möbius matvec with the Ls axis REPLICATED and lattice sharded
+    (the Ls-parallel layout shards Ls instead; both must bit-match)."""
+    from quda_tpu.models.domain_wall import DiracMobius
+    gauge, _ = data
+    LS = 4
+    key = jax.random.PRNGKey(41)
+    psi = jnp.stack([
+        ColorSpinorField.gaussian(jax.random.fold_in(key, s), GEOM).data
+        for s in range(LS)])
+    d = DiracMobius(gauge, GEOM, LS, 1.4, 0.04, 1.25, 0.25)
+    want = np.asarray(d.M(psi))
+
+    mesh = make_lattice_mesh()
+    g_s = shard_gauge(d.gauge, mesh)
+    psi_s = jax.device_put(
+        psi, NamedSharding(mesh, P(None, *spinor_pspec())))
+
+    def m(g, p5):
+        dd = DiracMobius.__new__(DiracMobius)
+        dd.geom = GEOM
+        dd.ls, dd.m5, dd.mf = LS, 1.4, 0.04
+        dd.b5, dd.c5 = 1.25, 0.25
+        dd.gauge = g
+        dd.s_m5, dd.s_m5p = d.s_m5, d.s_m5p
+        return dd.M(p5)
+
+    got = np.asarray(jax.jit(m)(g_s, psi_s))
+    assert np.allclose(got, want, atol=1e-12)
+
+
+def test_multishift_sharded_matches(data):
+    """Multi-shift CG under GSPMD equals the single-device solve."""
+    from quda_tpu.models.wilson import DiracWilsonPC
+    from quda_tpu.fields.spinor import even_odd_split
+    from quda_tpu.solvers.multishift import multishift_cg
+    gauge, psi = data
+    dpc = DiracWilsonPC(gauge, GEOM, 0.12)
+    b = even_odd_split(psi, GEOM)[0]
+    shifts = (0.01, 0.1)
+    want = multishift_cg(dpc.MdagM, b, shifts, tol=1e-8, maxiter=500)
+
+    mesh = make_lattice_mesh()
+    g_sh = jax.device_put(
+        dpc.gauge_eo, NamedSharding(mesh, P(None, "t", "z", "y", "x")))
+    b_sh = jax.device_put(b, NamedSharding(mesh, spinor_pspec()))
+
+    def solve(gauge_eo, rhs):
+        dl = DiracWilsonPC.from_eo(gauge_eo, GEOM, 0.12)
+        return multishift_cg(dl.MdagM, rhs, shifts, tol=1e-8,
+                             maxiter=500).x
+
+    got = np.asarray(jax.jit(solve)(g_sh, b_sh))
+    assert np.allclose(got, np.asarray(want.x), atol=1e-9)
+
+
+def test_mg_vcycle_sharded_matches(data):
+    """One MG V-cycle under GSPMD sharding matches the single-device
+    V-cycle (transfers/coarse ops lower to collectives transparently)."""
+    from quda_tpu.mg.mg import MG, MGLevelParam
+    from quda_tpu.models.wilson import DiracWilson
+    gauge, psi = data
+    d = DiracWilson(gauge, GEOM, 0.12)
+    params = [MGLevelParam(block=(2, 2, 2, 2), n_vec=4, setup_iters=30)]
+    mg = MG(d, GEOM, params)
+    bc = mg.adapter.to_chiral(psi)
+    want = np.asarray(mg.vcycle(0, bc))
+
+    mesh = make_lattice_mesh()
+    bc_sh = jax.device_put(
+        bc, NamedSharding(mesh, P("t", "z", "y", "x", None, None)))
+    got = np.asarray(jax.jit(lambda v: mg.vcycle(0, v))(bc_sh))
+    assert np.allclose(got, want, atol=1e-10)

@@ -65,3 +65,22 @@ def test_schwarz_preconditioned_gcr(setup):
     # the Schwarz-preconditioned outer iteration count must beat plain GCR
     plain = gcr(d.M, b, tol=1e-9, nkrylov=16, max_restarts=60)
     assert int(res.iters) < int(plain.iters)
+
+
+def test_multiplicative_schwarz_beats_additive(setup):
+    """Multiplicative (red-black) Schwarz needs no more outer GCR
+    iterations than additive at the same local work."""
+    from quda_tpu.parallel.schwarz import multiplicative_schwarz
+    d, local_mv = setup
+    b = ColorSpinorField.gaussian(jax.random.PRNGKey(92), GEOM).data
+    K_add = additive_schwarz(local_mv, n_iter=4, omega=0.8)
+    K_mul = multiplicative_schwarz(local_mv, d.M, GEOM, DOMAIN,
+                                   n_iter=4, omega=0.8)
+    res_a = gcr(d.M, b, precond=K_add, tol=1e-8, nkrylov=16,
+                max_restarts=20)
+    res_m = gcr(d.M, b, precond=K_mul, tol=1e-8, nkrylov=16,
+                max_restarts=20)
+    assert bool(res_m.converged)
+    assert int(res_m.iters) <= int(res_a.iters)
+    r = b - d.M(res_m.x)
+    assert float(jnp.sqrt(blas.norm2(r) / blas.norm2(b))) < 1e-7
