@@ -111,3 +111,18 @@ def project_su3(u: jnp.ndarray, iters: int = 2) -> jnp.ndarray:
 
 def unit_gauge(shape, dtype=jnp.complex128):
     return jnp.broadcast_to(jnp.eye(3, dtype=dtype), shape + (3, 3))
+
+
+def compress12(u: jnp.ndarray) -> jnp.ndarray:
+    """Reconstruct-12 storage: keep the first two rows of an SU(3) link
+    (QUDA QUDA_RECONSTRUCT_12, include/gauge_field_order.h Reconstruct<12>).
+    (..., 3, 3) -> (..., 2, 3); bandwidth 12/18 of full storage."""
+    return u[..., :2, :]
+
+
+def reconstruct12(r: jnp.ndarray) -> jnp.ndarray:
+    """Rebuild the third row: row2 = conj(row0 x row1) (valid for SU(3):
+    unitarity + det 1).  (..., 2, 3) -> (..., 3, 3)."""
+    a, b = r[..., 0, :], r[..., 1, :]
+    c = jnp.conjugate(jnp.cross(a, b))
+    return jnp.concatenate([r, c[..., None, :]], axis=-2)

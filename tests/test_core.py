@@ -97,3 +97,16 @@ def test_gauge_split_roundtrip(key):
     for mu in range(4):
         back = even_odd_join(ge[mu], go[mu], GEOM)
         assert np.array_equal(np.asarray(back), np.asarray(gf.data[mu]))
+
+
+def test_reconstruct12_round_trip():
+    """compress12/reconstruct12 is exact on SU(3) links."""
+    from quda_tpu.fields.geometry import LatticeGeometry
+    from quda_tpu.fields.gauge import GaugeField
+    from quda_tpu.ops.su3 import compress12, reconstruct12
+    geom = LatticeGeometry((4, 4, 4, 4))
+    u = GaugeField.random(jax.random.PRNGKey(2), geom).data
+    r = compress12(u)
+    assert r.shape == u.shape[:-2] + (2, 3)
+    back = reconstruct12(r)
+    assert float(jnp.max(jnp.abs(back - u))) < 1e-13
