@@ -19,6 +19,8 @@ Paths benchmarked (best wins):
   xla_packed    — TPU-native packed order (4,3,T,Z,Y*X) unrolled stencil
                   (ops/wilson_packed.py); pack/unpack excluded from timing,
                   as fields stay packed across a whole solve
+  pallas_packed — hand-blocked pallas kernel on the packed pair layout
+                  (ops/wilson_pallas_packed.py); TPU only
 """
 
 from __future__ import annotations
@@ -129,6 +131,19 @@ def main():
     secs["xla_packed"] = _time_chain(
         lambda g, p: wpk.dslash_packed(g, p, L, L), (gauge_p, psi_p),
         chain, reps)
+    if platform == "tpu":
+        # pallas kernel (compiled mode needs real TPU; interpret-only
+        # correctness is covered in tests)
+        try:
+            from quda_tpu.ops import wilson_pallas_packed as wpp
+            g_pl = wpp.to_pallas_layout(gauge_p)
+            p_pl = wpp.to_pallas_layout(psi_p)
+            g_pl.block_until_ready()
+            secs["pallas_packed"] = _time_chain(
+                lambda g, p: wpp.dslash_pallas_packed(g, p, L),
+                (g_pl, p_pl), chain, reps)
+        except Exception as e:
+            paths["pallas_packed_error"] = str(e)[:120]
     for name, s in secs.items():
         paths[name] = round(flops / s / 1e9, 1)
 

@@ -1,0 +1,232 @@
+"""Pallas TPU Wilson dslash on the packed device layout — the hand-tuned
+hot path, round 2.
+
+Replaces ops/wilson_pallas.py's canonical-layout kernel, which fetched the
+full spinor five times per application and fought the (8,128) tiling with
+trailing (4,3,2) axes.  This kernel works on the PACKED order of
+ops/wilson_packed.py, split into float re/im planes:
+
+    psi   (4, 3, 2, T, Z, Y*X)   float32
+    gauge (4, 3, 3, 2, T, Z, Y*X) float32
+
+so every (Z, Y*X) plane is a fully-utilised vector tile.  Grid = (T,):
+each program owns one t-plane; BlockSpec index maps deliver psi(t),
+psi(t±1) (periodic wrap in the map) and U_t(t-1) — each element of psi is
+read exactly 3x per application (its own plane + as t-neighbour), gauge
+1x+1 plane, vs 5x full-array fetches before.  x/y shifts are lane
+rolls with an x-boundary mask built from an in-kernel iota; z shifts are
+sublane rolls; the spin algebra is the derived projection-table
+project -> 3x3 color multiply -> reconstruct of ops/wilson_pallas
+(reference include/kernels/dslash_wilson.cuh:84-162), in explicit
+re/im-pair arithmetic on (Z, Y*X) tiles.
+
+VMEM budget per program at 24^4: 3 psi planes (4.0 MB) + 2 gauge plane
+sets (9.6 MB) + out (1.3 MB) ~ 15 MB.  ``dslash_pallas_packed`` raises
+with a clear message beyond that budget — callers (bench.py) fall back
+to the XLA packed path (ops/wilson_packed.py) for larger planes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .wilson_pallas import TABLES
+
+F32 = jnp.float32
+
+
+# -- layout conversion ------------------------------------------------------
+
+def to_pallas_layout(arr: jnp.ndarray) -> jnp.ndarray:
+    """complex packed (..., T, Z, YX) -> float pairs (..., 2, T, Z, YX)."""
+    return jnp.stack([arr.real, arr.imag], axis=-4).astype(F32)
+
+
+def from_pallas_layout(arr: jnp.ndarray, dtype=jnp.complex64) -> jnp.ndarray:
+    return (arr[..., 0, :, :, :] + 1j * arr[..., 1, :, :, :]).astype(dtype)
+
+
+# -- in-kernel complex helpers on (re, im) tuples of (Z, YX) tiles ---------
+
+def _cmul(a, b):
+    return (a[0] * b[0] - a[1] * b[1], a[0] * b[1] + a[1] * b[0])
+
+
+def _cmul_conj(a, b):
+    """conj(a) * b."""
+    return (a[0] * b[0] + a[1] * b[1], a[0] * b[1] - a[1] * b[0])
+
+
+def _cadd(a, b):
+    return (a[0] + b[0], a[1] + b[1])
+
+
+def _cscale(c: complex, x):
+    cr, ci = float(c.real), float(c.imag)
+    if ci == 0.0:
+        return (cr * x[0], cr * x[1])
+    if cr == 0.0:
+        return (-ci * x[1], ci * x[0])
+    return (cr * x[0] - ci * x[1], cr * x[1] + ci * x[0])
+
+
+def _shift_xy(v, mu: int, sign: int, X: int):
+    """x/y shifts on a (Z, YX) tile: result(z, i) = v at site + sign*mu."""
+    if mu == 1:
+        return (jnp.roll(v[0], -sign * X, axis=1),
+                jnp.roll(v[1], -sign * X, axis=1))
+    # x: lane roll + boundary-column fix
+    col = jax.lax.broadcasted_iota(jnp.int32, v[0].shape, 1) % X
+    if sign > 0:
+        mask = col == X - 1
+        out = []
+        for c in v:
+            interior = jnp.roll(c, -1, axis=1)
+            wrapped = jnp.roll(c, X - 1, axis=1)
+            out.append(jnp.where(mask, wrapped, interior))
+        return tuple(out)
+    mask = col == 0
+    out = []
+    for c in v:
+        interior = jnp.roll(c, 1, axis=1)
+        wrapped = jnp.roll(c, -(X - 1), axis=1)
+        out.append(jnp.where(mask, wrapped, interior))
+    return tuple(out)
+
+
+def _shift_z(v, sign: int):
+    return (jnp.roll(v[0], -sign, axis=0), jnp.roll(v[1], -sign, axis=0))
+
+
+def _make_kernel(X: int):
+    """Kernel over one t-plane.  Ref shapes (leading block dims of 1
+    squeezed by indexing):
+      psi refs:   (4, 3, 2, 1, Z, YX)
+      gauge refs: (4, 3, 3, 2, 1, Z, YX); u_tm ref (3, 3, 2, 1, Z, YX)
+    """
+
+    def kernel(psi_c, psi_tp, psi_tm, g_c, g_tm, out_ref):
+        def psi_at(ref, s, c):
+            return (ref[s, c, 0, 0], ref[s, c, 1, 0])
+
+        def link(ref, mu, a, b):
+            return (ref[mu, a, b, 0, 0], ref[mu, a, b, 1, 0])
+
+        def link_tm(a, b):
+            return (g_tm[a, b, 0, 0], g_tm[a, b, 1, 0])
+
+        # accumulators per (spin, color)
+        acc = [[(jnp.zeros_like(psi_c[0, 0, 0, 0]),
+                 jnp.zeros_like(psi_c[0, 0, 0, 0]))
+                for _ in range(3)] for _ in range(4)]
+
+        def hop(get_psi, get_link, table, adjoint):
+            """get_psi(s, c) -> shifted psi pair; get_link(a, b) -> link
+            pair (already at the right site)."""
+            t = table
+            # project to half spinor h[a][color]
+            h = [[_cadd(get_psi(a, c),
+                        _cscale(t[f"c{a}"], get_psi(t[f"j{a}"], c)))
+                  for c in range(3)] for a in (0, 1)]
+            # color multiply
+            uh = [[None] * 3 for _ in range(2)]
+            for s in range(2):
+                for a in range(3):
+                    term = None
+                    for b in range(3):
+                        m = (_cmul_conj(get_link(b, a), h[s][b]) if adjoint
+                             else _cmul(get_link(a, b), h[s][b]))
+                        term = m if term is None else _cadd(term, m)
+                    uh[s][a] = term
+            # accumulate with reconstruction
+            for c in range(3):
+                acc[0][c] = _cadd(acc[0][c], uh[0][c])
+                acc[1][c] = _cadd(acc[1][c], uh[1][c])
+                acc[2][c] = _cadd(acc[2][c],
+                                  _cscale(t["d2"], uh[t["k2"]][c]))
+                acc[3][c] = _cadd(acc[3][c],
+                                  _cscale(t["d3"], uh[t["k3"]][c]))
+
+        # x, y directions: in-plane lane shifts
+        for mu in (0, 1):
+            hop(lambda s, c, mu=mu: _shift_xy(psi_at(psi_c, s, c), mu, +1,
+                                              X),
+                lambda a, b, mu=mu: link(g_c, mu, a, b),
+                TABLES[(mu, +1)], adjoint=False)
+            hop(lambda s, c, mu=mu: _shift_xy(psi_at(psi_c, s, c), mu, -1,
+                                              X),
+                lambda a, b, mu=mu: _shift_xy(link(g_c, mu, a, b), mu, -1,
+                                              X),
+                TABLES[(mu, -1)], adjoint=True)
+        # z direction: sublane shifts
+        hop(lambda s, c: _shift_z(psi_at(psi_c, s, c), +1),
+            lambda a, b: link(g_c, 2, a, b),
+            TABLES[(2, +1)], adjoint=False)
+        hop(lambda s, c: _shift_z(psi_at(psi_c, s, c), -1),
+            lambda a, b: _shift_z(link(g_c, 2, a, b), -1),
+            TABLES[(2, -1)], adjoint=True)
+        # t direction: neighbour planes (index maps did the wrap)
+        hop(lambda s, c: psi_at(psi_tp, s, c),
+            lambda a, b: link(g_c, 3, a, b),
+            TABLES[(3, +1)], adjoint=False)
+        hop(lambda s, c: psi_at(psi_tm, s, c),
+            lambda a, b: link_tm(a, b),
+            TABLES[(3, -1)], adjoint=True)
+
+        for s in range(4):
+            for c in range(3):
+                out_ref[s, c, 0, 0] = acc[s][c][0]
+                out_ref[s, c, 1, 0] = acc[s][c][1]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("X", "interpret"))
+def dslash_pallas_packed(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
+                         X: int, interpret: bool = False) -> jnp.ndarray:
+    """Wilson hop sum on pallas-layout pair arrays.
+
+    gauge_pl: (4,3,3,2,T,Z,YX) f32 (phases folded);
+    psi_pl: (4,3,2,T,Z,YX) f32.  Returns the same layout as psi_pl.
+    """
+    from jax.experimental import pallas as pl
+
+    _, _, _, T, Z, YX = psi_pl.shape
+    plane_bytes = Z * YX * 4
+    vmem_bytes = (3 * 24 + 2 * 72 + 24) * plane_bytes
+    if vmem_bytes > 15 * 2 ** 20:
+        raise ValueError(
+            f"t-plane working set {vmem_bytes / 2**20:.1f} MB exceeds the "
+            "VMEM budget; use ops/wilson_packed.dslash_packed instead")
+
+    def psi_spec(dt):
+        return pl.BlockSpec(
+            (4, 3, 2, 1, Z, YX),
+            lambda t, dt=dt: (0, 0, 0, (t + dt) % T, 0, 0))
+
+    gauge_spec = pl.BlockSpec(
+        (4, 3, 3, 2, 1, Z, YX), lambda t: (0, 0, 0, 0, t, 0, 0))
+    # U_t at t-1: index the direction axis at 3
+    g_tm_spec = pl.BlockSpec(
+        (1, 3, 3, 2, 1, Z, YX),
+        lambda t: (3, 0, 0, 0, (t - 1) % T, 0, 0))
+
+    kernel = _make_kernel(X)
+
+    def kernel_wrap(psi_c, psi_tp, psi_tm, g_c, g_tm, out_ref):
+        kernel(psi_c, psi_tp, psi_tm, g_c, g_tm[0], out_ref)
+
+    return pl.pallas_call(
+        kernel_wrap,
+        grid=(T,),
+        in_specs=[psi_spec(0), psi_spec(+1), psi_spec(-1), gauge_spec,
+                  g_tm_spec],
+        out_specs=pl.BlockSpec((4, 3, 2, 1, Z, YX),
+                               lambda t: (0, 0, 0, t, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape, psi_pl.dtype),
+        interpret=interpret,
+    )(psi_pl, psi_pl, psi_pl, gauge_pl, gauge_pl)
