@@ -46,6 +46,13 @@ class MGLevelParam:
     smoother_omega: float = 0.85
     coarse_solver_iters: int = 8    # GCR iterations on the bottom level
     coarse_solver_cycles: int = 2
+    # Coarse-level latency strategy (SURVEY hard-part #1; QUDA runs
+    # coarse levels on subset communicators, lib/multigrid.cpp:358).
+    # True = all-gather the tiny coarsest-level fields and solve them
+    # REPLICATED on every device (redundant flops, zero collectives in
+    # the bottom solve — the ICI-latency trade that wins when the
+    # coarsest lattice is a handful of sites per device).
+    coarse_replicate: bool = False
 
 
 class _LevelOp:
@@ -256,6 +263,23 @@ class MG:
         if level + 1 < len(self.levels):
             ec = self.vcycle(level + 1, rc)
         else:
+            if p.coarse_replicate:
+                # gather the coarsest rhs onto every device; the bottom
+                # GCR then runs collective-free and redundantly, and the
+                # prolong's input resharding is a single scatter.  Needs
+                # an active mesh context (``with mesh:`` around the jit).
+                from jax.sharding import PartitionSpec as P
+                amesh = jax.sharding.get_abstract_mesh()
+                if amesh is not None and amesh.shape_tuple:
+                    rc = jax.lax.with_sharding_constraint(
+                        rc, P(*([None] * rc.ndim)))
+                elif not getattr(self, "_warned_replicate", False):
+                    import warnings
+                    warnings.warn(
+                        "coarse_replicate=True has no effect without an "
+                        "active mesh context (wrap the jit in `with "
+                        "mesh:`)", stacklevel=2)
+                    self._warned_replicate = True
             ec = gcr_fixed(coarse.M, rc, nkrylov=p.coarse_solver_iters,
                            cycles=p.coarse_solver_cycles)
         x = x + tr.prolong(ec)

@@ -20,8 +20,8 @@ project -> 3x3 color multiply -> reconstruct of ops/wilson_pallas
 (reference include/kernels/dslash_wilson.cuh:84-162), in explicit
 re/im-pair arithmetic on (Z, Y*X) tiles.
 
-VMEM budget per program at 24^4: 3 psi planes (4.0 MB) + 2 gauge plane
-sets (9.6 MB) + out (1.3 MB) ~ 15 MB.  ``dslash_pallas_packed`` raises
+VMEM budget per program at 24^4: 3 psi planes (4.0 MB) + gauge plane at
+t (4.0 MB) + the U_t slice at t-1 (1.0 MB) + out (1.3 MB) ~ 10 MB.  ``dslash_pallas_packed`` raises
 with a clear message beyond that budget — callers (bench.py) fall back
 to the XLA packed path (ops/wilson_packed.py) for larger planes.
 """
@@ -197,7 +197,9 @@ def dslash_pallas_packed(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
 
     _, _, _, T, Z, YX = psi_pl.shape
     plane_bytes = Z * YX * 4
-    vmem_bytes = (3 * 24 + 2 * 72 + 24) * plane_bytes
+    # 3 psi blocks (24 planes each) + gauge at t (72) + U_t slice at t-1
+    # (18) + out (24) = 186 planes
+    vmem_bytes = (3 * 24 + 72 + 18 + 24) * plane_bytes
     if vmem_bytes > 15 * 2 ** 20:
         raise ValueError(
             f"t-plane working set {vmem_bytes / 2**20:.1f} MB exceeds the "

@@ -224,3 +224,24 @@ def test_mg_vcycle_sharded_matches(data):
         bc, NamedSharding(mesh, P("t", "z", "y", "x", None, None)))
     got = np.asarray(jax.jit(lambda v: mg.vcycle(0, v))(bc_sh))
     assert np.allclose(got, want, atol=1e-10)
+
+
+def test_mg_vcycle_replicated_coarsest(data):
+    """coarse_replicate=True (replicated collective-free bottom solves,
+    the QUDA subset-communicator analog) still bit-matches."""
+    from quda_tpu.mg.mg import MG, MGLevelParam
+    from quda_tpu.models.wilson import DiracWilson
+    gauge, psi = data
+    d = DiracWilson(gauge, GEOM, 0.12)
+    params = [MGLevelParam(block=(2, 2, 2, 2), n_vec=4, setup_iters=30,
+                           coarse_replicate=True)]
+    mg = MG(d, GEOM, params)
+    bc = mg.adapter.to_chiral(psi)
+    want = np.asarray(mg.vcycle(0, bc))
+
+    mesh = make_lattice_mesh()
+    bc_sh = jax.device_put(
+        bc, NamedSharding(mesh, P("t", "z", "y", "x", None, None)))
+    with mesh:
+        got = np.asarray(jax.jit(lambda v: mg.vcycle(0, v))(bc_sh))
+    assert np.allclose(got, want, atol=1e-10)
