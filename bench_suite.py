@@ -151,6 +151,13 @@ def main(argv):
         spsi = psi[..., :1, :]
         cases.append(("staggered", dst.M, spsi, 594,
                       gauge_bytes + 2 * vol * 6 * itemsize))
+        from quda_tpu.ops import staggered_packed as spk
+        sfat_p = spk.pack_links(dst.fat)
+        sp_p = spk.pack_staggered(spsi)
+        cases.append(("staggered_xla_packed",
+                      lambda p: spk.matvec_staggered_packed(
+                          sfat_p, p, 0.05, L, L), sp_p, 594,
+                      gauge_bytes + 2 * vol * 6 * itemsize))
         LS = 8
         dmob = DiracMobius(gauge, geom, LS, 1.4, 0.04, 1.25, 0.25)
         dpsi = jnp.stack([psi] * LS)

@@ -1,0 +1,79 @@
+"""Staggered / improved-staggered dslash on the TPU-native packed order.
+
+Same layout move as ops/wilson_packed.py, for the second headline
+family (reference: QUDA's staggered/HISQ dslash kernels,
+include/kernels/dslash_staggered.cuh):
+
+    staggered spinor  (3, T, Z, Y*X)     [color planes]
+    links             (3, 3, T, Z, Y*X)  per direction
+
+1-hop (fat) and 3-hop (Naik long-link) shifts both ride the fused-axis
+lane rolls of shift_packed (nhop-aware wrap masks); the color multiply
+is unrolled 3x3 elementwise work on full vector tiles.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .wilson_packed import pack_gauge as pack_links  # (4,3,3,T,Z,Y*X)
+from .wilson_packed import shift_packed
+
+
+def pack_staggered(psi: jnp.ndarray) -> jnp.ndarray:
+    """(T,Z,Y,X,1,3) -> (3,T,Z,Y*X)."""
+    T, Z, Y, X = psi.shape[:4]
+    return jnp.transpose(psi[..., 0, :],
+                         (4, 0, 1, 2, 3)).reshape(3, T, Z, Y * X)
+
+
+def unpack_staggered(pp: jnp.ndarray, lattice_shape) -> jnp.ndarray:
+    T, Z, Y, X = lattice_shape
+    return jnp.transpose(pp.reshape(3, T, Z, Y, X),
+                         (1, 2, 3, 4, 0))[..., None, :]
+
+
+def _mat_vec(u, v, adjoint: bool):
+    """u: (3,3,lat...), v: (3,lat...) color planes -> list of 3 planes."""
+    out = []
+    for a in range(3):
+        acc = None
+        for b in range(3):
+            t = (jnp.conjugate(u[b, a]) * v[b] if adjoint
+                 else u[a, b] * v[b])
+            acc = t if acc is None else acc + t
+        out.append(acc)
+    return out
+
+
+def dslash_staggered_packed(fat_p: jnp.ndarray, psi_p: jnp.ndarray,
+                            X: int, Y: int,
+                            long_p: jnp.ndarray = None) -> jnp.ndarray:
+    """D psi on packed arrays (phases folded in the links).
+
+    fat_p/long_p: (4,3,3,T,Z,YX); psi_p: (3,T,Z,YX).
+    Mirrors ops/staggered.dslash_full: 0.5 * [U psi(+1) - U^dag psi(-1)]
+    per hop set; whole arrays are shifted at once (shift_packed acts on
+    the last three axes), matching wilson_packed.dslash_packed.
+    """
+    acc = None
+    for links, nhop in (((fat_p, 1),) if long_p is None
+                        else ((fat_p, 1), (long_p, 3))):
+        for mu in range(4):
+            u = links[mu]
+            fwd = _mat_vec(u, shift_packed(psi_p, mu, +1, X, Y, nhop),
+                           adjoint=False)
+            ub = shift_packed(u, mu, -1, X, Y, nhop)
+            bwd = _mat_vec(ub, shift_packed(psi_p, mu, -1, X, Y, nhop),
+                           adjoint=True)
+            term = [0.5 * (f - b) for f, b in zip(fwd, bwd)]
+            acc = term if acc is None else [a + t
+                                            for a, t in zip(acc, term)]
+    return jnp.stack(acc)
+
+
+def matvec_staggered_packed(fat_p, psi_p, mass: float, X: int, Y: int,
+                            long_p=None):
+    """M psi = 2m psi + D psi on packed arrays."""
+    return 2.0 * mass * psi_p + dslash_staggered_packed(
+        fat_p, psi_p, X, Y, long_p)

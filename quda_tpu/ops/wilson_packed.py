@@ -71,30 +71,37 @@ def unpack_gauge(gp: jnp.ndarray, lattice_shape) -> jnp.ndarray:
 # -- packed shifts ----------------------------------------------------------
 
 @lru_cache(maxsize=None)
-def _x_wrap_masks(Y: int, X: int):
+def _x_wrap_masks(Y: int, X: int, nhop: int = 1):
     """Lane masks (numpy, see ops/shift.py tracer-cache note) marking the
-    x-boundary columns of the fused Y*X axis."""
+    x-columns of the fused Y*X axis whose +nhop (resp. -nhop) neighbour
+    wraps around the x extent."""
     x = np.arange(Y * X) % X
-    return (x == X - 1), (x == 0)
+    return (x >= X - nhop), (x < nhop)
 
 
 def shift_packed(arr: jnp.ndarray, mu: int, sign: int, X: int,
-                 Y: int) -> jnp.ndarray:
-    """result[site] = arr[site + sign * mu_hat] on packed layout; lattice
-    axes are the LAST three (T, Z, Y*X); mu = 0,1,2,3 = x,y,z,t."""
+                 Y: int, nhop: int = 1) -> jnp.ndarray:
+    """result[site] = arr[site + sign*nhop*mu_hat] on packed layout;
+    lattice axes are the LAST three (T, Z, Y*X); mu = 0,1,2,3 = x,y,z,t."""
     if mu == 3:
-        return jnp.roll(arr, -sign, axis=-3)
+        return jnp.roll(arr, -sign * nhop, axis=-3)
     if mu == 2:
-        return jnp.roll(arr, -sign, axis=-2)
+        return jnp.roll(arr, -sign * nhop, axis=-2)
     if mu == 1:
-        return jnp.roll(arr, -sign * X, axis=-1)
-    last, first = _x_wrap_masks(Y, X)
+        return jnp.roll(arr, -sign * nhop * X, axis=-1)
+    # x-coordinate arithmetic is mod X, so an nhop shift equals an
+    # (nhop % X) shift — this also keeps the 2-case wrap select valid
+    # for nhop >= X (e.g. Naik on an X=2 lattice)
+    nhop = nhop % X
+    if nhop == 0:
+        return arr
+    last, first = _x_wrap_masks(Y, X, nhop)
     if sign > 0:
-        interior = jnp.roll(arr, -1, axis=-1)
-        wrapped = jnp.roll(arr, X - 1, axis=-1)
+        interior = jnp.roll(arr, -nhop, axis=-1)
+        wrapped = jnp.roll(arr, X - nhop, axis=-1)
         return jnp.where(jnp.asarray(last), wrapped, interior)
-    interior = jnp.roll(arr, 1, axis=-1)
-    wrapped = jnp.roll(arr, -(X - 1), axis=-1)
+    interior = jnp.roll(arr, nhop, axis=-1)
+    wrapped = jnp.roll(arr, -(X - nhop), axis=-1)
     return jnp.where(jnp.asarray(first), wrapped, interior)
 
 

@@ -132,3 +132,46 @@ def test_packed_matvec_in_solver():
     assert float(jnp.sqrt(blas.norm2(res_ref.x - x_pk)
                           / blas.norm2(res_ref.x))) < 1e-8
     assert abs(int(res_pk.iters) - int(res_ref.iters)) <= 2
+
+
+@pytest.mark.parametrize("improved", [False, True])
+def test_staggered_packed_matches_canonical(improved):
+    """Packed staggered dslash (1-hop and 3-hop Naik) == canonical."""
+    from quda_tpu.models.staggered import DiracStaggered
+    from quda_tpu.ops import staggered_packed as spk
+    geom = LatticeGeometry((8, 4, 6, 4))
+    T, Z, Y, X = geom.lattice_shape
+    key = jax.random.PRNGKey(21)
+    gauge = GaugeField.random(key, geom).data
+    long = GaugeField.random(jax.random.fold_in(key, 1), geom).data
+    k2 = jax.random.fold_in(key, 2)
+    re = jax.random.normal(k2, geom.lattice_shape + (1, 3))
+    im = jax.random.normal(jax.random.fold_in(k2, 3),
+                           geom.lattice_shape + (1, 3))
+    psi = (re + 1j * im).astype(gauge.dtype)
+    d = DiracStaggered(gauge, geom, 0.05, improved=improved,
+                       long_links=long if improved else None)
+    want = d.M(psi)
+    fat_p = spk.pack_links(d.fat)
+    long_p = spk.pack_links(d.long) if improved else None
+    got = spk.unpack_staggered(
+        spk.matvec_staggered_packed(fat_p, spk.pack_staggered(psi), 0.05,
+                                    X, Y, long_p), (T, Z, Y, X))
+    assert float(jnp.sqrt(blas.norm2(want - got)
+                          / blas.norm2(want))) < 1e-13
+
+
+def test_shift_packed_nhop3():
+    """3-hop packed shifts against the canonical nhop=3 shift."""
+    from quda_tpu.ops.shift import shift
+    geom = LatticeGeometry((8, 4, 6, 4))
+    T, Z, Y, X = geom.lattice_shape
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(7), geom).data
+    pp = wpk.pack_spinor(psi)
+    for mu in range(4):
+        for sign in (+1, -1):
+            ref = shift(psi, mu, sign, nhop=3)
+            got = wpk.unpack_spinor(
+                wpk.shift_packed(pp, mu, sign, X, Y, nhop=3),
+                (T, Z, Y, X))
+            assert jnp.array_equal(ref, got), (mu, sign)
