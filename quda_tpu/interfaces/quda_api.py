@@ -254,6 +254,34 @@ def invert_quda(source, param: InvertParam):
     d = _build_dirac(param, pc)
     d_full = _build_dirac(param, False)
 
+    # Mixed-precision gate (computed early: the layout choice below must
+    # not apply to representation combinations it cannot serve).  QUDA
+    # threads matSloppy through every solver (include/invert_quda.h:369);
+    # the TPU ladder (utils/precision.py) has two genuinely distinct
+    # sloppy levels: a lower complex dtype (double->single, CPU only) and
+    # bf16/int8 pair storage ("half"/"quarter" — ops/pair.py).
+    sloppy_prec = _resolve_sloppy(param)
+    pair_sloppy = (sloppy_prec in ("half", "quarter")
+                   and param.dslash_type == "wilson" and pc)
+    dtype_sloppy = (sloppy_prec != param.cuda_prec
+                    and complex_dtype(sloppy_prec) != complex_dtype(
+                        param.cuda_prec))
+    mixed = (param.inv_type == "cg" and (pair_sloppy or dtype_sloppy))
+
+    # TPU-native packed device order for the Wilson PC solve path (QUDA
+    # keeps solver fields in native FloatN order the same way); default
+    # on TPU, opt-in/out anywhere via QUDA_TPU_PACKED=1/0.  Skipped for
+    # the dtype-sloppy mixed path (its canonical sloppy operator cannot
+    # consume packed iterates) and for 'quarter' (the int8 gauge codec
+    # lives on the canonical layout).
+    import os
+    packed_default = "1" if jax.default_backend() == "tpu" else "0"
+    if (param.dslash_type == "wilson" and pc
+            and os.environ.get("QUDA_TPU_PACKED", packed_default) == "1"
+            and not (mixed and dtype_sloppy and not pair_sloppy)
+            and sloppy_prec != "quarter"):
+        d = d.packed()
+
     if pc:
         be, bo = _split(b, param, d)
         rhs = d.prepare(be, bo)
@@ -265,19 +293,6 @@ def invert_quda(source, param: InvertParam):
 
     if param.num_offset:
         qlog.errorq("use invert_multishift_quda for shifted solves")
-
-    # Mixed-precision gate.  QUDA threads matSloppy through every solver
-    # (include/invert_quda.h:369); the TPU ladder (utils/precision.py) has
-    # two genuinely distinct sloppy levels: a lower complex dtype
-    # (double->single, CPU only) and bf16/int8 pair storage
-    # ("half"/"quarter" — real TPU fast path, ops/pair.py).
-    sloppy_prec = _resolve_sloppy(param)
-    pair_sloppy = (sloppy_prec in ("half", "quarter")
-                   and param.dslash_type == "wilson" and pc)
-    dtype_sloppy = (sloppy_prec != param.cuda_prec
-                    and complex_dtype(sloppy_prec) != complex_dtype(
-                        param.cuda_prec))
-    mixed = (param.inv_type == "cg" and (pair_sloppy or dtype_sloppy))
 
     if hermitian_pc:           # staggered PC: already the normal operator
         mv = d.M
@@ -305,7 +320,13 @@ def invert_quda(source, param: InvertParam):
     if mixed and inv == "cg":
         if pair_sloppy:
             sl = d.sloppy(sloppy_prec)
-            codec = solvers.pair_codec(sl.store_dtype, dtype)
+            # each operator representation (canonical / packed) supplies
+            # the codec matching its sloppy storage layout; the storage
+            # dtype comes from the BUILT sloppy operator so the two can
+            # never desynchronise
+            codec = (d.codec(dtype, sl.store_dtype)
+                     if hasattr(d, "codec")
+                     else solvers.pair_codec(sl.store_dtype, dtype))
             res = solvers.cg_reliable(
                 mv, sl.MdagM_pairs, sys_rhs, tol=param.tol,
                 maxiter=param.maxiter, delta=param.reliable_delta,

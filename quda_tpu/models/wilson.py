@@ -117,6 +117,68 @@ class DiracWilsonPC(DiracPC):
         FloatN field order analog, ops/wilson_packed.py)."""
         return DiracWilsonPCPacked(self)
 
+    def codec(self, precise_dtype, store_dtype=None):
+        """StorageCodec matching this operator's sloppy representation
+        (pass the built sloppy operator's store_dtype)."""
+        from ..solvers.mixed import pair_codec
+        return pair_codec(store_dtype or jnp.bfloat16, precise_dtype)
+
+
+class _PairSloppyBase:
+    """Shared pair-storage sloppy-operator algebra (QUDA matSloppy).
+
+    Subclasses supply the representation: ``_d_to`` (the stencil),
+    ``_to_pairs``/``_from_pairs`` (layout converters) and ``_spin_axis``
+    (where the 4-spin axis lives in the pair layout).  Everything else
+    — the Schur composition, gamma5 trick, complex wrappers — is written
+    ONCE here so a numerics fix cannot diverge between layouts.
+    """
+
+    store_dtype = jnp.bfloat16
+    _spin_axis: int
+
+    def _d_to(self, psi_pairs, target_parity, out_dtype):
+        raise NotImplementedError
+
+    def _to_pairs(self, x):
+        raise NotImplementedError
+
+    def _from_pairs(self, x, dtype):
+        raise NotImplementedError
+
+    def M_pairs(self, x):
+        p = self.matpc
+        tmp = self._d_to(x, 1 - p, self.store_dtype)
+        dd = self._d_to(tmp, p, jnp.float32)
+        out = x.astype(jnp.float32) - (self.kappa ** 2) * dd
+        return out.astype(self.store_dtype)
+
+    def _g5_pairs(self, x):
+        sign = jnp.asarray([1.0, 1.0, -1.0, -1.0], jnp.float32)
+        ax = self._spin_axis % x.ndim
+        shape = [1] * x.ndim
+        shape[ax] = 4
+        return (x.astype(jnp.float32)
+                * sign.reshape(shape)).astype(x.dtype)
+
+    def Mdag_pairs(self, x):
+        return self._g5_pairs(self.M_pairs(self._g5_pairs(x)))
+
+    def MdagM_pairs(self, x):
+        return self.Mdag_pairs(self.M_pairs(x))
+
+    # -- complex in/out path -------------------------------------------
+    def M(self, x):
+        return self._from_pairs(self.M_pairs(self._to_pairs(x)), x.dtype)
+
+    def Mdag(self, x):
+        return self._from_pairs(self.Mdag_pairs(self._to_pairs(x)),
+                                x.dtype)
+
+    def MdagM(self, x):
+        return self._from_pairs(self.MdagM_pairs(self._to_pairs(x)),
+                                x.dtype)
+
 
 class DiracWilsonPCPacked:
     """PC Wilson operator on the TPU-native packed half-lattice layout.
@@ -169,19 +231,56 @@ class DiracWilsonPCPacked:
     def flops_per_site_M(self) -> int:
         return self._dpc.flops_per_site_M()
 
+    def sloppy(self, prec: str = "half") -> "DiracWilsonPCPackedSloppy":
+        """bf16 companion on the PACKED pair layout (matSloppy analog;
+        int8 'quarter' falls back to bf16 storage here)."""
+        return DiracWilsonPCPackedSloppy(self)
 
-class DiracWilsonPCSloppy:
-    """Low-precision PC Wilson operator on pair-format storage.
+    def codec(self, precise_dtype, store_dtype=None):
+        """StorageCodec matching this operator's sloppy representation
+        (pass the built sloppy operator's store_dtype)."""
+        from ..solvers.mixed import packed_pair_codec
+        return packed_pair_codec(store_dtype or jnp.bfloat16,
+                                 precise_dtype)
 
-    Two entry points:
 
-    * ``M_pairs`` / ``MdagM_pairs`` — act on (T,Z,Y,X//2,4,3,2) pair
-      arrays in the storage dtype; the whole sloppy CG loop stays in
-      half storage (QUDA's half sloppy solve).
-    * ``M`` / ``MdagM`` — complex64 in/out with bf16 internals: usable
-      as a drop-in sloppy operator inside any complex-arithmetic solver
-      (gauge traffic halved, einsums on the bf16 MXU path).
-    """
+class DiracWilsonPCPackedSloppy(_PairSloppyBase):
+    """bf16 pair-storage PC Wilson operator on the PACKED layout:
+    spinors (4,3,2,T,Z,Y*Xh) bf16, gauge likewise — the sloppy stencil
+    of the packed solve path (ops/wilson_packed.dslash_eo_packed_pairs)."""
+
+    _spin_axis = 0
+
+    def __init__(self, dpk: "DiracWilsonPCPacked"):
+        from ..ops import wilson_packed as wpk
+        self.geom = dpk.geom
+        self.kappa = float(dpk.kappa)
+        self.matpc = dpk.matpc
+        self.dims = dpk.dims
+        self.gauge_eo_pp = tuple(
+            wpk.to_packed_pairs(g, jnp.bfloat16) for g in dpk.gauge_eo_p)
+
+    def _d_to(self, psi_pp, target_parity, out_dtype):
+        from ..ops import wilson_packed as wpk
+        return wpk.dslash_eo_packed_pairs(self.gauge_eo_pp, psi_pp,
+                                          self.dims, target_parity,
+                                          out_dtype=out_dtype)
+
+    def _to_pairs(self, x):
+        from ..ops import wilson_packed as wpk
+        return wpk.to_packed_pairs(x, self.store_dtype)
+
+    def _from_pairs(self, x, dtype):
+        from ..ops import wilson_packed as wpk
+        return wpk.from_packed_pairs(x, dtype)
+
+
+class DiracWilsonPCSloppy(_PairSloppyBase):
+    """Low-precision PC Wilson operator on CANONICAL pair storage
+    (T,Z,Y,X//2,4,3,2): bf16 ('half') or int8 block-float gauge
+    ('quarter'); the whole sloppy CG loop stays in half storage."""
+
+    _spin_axis = -3
 
     def __init__(self, dpc: DiracWilsonPC, prec: str = "half"):
         from ..ops import pair as pops
@@ -189,46 +288,19 @@ class DiracWilsonPCSloppy:
         self.kappa = float(dpc.kappa)
         self.matpc = dpc.matpc
         self.prec = prec
-        self.store_dtype = jnp.bfloat16
         # links are already boundary-phase folded in the precise operator
         self.gauge_eo_st = tuple(
             pops.encode_gauge(dpc.gauge_eo[p], prec) for p in (0, 1))
 
-    # -- pair-storage path ---------------------------------------------
     def _d_to(self, psi_pairs, target_parity, out_dtype):
         from ..ops import pair as pops
         return pops.dslash_eo_pairs(self.gauge_eo_st, psi_pairs, self.geom,
                                     target_parity, out_dtype=out_dtype)
 
-    def M_pairs(self, x):
-        p = self.matpc
-        tmp = self._d_to(x, 1 - p, self.store_dtype)
-        dd = self._d_to(tmp, p, jnp.float32)
-        out = x.astype(jnp.float32) - (self.kappa ** 2) * dd
-        return out.astype(self.store_dtype)
-
-    def _g5_pairs(self, x):
-        sign = jnp.asarray([1.0, 1.0, -1.0, -1.0], jnp.float32)
-        return (x.astype(jnp.float32)
-                * sign[:, None, None]).astype(x.dtype)
-
-    def Mdag_pairs(self, x):
-        return self._g5_pairs(self.M_pairs(self._g5_pairs(x)))
-
-    def MdagM_pairs(self, x):
-        return self.Mdag_pairs(self.M_pairs(x))
-
-    # -- complex in/out path -------------------------------------------
-    def M(self, x):
+    def _to_pairs(self, x):
         from ..ops import pair as pops
-        out = self.M_pairs(pops.to_pairs(x, self.store_dtype))
-        return pops.from_pairs(out, x.dtype)
+        return pops.to_pairs(x, self.store_dtype)
 
-    def Mdag(self, x):
-        from .dirac import apply_gamma5
-        return apply_gamma5(self.M(apply_gamma5(x)))
-
-    def MdagM(self, x):
+    def _from_pairs(self, x, dtype):
         from ..ops import pair as pops
-        out = self.MdagM_pairs(pops.to_pairs(x, self.store_dtype))
-        return pops.from_pairs(out, x.dtype)
+        return pops.from_pairs(x, dtype)

@@ -247,3 +247,112 @@ def dslash_eo_packed(gauge_eo_p, psi_p: jnp.ndarray, dims,
         term = [f + b for f, b in zip(fwd, bwd)]
         acc = term if acc is None else [a + t for a, t in zip(acc, term)]
     return jnp.stack(acc)
+
+
+# ---------------------------------------------------------------------------
+# bf16 pair-form packed stencils (the sloppy fast path)
+# ---------------------------------------------------------------------------
+#
+# Pair layout on packed arrays: re/im as axis 2, keeping (Z, Y*X) minor:
+#   spinor (4, 3, 2, T, Z, Y*Xh)    gauge (4, 3, 3, 2, T, Z, Y*Xh)
+# Storage bf16 (or f32), arithmetic f32 (see ops/pair.py rationale).
+
+def to_packed_pairs(arr: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """complex packed (..., T, Z, YX) -> pairs with re/im before T."""
+    return jnp.stack([arr.real, arr.imag], axis=-4).astype(dtype)
+
+
+def from_packed_pairs(p: jnp.ndarray, dtype=jnp.complex64) -> jnp.ndarray:
+    f = p.astype(jnp.float32)
+    return (f[..., 0, :, :, :] + 1j * f[..., 1, :, :, :]).astype(dtype)
+
+
+def _pp_cmul(a, b):
+    return (a[0] * b[0] - a[1] * b[1], a[0] * b[1] + a[1] * b[0])
+
+
+def _pp_cmul_conj(a, b):
+    return (a[0] * b[0] + a[1] * b[1], a[0] * b[1] - a[1] * b[0])
+
+
+def _pp_cscale(c: complex, x):
+    cr, ci = float(c.real), float(c.imag)
+    if ci == 0.0:
+        return (cr * x[0], cr * x[1])
+    if cr == 0.0:
+        return (-ci * x[1], ci * x[0])
+    return (cr * x[0] - ci * x[1], cr * x[1] + ci * x[0])
+
+
+def _pp_add(a, b):
+    return (a[0] + b[0], a[1] + b[1])
+
+
+def _hop_packed_pairs(psi_s, u, table, adjoint: bool):
+    """Pair-form analog of _hop_packed.  psi_s[(s,c)] / u[(a,b)] are
+    (re, im) tuples of f32 lattice planes."""
+    t = table
+    h = [[_pp_add(psi_s[(a, c)],
+                  _pp_cscale(t[f"c{a}"], psi_s[(t[f"j{a}"], c)]))
+          for c in range(3)] for a in (0, 1)]
+    uh = [[None] * 3 for _ in range(2)]
+    for s in range(2):
+        for a in range(3):
+            acc = None
+            for b in range(3):
+                m = (_pp_cmul_conj(u[(b, a)], h[s][b]) if adjoint
+                     else _pp_cmul(u[(a, b)], h[s][b]))
+                acc = m if acc is None else _pp_add(acc, m)
+            uh[s][a] = acc
+    return [uh[0], uh[1],
+            [_pp_cscale(t["d2"], uh[t["k2"]][c]) for c in range(3)],
+            [_pp_cscale(t["d3"], uh[t["k3"]][c]) for c in range(3)]]
+
+
+def dslash_eo_packed_pairs(gauge_eo_pp, psi_pp: jnp.ndarray, dims,
+                           target_parity: int,
+                           out_dtype=None) -> jnp.ndarray:
+    """Checkerboarded Wilson hop on PAIR-FORM packed half-lattice arrays
+    (the bf16 sloppy stencil of the packed solve path).
+
+    gauge_eo_pp: (even, odd) of (4,3,3,2,T,Z,Y*Xh) storage arrays;
+    psi_pp: (4,3,2,T,Z,Y*Xh) of parity 1-p.  Compute at f32, output cast
+    to ``out_dtype`` (default: psi storage dtype).
+    """
+    out_dtype = out_dtype or psi_pp.dtype
+    f32 = jnp.float32
+
+    def planes_psi(arr):
+        a = arr.astype(f32)
+        return {(s, c): (a[s, c, 0], a[s, c, 1])
+                for s in range(4) for c in range(3)}
+
+    def planes_u(arr4, mu):
+        a = arr4[mu].astype(f32)
+        return {(i, j): (a[i, j, 0], a[i, j, 1])
+                for i in range(3) for j in range(3)}
+
+    u_here = gauge_eo_pp[target_parity]
+    u_there = gauge_eo_pp[1 - target_parity]
+    acc = None
+    for mu in range(4):
+        fwd_arr = shift_eo_packed(psi_pp, dims, mu, +1, target_parity)
+        fwd = _hop_packed_pairs(planes_psi(fwd_arr),
+                                planes_u(u_here, mu),
+                                TABLES[(mu, +1)], adjoint=False)
+        ub = shift_eo_packed(u_there[mu], dims, mu, -1, target_parity)
+        ub_pl = {(i, j): (ub[i, j, 0].astype(f32), ub[i, j, 1].astype(f32))
+                 for i in range(3) for j in range(3)}
+        bwd_arr = shift_eo_packed(psi_pp, dims, mu, -1, target_parity)
+        bwd = _hop_packed_pairs(planes_psi(bwd_arr), ub_pl,
+                                TABLES[(mu, -1)], adjoint=True)
+        term = [[_pp_add(f, b) for f, b in zip(fs, bs)]
+                for fs, bs in zip(fwd, bwd)]
+        acc = term if acc is None else [
+            [_pp_add(a, t) for a, t in zip(as_, ts)]
+            for as_, ts in zip(acc, term)]
+    out = jnp.stack([
+        jnp.stack([jnp.stack([acc[s][c][0], acc[s][c][1]])
+                   for c in range(3)])
+        for s in range(4)])
+    return out.astype(out_dtype)

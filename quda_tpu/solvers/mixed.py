@@ -68,6 +68,23 @@ def pair_codec(store_dtype, precise_dtype) -> StorageCodec:
                               ).astype(store_dtype))
 
 
+def packed_pair_codec(store_dtype, precise_dtype) -> StorageCodec:
+    """Pair storage on the PACKED device layout: re/im as axis 2 of
+    (4,3,2,T,Z,YX) — same real-arithmetic reductions (layout-agnostic),
+    different stacking axis (ops/wilson_packed pair stencils)."""
+    from ..ops import pair as pops
+    from ..ops import wilson_packed as wpk
+    f32 = jnp.float32
+    return StorageCodec(
+        down=lambda x: wpk.to_packed_pairs(x, store_dtype),
+        up=lambda x: wpk.from_packed_pairs(x, precise_dtype),
+        norm2=pops.pair_norm2,
+        redot=pops.pair_redot,
+        axpy=lambda a, x, y: (y.astype(f32)
+                              + a.astype(f32) * x.astype(f32)
+                              ).astype(store_dtype))
+
+
 def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
                 sloppy_dtype=None, tol: float = 1e-10, maxiter: int = 2000,
                 delta: float = 0.1,

@@ -175,3 +175,55 @@ def test_shift_packed_nhop3():
                 wpk.shift_packed(pp, mu, sign, X, Y, nhop=3),
                 (T, Z, Y, X))
             assert jnp.array_equal(ref, got), (mu, sign)
+
+
+def test_packed_pair_sloppy_stencil():
+    """bf16 pair-form packed eo stencil tracks the exact packed eo hop."""
+    from quda_tpu.models.wilson import DiracWilsonPC
+    from quda_tpu.fields.spinor import even_odd_split
+    geom = LatticeGeometry((8, 4, 6, 4))
+    T, Z, Y, X = geom.lattice_shape
+    gauge = GaugeField.random(jax.random.PRNGKey(31), geom).data
+    dpc = DiracWilsonPC(gauge, geom, 0.12)
+    dpk = dpc.packed()
+    sl = dpk.sloppy()
+    v = even_odd_split(
+        ColorSpinorField.gaussian(jax.random.PRNGKey(32), geom).data,
+        geom)[0].astype(jnp.complex64)
+    vp = wpk.pack_spinor(v)
+    exact = dpk.M(vp)
+    got = sl.M(vp)
+    rel = float(jnp.sqrt(blas.norm2(exact - got) / blas.norm2(exact)))
+    assert rel < 0.02
+
+
+def test_api_packed_mixed_solve(monkeypatch):
+    """invert_quda with QUDA_TPU_PACKED=1: the whole Krylov loop runs in
+    the packed layout with the bf16 packed-pair sloppy operator."""
+    import os
+    from quda_tpu.interfaces.params import GaugeParam, InvertParam
+    from quda_tpu.interfaces.quda_api import (init_quda, invert_quda,
+                                              load_gauge_quda)
+    from quda_tpu.models.wilson import DiracWilson
+    monkeypatch.setenv("QUDA_TPU_PACKED", "1")
+    geom = LatticeGeometry((4, 4, 4, 4))
+    gauge = GaugeField.random(jax.random.PRNGKey(41), geom).data
+    b = ColorSpinorField.gaussian(jax.random.PRNGKey(42), geom).data
+    init_quda()
+    load_gauge_quda(gauge, GaugeParam(X=geom.dims, cuda_prec="double"))
+    p = InvertParam(dslash_type="wilson", kappa=0.12, inv_type="cg",
+                    solve_type="normop-pc", tol=1e-9, maxiter=2000,
+                    cuda_prec="double", cuda_prec_sloppy="half")
+    x = invert_quda(b, p)
+    d = DiracWilson(gauge, geom, 0.12)
+    rel = float(jnp.sqrt(blas.norm2(b - d.M(jnp.asarray(x)))
+                         / blas.norm2(b)))
+    assert rel < 1e-8
+    # pure-precision packed path too
+    p2 = InvertParam(dslash_type="wilson", kappa=0.12, inv_type="bicgstab",
+                     solve_type="direct-pc", tol=1e-9, maxiter=2000,
+                     cuda_prec="double", cuda_prec_sloppy="half")
+    x2 = invert_quda(b, p2)
+    rel2 = float(jnp.sqrt(blas.norm2(b - d.M(jnp.asarray(x2)))
+                          / blas.norm2(b)))
+    assert rel2 < 1e-7
