@@ -1,0 +1,69 @@
+"""ARPACK bridge: host-side Arnoldi/Lanczos through scipy's ARPACK.
+
+Reference behavior: lib/arpack_interface.cpp (QUDA_EIG_ARPACK) — QUDA
+hands the reverse-communication loop to ARPACK and supplies matvecs.
+Here the device matvec is wrapped as a scipy LinearOperator: each
+reverse-communication vector crosses host<->device once per iteration,
+so this is the robustness/validation path, not the fast one (TRLM/IRAM
+in eig/ run fully on device).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .lanczos import EigParam, EigResult
+
+
+def arpack_solve(matvec: Callable, example: jnp.ndarray, param: EigParam,
+                 hermitian: bool = False) -> EigResult:
+    """Smallest/largest eigenpairs via ARPACK (eigsh when hermitian).
+
+    The requested count is over-allocated (QUDA also requests extra
+    workspace: nKr > nEv) — ARPACK with an exact k on clustered spectra
+    can misconverge (observed; see tests/test_eig.py oracle note).
+    """
+    import scipy.sparse.linalg as ssl
+
+    shape = example.shape
+    dim = int(np.prod(shape))
+    mv = jax.jit(matvec)
+
+    def apply(a):
+        v = jnp.asarray(a.astype(np.complex128).reshape(shape))
+        return np.asarray(mv(v)).reshape(dim)
+
+    linop = ssl.LinearOperator((dim, dim), matvec=apply,
+                               dtype=np.complex128)
+    if param.n_ev > dim - 2:
+        raise ValueError(
+            f"arpack bridge: n_ev={param.n_ev} exceeds ARPACK's limit of "
+            f"dim-2 = {dim - 2} for this operator")
+    k = min(param.n_ev + 4, dim - 2)
+    which = {"SR": "SR", "LR": "LR", "SM": "SM", "LM": "LM"}[param.spectrum]
+    v0 = np.full(dim, 1.0 + 0.5j, dtype=np.complex128)
+    if hermitian:
+        which_h = {"SR": "SA", "LR": "LA", "SM": "SM",
+                   "LM": "LM"}[param.spectrum]
+        vals, vecs = ssl.eigsh(linop, k=k, which=which_h, v0=v0,
+                               tol=param.tol, maxiter=param.max_restarts
+                               * param.n_kr)
+    else:
+        vals, vecs = ssl.eigs(linop, k=k, which=which, v0=v0,
+                              tol=param.tol,
+                              maxiter=param.max_restarts * param.n_kr)
+    # order by the requested spectrum and keep n_ev
+    key = {"SR": vals.real, "LR": -vals.real,
+           "SM": np.abs(vals), "LM": -np.abs(vals)}[param.spectrum]
+    order = np.argsort(key)[:param.n_ev]
+    vals = vals[order]
+    evecs = jnp.asarray(vecs[:, order].T.reshape((param.n_ev,) + shape))
+    residua = []
+    for i in range(param.n_ev):
+        r = mv(evecs[i]) - vals[i] * evecs[i]
+        residua.append(float(jnp.sqrt(jnp.sum(jnp.abs(r) ** 2))))
+    return EigResult(vals, evecs, np.asarray(residua), 0, True)

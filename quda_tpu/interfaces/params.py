@@ -145,7 +145,8 @@ class EigParamAPI:
     vec_infile: str = ""
 
     def validate(self):
-        _check(self.eig_type in ("trlm", "iram"), "bad eig_type")
+        _check(self.eig_type in ("trlm", "iram", "arpack"),
+               "bad eig_type")
         _check(0 < self.n_ev < self.n_kr, "need n_ev < n_kr")
         return self
 

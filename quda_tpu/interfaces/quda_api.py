@@ -601,6 +601,11 @@ def eigensolve_quda(eig_param: EigParamAPI, invert_param: InvertParam):
         op = d.M
     if eig_param.eig_type == "trlm":
         res = trlm(op, example, p)
+    elif eig_param.eig_type == "arpack":
+        # host ARPACK bridge (lib/arpack_interface.cpp analog)
+        from ..eig.arpack_bridge import arpack_solve
+        res = arpack_solve(op, example, p,
+                           hermitian=eig_param.use_norm_op)
     else:
         res = iram(op, example, p)
     if eig_param.vec_outfile:

@@ -213,19 +213,60 @@ def dslash_pallas(gauge: jnp.ndarray, psi: jnp.ndarray,
     return _unpairs(out)
 
 
-def tuned_dslash(gauge: jnp.ndarray, psi: jnp.ndarray):
-    """Autotuned Wilson hop: times the XLA and Pallas paths once per
-    (volume, dtype) and caches the winner (lib/tune.cpp tuneLaunch analog;
-    on CPU backends only the XLA path is eligible)."""
-    from ..ops import wilson as wops
-    from ..utils import tune
+_TUNED_CACHE = {}
 
-    if jax.default_backend() != "tpu":
-        return wops.dslash_full(gauge, psi)
+
+def _tuned_candidates(lat, dtype_str, backend):
+    """Jitted candidate set per (lattice, dtype, backend) — cached at
+    module level so repeat tuned_dslash calls reuse the jit caches."""
+    key = (lat, dtype_str, backend)
+    if key in _TUNED_CACHE:
+        return _TUNED_CACHE[key]
+    from ..ops import wilson as wops
+    from ..ops import wilson_packed as wpk
+    T, Z, Y, X = lat
+
+    def packed_xla(g, p):
+        out = wpk.dslash_packed(wpk.pack_gauge(g), wpk.pack_spinor(p),
+                                X, Y)
+        return wpk.unpack_spinor(out, (T, Z, Y, X))
+
     candidates = {
         "xla": jax.jit(wops.dslash_full),
-        "pallas": jax.jit(lambda g, p: dslash_pallas(g, p)),
+        "xla_packed": jax.jit(packed_xla),
     }
-    winner = tune.tune("wilson_dslash", tuple(psi.shape[:4]), candidates,
-                       (gauge, psi), aux=str(psi.dtype))
+    if backend == "tpu":
+        from .wilson_pallas_packed import (dslash_pallas_packed,
+                                           from_pallas_layout,
+                                           to_pallas_layout)
+
+        def pallas_packed(g, p):
+            gp = to_pallas_layout(wpk.pack_gauge(g))
+            pp = to_pallas_layout(wpk.pack_spinor(p))
+            out = from_pallas_layout(dslash_pallas_packed(gp, pp, X),
+                                     p.dtype)
+            return wpk.unpack_spinor(out, (T, Z, Y, X))
+
+        candidates["pallas_packed"] = jax.jit(pallas_packed)
+    _TUNED_CACHE[key] = candidates
+    return candidates
+
+
+def tuned_dslash(gauge: jnp.ndarray, psi: jnp.ndarray):
+    """Autotuned Wilson hop on CANONICAL-layout arrays: times the
+    canonical-XLA, packed-XLA and (TPU) packed-pallas paths once per
+    (volume, dtype) and caches the winner (lib/tune.cpp tuneLaunch
+    analog).  The packed candidates include the pack/unpack conversions,
+    so the cached winner is honest for a caller holding canonical
+    arrays; solvers that keep fields packed
+    (models/wilson.DiracWilsonPCPacked) skip the conversions entirely.
+    Jitted candidates are cached at module level, so repeat calls hit
+    the compiled winner directly."""
+    from ..utils import tune
+
+    lat = tuple(psi.shape[:4])
+    candidates = _tuned_candidates(lat, str(psi.dtype),
+                                   jax.default_backend())
+    winner = tune.tune("wilson_dslash", lat, candidates, (gauge, psi),
+                       aux=str(psi.dtype))
     return candidates[winner](gauge, psi)

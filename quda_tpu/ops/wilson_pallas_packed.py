@@ -42,12 +42,15 @@ F32 = jnp.float32
 # -- layout conversion ------------------------------------------------------
 
 def to_pallas_layout(arr: jnp.ndarray) -> jnp.ndarray:
-    """complex packed (..., T, Z, YX) -> float pairs (..., 2, T, Z, YX)."""
-    return jnp.stack([arr.real, arr.imag], axis=-4).astype(F32)
+    """complex packed (..., T, Z, YX) -> f32 pairs (..., 2, T, Z, YX)
+    (delegates to the single pair-layout converter in wilson_packed)."""
+    from .wilson_packed import to_packed_pairs
+    return to_packed_pairs(arr, F32)
 
 
 def from_pallas_layout(arr: jnp.ndarray, dtype=jnp.complex64) -> jnp.ndarray:
-    return (arr[..., 0, :, :, :] + 1j * arr[..., 1, :, :, :]).astype(dtype)
+    from .wilson_packed import from_packed_pairs
+    return from_packed_pairs(arr, dtype)
 
 
 # -- in-kernel complex helpers on (re, im) tuples of (Z, YX) tiles ---------

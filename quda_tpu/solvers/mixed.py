@@ -55,34 +55,35 @@ def dtype_codec(sloppy_dtype, precise_dtype) -> StorageCodec:
         axpy=lambda a, x, y: y + a.astype(sloppy_dtype) * x)
 
 
-def pair_codec(store_dtype, precise_dtype) -> StorageCodec:
+def _make_pair_codec(down, up, store_dtype) -> StorageCodec:
+    """Shared reductions/axpy for every pair-storage layout — ONE home
+    for the f32-accumulate rounding policy the reliable updates rely on;
+    layouts differ only in their down/up converters."""
     from ..ops import pair as pops
     f32 = jnp.float32
     return StorageCodec(
-        down=lambda x: pops.to_pairs(x, store_dtype),
-        up=lambda x: pops.from_pairs(x, precise_dtype),
+        down=down, up=up,
         norm2=pops.pair_norm2,
         redot=pops.pair_redot,
         axpy=lambda a, x, y: (y.astype(f32)
                               + a.astype(f32) * x.astype(f32)
                               ).astype(store_dtype))
+
+
+def pair_codec(store_dtype, precise_dtype) -> StorageCodec:
+    from ..ops import pair as pops
+    return _make_pair_codec(
+        lambda x: pops.to_pairs(x, store_dtype),
+        lambda x: pops.from_pairs(x, precise_dtype), store_dtype)
 
 
 def packed_pair_codec(store_dtype, precise_dtype) -> StorageCodec:
     """Pair storage on the PACKED device layout: re/im as axis 2 of
-    (4,3,2,T,Z,YX) — same real-arithmetic reductions (layout-agnostic),
-    different stacking axis (ops/wilson_packed pair stencils)."""
-    from ..ops import pair as pops
+    (4,3,2,T,Z,YX) (ops/wilson_packed pair stencils)."""
     from ..ops import wilson_packed as wpk
-    f32 = jnp.float32
-    return StorageCodec(
-        down=lambda x: wpk.to_packed_pairs(x, store_dtype),
-        up=lambda x: wpk.from_packed_pairs(x, precise_dtype),
-        norm2=pops.pair_norm2,
-        redot=pops.pair_redot,
-        axpy=lambda a, x, y: (y.astype(f32)
-                              + a.astype(f32) * x.astype(f32)
-                              ).astype(store_dtype))
+    return _make_pair_codec(
+        lambda x: wpk.to_packed_pairs(x, store_dtype),
+        lambda x: wpk.from_packed_pairs(x, precise_dtype), store_dtype)
 
 
 def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,

@@ -150,3 +150,26 @@ def test_deflation_cuts_iterations(setup):
     assert int(warm.iters) < int(cold.iters)
     r2 = blas.norm2(b - dpc.MdagM(warm.x))
     assert float(jnp.sqrt(r2 / blas.norm2(b))) < 2e-10
+
+
+def test_arpack_bridge_through_api():
+    """eig_type='arpack' (the lib/arpack_interface.cpp analog) matches
+    TRLM through the public eigensolve_quda entry point."""
+    from quda_tpu.interfaces.params import (EigParamAPI, GaugeParam,
+                                            InvertParam)
+    from quda_tpu.interfaces.quda_api import (eigensolve_quda, init_quda,
+                                              load_gauge_quda)
+    geom = LatticeGeometry((4, 4, 4, 4))
+    gauge = GaugeField.random(jax.random.PRNGKey(1), geom).data
+    init_quda()
+    load_gauge_quda(gauge, GaugeParam(X=geom.dims, cuda_prec="double"))
+    ip = InvertParam(dslash_type="wilson", kappa=0.12,
+                     solve_type="normop-pc", cuda_prec="double",
+                     cuda_prec_sloppy="double")
+    ep_a = EigParamAPI(eig_type="arpack", n_ev=4, spectrum="SR", tol=1e-8)
+    vals_a, vecs_a = eigensolve_quda(ep_a, ip)
+    ep_t = EigParamAPI(eig_type="trlm", n_ev=4, n_kr=32, spectrum="SR",
+                       tol=1e-9, max_restarts=200)
+    vals_t, _ = eigensolve_quda(ep_t, ip)
+    assert np.allclose(np.sort(np.asarray(vals_a).real),
+                       np.sort(np.asarray(vals_t).real)[:4], rtol=1e-6)

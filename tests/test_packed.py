@@ -219,10 +219,11 @@ def test_api_packed_mixed_solve(monkeypatch):
     rel = float(jnp.sqrt(blas.norm2(b - d.M(jnp.asarray(x)))
                          / blas.norm2(b)))
     assert rel < 1e-8
-    # pure-precision packed path too
+    # pure-precision packed path (sloppy == prec disables the pair
+    # branch, so the plain solver runs on the packed operator directly)
     p2 = InvertParam(dslash_type="wilson", kappa=0.12, inv_type="bicgstab",
                      solve_type="direct-pc", tol=1e-9, maxiter=2000,
-                     cuda_prec="double", cuda_prec_sloppy="half")
+                     cuda_prec="double", cuda_prec_sloppy="double")
     x2 = invert_quda(b, p2)
     rel2 = float(jnp.sqrt(blas.norm2(b - d.M(jnp.asarray(x2)))
                           / blas.norm2(b)))
