@@ -203,21 +203,29 @@ class MG:
     # -- setup ---------------------------------------------------------
     def _generate_null_vectors(self, op_M, op_MdagM, example, n_vec, iters,
                                key):
-        """Inverse iteration: v = (MdagM)^{-1}-ish random, normalised."""
-        vecs = []
-        solve = jax.jit(
-            lambda b: cg_fixed_iters(op_MdagM, b, None, iters)[0].x)
-        for i in range(n_vec):
+        """Inverse iteration: v = (MdagM)^{-1}-ish random, normalised.
+        All n_vec solves run as ONE vmapped fixed-iteration CG (a single
+        compiled computation — the setup-dominant cost of MG::reset)."""
+        rdt = jnp.zeros((), example.dtype).real.dtype
+
+        def make_b(i):
             k = jax.random.fold_in(key, i)
-            rdt = jnp.zeros((), example.dtype).real.dtype
             re = jax.random.normal(k, example.shape, rdt)
             im = jax.random.normal(jax.random.fold_in(k, 1), example.shape,
                                    rdt)
-            b = (re + 1j * im).astype(example.dtype)
-            v = solve(b)
-            v = v / jnp.sqrt(blas.norm2(v)).astype(v.dtype)
-            vecs.append(v)
-        return jnp.stack(vecs)
+            return (re + 1j * im).astype(example.dtype)
+
+        bs = jnp.stack([make_b(i) for i in range(n_vec)])
+
+        @jax.jit
+        def solve_all(bb):
+            xs = jax.vmap(
+                lambda b: cg_fixed_iters(op_MdagM, b, None, iters)[0].x)(bb)
+            norms = jax.vmap(blas.norm2)(xs)
+            scale = (1.0 / jnp.sqrt(norms)).astype(xs.dtype)
+            return xs * scale.reshape((n_vec,) + (1,) * (xs.ndim - 1))
+
+        return solve_all(bs)
 
     def _setup(self, adapter, key, verbosity):
         level_op = adapter

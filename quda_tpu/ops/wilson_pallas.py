@@ -265,8 +265,10 @@ def tuned_dslash(gauge: jnp.ndarray, psi: jnp.ndarray):
     from ..utils import tune
 
     lat = tuple(psi.shape[:4])
-    candidates = _tuned_candidates(lat, str(psi.dtype),
-                                   jax.default_backend())
+    backend = jax.default_backend()
+    candidates = _tuned_candidates(lat, str(psi.dtype), backend)
+    # backend in the cache key: a winner tuned on CPU must not pin a TPU
+    # run (candidate sets and timings are backend-dependent)
     winner = tune.tune("wilson_dslash", lat, candidates, (gauge, psi),
-                       aux=str(psi.dtype))
+                       aux=f"{psi.dtype}/{backend}")
     return candidates[winner](gauge, psi)
