@@ -44,8 +44,15 @@ def init_quda(device: int = 0):
 
 
 def end_quda():
+    # gauge_epoch stays MONOTONE across re-initialisation: resident
+    # caches elsewhere (interfaces/milc.py) key on it, and a reset would
+    # let a post-reinit epoch collide with a pre-reset one, reviving
+    # stale operators built against the old gauge.
+    keep_epoch = _ctx["gauge_epoch"]
     for k in list(_ctx):
         _ctx[k] = None if k != "initialized" else False
+    _ctx["gauge_epoch"] = keep_epoch
+    _ctx["mg_epoch"] = -1
     from ..utils.timer import print_summary
     print_summary()
 

@@ -217,15 +217,23 @@ class MG:
 
         bs = jnp.stack([make_b(i) for i in range(n_vec)])
 
+        # chunked vmap: all solves in one compiled computation per chunk,
+        # but peak memory capped at ~chunk Krylov states (a full-width
+        # vmap holds n_vec concurrent (x, r, p, Ap) sets — an OOM risk
+        # on fine lattices where the sequential loop fit)
+        chunk = min(n_vec, 4)
+
         @jax.jit
-        def solve_all(bb):
+        def solve_chunk(bb):
             xs = jax.vmap(
                 lambda b: cg_fixed_iters(op_MdagM, b, None, iters)[0].x)(bb)
             norms = jax.vmap(blas.norm2)(xs)
             scale = (1.0 / jnp.sqrt(norms)).astype(xs.dtype)
-            return xs * scale.reshape((n_vec,) + (1,) * (xs.ndim - 1))
+            return xs * scale.reshape(scale.shape + (1,) * (xs.ndim - 1))
 
-        return solve_all(bs)
+        outs = [solve_chunk(bs[i:i + chunk])
+                for i in range(0, n_vec, chunk)]
+        return jnp.concatenate(outs) if len(outs) > 1 else outs[0]
 
     def _setup(self, adapter, key, verbosity):
         level_op = adapter
