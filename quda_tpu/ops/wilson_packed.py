@@ -288,6 +288,28 @@ def _pp_add(a, b):
     return (a[0] + b[0], a[1] + b[1])
 
 
+def _planes_psi(arr):
+    """(4,3,2,...) pair storage -> {(spin, color): (re, im)} f32 planes."""
+    a = arr.astype(jnp.float32)
+    return {(s, c): (a[s, c, 0], a[s, c, 1])
+            for s in range(4) for c in range(3)}
+
+
+def _planes_u(arr):
+    """(3,3,2,...) pair storage -> {(row, col): (re, im)} f32 planes."""
+    a = arr.astype(jnp.float32)
+    return {(i, j): (a[i, j, 0], a[i, j, 1])
+            for i in range(3) for j in range(3)}
+
+
+def _stack_pairs(acc, out_dtype):
+    """acc[s][c] = (re, im) planes -> (4,3,2,...) array of out_dtype."""
+    return jnp.stack([
+        jnp.stack([jnp.stack([acc[s][c][0], acc[s][c][1]])
+                   for c in range(3)])
+        for s in range(4)]).astype(out_dtype)
+
+
 def _hop_packed_pairs(psi_s, u, table, adjoint: bool):
     """Pair-form analog of _hop_packed.  psi_s[(s,c)] / u[(a,b)] are
     (re, im) tuples of f32 lattice planes."""
@@ -309,6 +331,36 @@ def _hop_packed_pairs(psi_s, u, table, adjoint: bool):
             [_pp_cscale(t["d3"], uh[t["k3"]][c]) for c in range(3)]]
 
 
+def dslash_packed_pairs(gauge_pp: jnp.ndarray, psi_pp: jnp.ndarray,
+                        X: int, Y: int, out_dtype=None) -> jnp.ndarray:
+    """Full-lattice Wilson hop on PAIR-FORM packed arrays — no complex
+    dtype anywhere (some TPU runtimes cannot execute complex64; this is
+    also the honest single-precision path to compare against GPU f32
+    dslash numbers).
+
+    gauge_pp: (4,3,3,2,T,Z,Y*X) storage (f32 or bf16), phases folded;
+    psi_pp: (4,3,2,T,Z,Y*X).  Compute f32; output cast to ``out_dtype``
+    (default: psi storage dtype).
+    """
+    out_dtype = out_dtype or psi_pp.dtype
+    acc = None
+    for mu in range(4):
+        u = gauge_pp[mu]
+        fwd = _hop_packed_pairs(
+            _planes_psi(shift_packed(psi_pp, mu, +1, X, Y)),
+            _planes_u(u), TABLES[(mu, +1)], adjoint=False)
+        bwd = _hop_packed_pairs(
+            _planes_psi(shift_packed(psi_pp, mu, -1, X, Y)),
+            _planes_u(shift_packed(u, mu, -1, X, Y)),
+            TABLES[(mu, -1)], adjoint=True)
+        term = [[_pp_add(f, b) for f, b in zip(fs, bs)]
+                for fs, bs in zip(fwd, bwd)]
+        acc = term if acc is None else [
+            [_pp_add(a, t) for a, t in zip(as_, ts)]
+            for as_, ts in zip(acc, term)]
+    return _stack_pairs(acc, out_dtype)
+
+
 def dslash_eo_packed_pairs(gauge_eo_pp, psi_pp: jnp.ndarray, dims,
                            target_parity: int,
                            out_dtype=None) -> jnp.ndarray:
@@ -320,39 +372,21 @@ def dslash_eo_packed_pairs(gauge_eo_pp, psi_pp: jnp.ndarray, dims,
     to ``out_dtype`` (default: psi storage dtype).
     """
     out_dtype = out_dtype or psi_pp.dtype
-    f32 = jnp.float32
-
-    def planes_psi(arr):
-        a = arr.astype(f32)
-        return {(s, c): (a[s, c, 0], a[s, c, 1])
-                for s in range(4) for c in range(3)}
-
-    def planes_u(arr4, mu):
-        a = arr4[mu].astype(f32)
-        return {(i, j): (a[i, j, 0], a[i, j, 1])
-                for i in range(3) for j in range(3)}
-
     u_here = gauge_eo_pp[target_parity]
     u_there = gauge_eo_pp[1 - target_parity]
     acc = None
     for mu in range(4):
         fwd_arr = shift_eo_packed(psi_pp, dims, mu, +1, target_parity)
-        fwd = _hop_packed_pairs(planes_psi(fwd_arr),
-                                planes_u(u_here, mu),
+        fwd = _hop_packed_pairs(_planes_psi(fwd_arr),
+                                _planes_u(u_here[mu]),
                                 TABLES[(mu, +1)], adjoint=False)
         ub = shift_eo_packed(u_there[mu], dims, mu, -1, target_parity)
-        ub_pl = {(i, j): (ub[i, j, 0].astype(f32), ub[i, j, 1].astype(f32))
-                 for i in range(3) for j in range(3)}
         bwd_arr = shift_eo_packed(psi_pp, dims, mu, -1, target_parity)
-        bwd = _hop_packed_pairs(planes_psi(bwd_arr), ub_pl,
+        bwd = _hop_packed_pairs(_planes_psi(bwd_arr), _planes_u(ub),
                                 TABLES[(mu, -1)], adjoint=True)
         term = [[_pp_add(f, b) for f, b in zip(fs, bs)]
                 for fs, bs in zip(fwd, bwd)]
         acc = term if acc is None else [
             [_pp_add(a, t) for a, t in zip(as_, ts)]
             for as_, ts in zip(acc, term)]
-    out = jnp.stack([
-        jnp.stack([jnp.stack([acc[s][c][0], acc[s][c][1]])
-                   for c in range(3)])
-        for s in range(4)])
-    return out.astype(out_dtype)
+    return _stack_pairs(acc, out_dtype)

