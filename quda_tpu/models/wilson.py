@@ -236,6 +236,16 @@ class DiracWilsonPCPacked:
         int8 'quarter' falls back to bf16 storage here)."""
         return DiracWilsonPCPackedSloppy(self)
 
+    def pairs(self, store_dtype=jnp.bfloat16) -> "DiracWilsonPCPackedSloppy":
+        """Pair-storage companion at an arbitrary storage dtype.
+
+        With f32 storage this is the PRECISE operator in a fully
+        complex-free representation — required end-to-end on TPU
+        runtimes that cannot execute complex64 (see bench.py), and the
+        native-order analog of QUDA keeping solver fields in float2/
+        float4 orders (no complex type on the device either)."""
+        return DiracWilsonPCPackedSloppy(self, store_dtype)
+
     def codec(self, precise_dtype, store_dtype=None):
         """StorageCodec matching this operator's sloppy representation
         (pass the built sloppy operator's store_dtype)."""
@@ -251,14 +261,15 @@ class DiracWilsonPCPackedSloppy(_PairSloppyBase):
 
     _spin_axis = 0
 
-    def __init__(self, dpk: "DiracWilsonPCPacked"):
+    def __init__(self, dpk: "DiracWilsonPCPacked", store_dtype=jnp.bfloat16):
         from ..ops import wilson_packed as wpk
         self.geom = dpk.geom
         self.kappa = float(dpk.kappa)
         self.matpc = dpk.matpc
         self.dims = dpk.dims
+        self.store_dtype = store_dtype
         self.gauge_eo_pp = tuple(
-            wpk.to_packed_pairs(g, jnp.bfloat16) for g in dpk.gauge_eo_p)
+            wpk.to_packed_pairs(g, store_dtype) for g in dpk.gauge_eo_p)
 
     def _d_to(self, psi_pp, target_parity, out_dtype):
         from ..ops import wilson_packed as wpk

@@ -11,7 +11,7 @@ from .gcr import gcr, mr, mr_fixed, sd  # noqa: F401
 from .ca import ca_cg, ca_gcr  # noqa: F401
 from .multishift import multishift_cg  # noqa: F401
 from .mixed import (cg_reliable, dtype_codec, pair_codec,  # noqa: F401
-                    solve_refined)
+                    pair_inplace_codec, solve_refined)
 from .chrono import ChronoStore, mre_guess  # noqa: F401
 
 _REGISTRY = {

@@ -86,6 +86,17 @@ def packed_pair_codec(store_dtype, precise_dtype) -> StorageCodec:
         lambda x: wpk.from_packed_pairs(x, precise_dtype), store_dtype)
 
 
+def pair_inplace_codec(store_dtype) -> StorageCodec:
+    """Codec for when the PRECISE representation is itself an f32 pair
+    array on the SAME layout as the sloppy storage — the fully
+    complex-free solve path (TPU runtimes without complex64 execution;
+    also the zero-conversion native-order path).  down/up are plain
+    dtype casts."""
+    return _make_pair_codec(
+        lambda x: x.astype(store_dtype),
+        lambda x: x.astype(jnp.float32), store_dtype)
+
+
 def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
                 sloppy_dtype=None, tol: float = 1e-10, maxiter: int = 2000,
                 delta: float = 0.1,
