@@ -1,0 +1,234 @@
+"""blasGEMMQuda / blasLUInvQuda analogs — the public batched-BLAS entry
+points.
+
+Reference behavior: `include/quda.h:1779-1788` (blasGEMMQuda,
+blasLUInvQuda) with QudaBLASParam (`include/quda.h:871-902`), dispatched
+in `lib/interface/blas_interface.cpp` to strided-batch GEMM / batched
+LU-inverse backends (cuBLAS or Eigen).  Semantics implemented here:
+
+- flat host arrays addressed by (offset, leading dimension, stride),
+  where strides are in units of matrices and stride == 0 means densely
+  packed (`lib/targets/generic/blas_lapack_eigen.cpp`: effective element
+  stride = batch_matrix_size * max(stride, 1));
+- op(A)/op(B) in {n, t, c} (none / transpose / conjugate-transpose);
+- row- or column-major storage (the reference swaps A<->B and re-labels
+  dims to feed column-major cuBLAS; here the order just selects the
+  reshape);
+- alpha/beta complex scalars, C = alpha op(A) op(B) + beta C;
+- data types S/C/D/Z.  S/C run batched on the accelerator via jnp
+  einsum / jnp.linalg.inv (XLA batched GEMM / LU are MXU-native);
+  D/Z have no TPU hardware path and run on the host via numpy —
+  same split the reference makes between native and generic backends.
+
+The in-framework compute path never uses these (solvers/MG call
+`jnp.einsum`/`jnp.linalg` directly under jit); they exist for API parity
+with host applications that call QUDA as a BLAS utility.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from .params import _check
+
+BLAS_DTYPES = {"S": np.float32, "D": np.float64,
+               "C": np.complex64, "Z": np.complex128}
+
+
+@dataclasses.dataclass
+class BLASParam:
+    """QudaBLASParam (quda.h:871).  Defaults follow newQudaBLASParam."""
+    blas_type: str = "gemm"        # gemm | lu-inv
+    trans_a: str = "n"             # n | t | c
+    trans_b: str = "n"
+    m: int = 0
+    n: int = 0
+    k: int = 0
+    lda: int = 0
+    ldb: int = 0
+    ldc: int = 0
+    a_offset: int = 0
+    b_offset: int = 0
+    c_offset: int = 0
+    a_stride: int = 1              # units of matrices; 0 = packed
+    b_stride: int = 1
+    c_stride: int = 1
+    alpha: complex = 1.0
+    beta: complex = 0.0
+    inv_mat_size: int = 0          # rank of the square matrix for lu-inv
+    batch_count: int = 1
+    data_type: str = "C"           # S | D | C | Z
+    data_order: str = "col"        # row | col
+
+    def validate(self):
+        _check(self.blas_type in ("gemm", "lu-inv"),
+               f"bad blas_type {self.blas_type}")
+        _check(self.data_type in BLAS_DTYPES,
+               f"bad data_type {self.data_type}")
+        _check(self.data_order in ("row", "col"),
+               f"bad data_order {self.data_order}")
+        _check(self.batch_count > 0, "batch_count must be positive")
+        if self.blas_type == "gemm":
+            _check(self.trans_a in ("n", "t", "c"), "bad trans_a")
+            _check(self.trans_b in ("n", "t", "c"), "bad trans_b")
+            _check(self.m > 0 and self.n > 0 and self.k > 0,
+                   f"bad gemm dims m={self.m} n={self.n} k={self.k}")
+            _check(min(self.a_stride, self.b_stride, self.c_stride) >= 0,
+                   "BLAS strides must be positive or zero")
+            # leading-dimension consistency (checkBLASParam analog)
+            if self.data_order == "col":
+                _check(self.lda >= (self.m if self.trans_a == "n" else
+                                    self.k), "lda too small")
+                _check(self.ldb >= (self.k if self.trans_b == "n" else
+                                    self.n), "ldb too small")
+                _check(self.ldc >= self.m, "ldc too small")
+            else:
+                _check(self.lda >= (self.k if self.trans_a == "n" else
+                                    self.m), "lda too small")
+                _check(self.ldb >= (self.n if self.trans_b == "n" else
+                                    self.k), "ldb too small")
+                _check(self.ldc >= self.n, "ldc too small")
+        else:
+            _check(self.inv_mat_size > 0, "inv_mat_size must be positive")
+        return self
+
+    def describe(self) -> str:
+        return "\n".join(f"{f.name} = {getattr(self, f.name)}"
+                         for f in dataclasses.fields(self))
+
+
+def _stored_dims(rows_op, cols_op, trans):
+    """(stored_rows, stored_cols) of the array holding op(X)."""
+    return (rows_op, cols_op) if trans == "n" else (cols_op, rows_op)
+
+
+def _gather_batch(flat, offset, ld, rows, cols, stride, order, nbatch):
+    """Slice nbatch (rows, cols) matrices out of a flat array.
+
+    Column-major: element (i, j) of batch b lives at
+    offset + b*elem_stride + j*ld + i; row-major swaps i/j roles.
+    elem_stride = matrix_size * max(stride, 1)  (stride in matrices,
+    0 = packed, matching blas_lapack's batch addressing).
+    """
+    if order == "col":
+        mat_elems = ld * cols
+        elem_stride = mat_elems * max(stride, 1)
+        need = offset + (nbatch - 1) * elem_stride + mat_elems
+        _check(flat.size >= need,
+               f"array too small: have {flat.size}, need {need}")
+        idx = (offset + np.arange(nbatch)[:, None, None] * elem_stride
+               + np.arange(cols)[None, :, None] * ld
+               + np.arange(rows)[None, None, :])
+        return flat[idx].transpose(0, 2, 1)      # -> (b, rows, cols)
+    mat_elems = rows * ld
+    elem_stride = mat_elems * max(stride, 1)
+    need = offset + (nbatch - 1) * elem_stride + mat_elems
+    _check(flat.size >= need,
+           f"array too small: have {flat.size}, need {need}")
+    idx = (offset + np.arange(nbatch)[:, None, None] * elem_stride
+           + np.arange(rows)[None, :, None] * ld
+           + np.arange(cols)[None, None, :])
+    return flat[idx]                             # (b, rows, cols)
+
+
+def _scatter_batch(flat, mats, offset, ld, rows, cols, stride, order):
+    """Inverse of _gather_batch: write (b, rows, cols) back into flat."""
+    nbatch = mats.shape[0]
+    if order == "col":
+        mat_elems = ld * cols
+        elem_stride = mat_elems * max(stride, 1)
+        idx = (offset + np.arange(nbatch)[:, None, None] * elem_stride
+               + np.arange(cols)[None, :, None] * ld
+               + np.arange(rows)[None, None, :])
+        flat[idx] = mats.transpose(0, 2, 1)
+    else:
+        mat_elems = rows * ld
+        elem_stride = mat_elems * max(stride, 1)
+        idx = (offset + np.arange(nbatch)[:, None, None] * elem_stride
+               + np.arange(rows)[None, :, None] * ld
+               + np.arange(cols)[None, None, :])
+        flat[idx] = mats
+
+
+def _apply_op(mats, trans):
+    if trans == "n":
+        return mats
+    if trans == "t":
+        return mats.transpose(0, 2, 1)
+    return np.conj(mats.transpose(0, 2, 1))
+
+
+def blas_gemm_quda(array_a, array_b, array_c, param: BLASParam,
+                   use_native: bool = True):
+    """C = alpha op(A) op(B) + beta C, strided-batched over flat arrays.
+
+    Returns a new flat array with the updated C (the C analog mutates
+    arrayC in place; a functional return fits the JAX world).  With
+    ``use_native`` and an S/C data type the batched product runs on the
+    accelerator; otherwise numpy on the host (the generic backend).
+    """
+    param.validate()
+    _check(param.blas_type == "gemm", "blas_gemm_quda needs blas_type=gemm")
+    dt = BLAS_DTYPES[param.data_type]
+    a = np.asarray(array_a).ravel().astype(dt, copy=False)
+    b = np.asarray(array_b).ravel().astype(dt, copy=False)
+    c = np.array(array_c).ravel().astype(dt)     # owning copy, mutated
+
+    ar, ac = _stored_dims(param.m, param.k, param.trans_a)
+    br, bc = _stored_dims(param.k, param.n, param.trans_b)
+    order = param.data_order
+    amats = _gather_batch(a, param.a_offset, param.lda, ar, ac,
+                          param.a_stride, order, param.batch_count)
+    bmats = _gather_batch(b, param.b_offset, param.ldb, br, bc,
+                          param.b_stride, order, param.batch_count)
+    cmats = _gather_batch(c, param.c_offset, param.ldc, param.m, param.n,
+                          param.c_stride, order, param.batch_count)
+
+    opa = _apply_op(amats, param.trans_a)        # (b, m, k)
+    opb = _apply_op(bmats, param.trans_b)        # (b, k, n)
+    alpha = dt(param.alpha) if param.data_type in ("C", "Z") else \
+        dt(np.real(param.alpha))
+    beta = dt(param.beta) if param.data_type in ("C", "Z") else \
+        dt(np.real(param.beta))
+
+    if use_native and param.data_type in ("S", "C"):
+        prod = np.asarray(jnp.einsum("bij,bjk->bik",
+                                     jnp.asarray(opa), jnp.asarray(opb)))
+    else:
+        prod = np.einsum("bij,bjk->bik", opa, opb)
+    out = (alpha * prod.astype(dt) + beta * cmats).astype(dt)
+
+    _scatter_batch(c, out, param.c_offset, param.ldc, param.m, param.n,
+                   param.c_stride, param.data_order)
+    return c
+
+
+def blas_lu_inv_quda(array_a, param: BLASParam, use_native: bool = True):
+    """Batched LU-based inverse of batch_count square matrices.
+
+    Reference: blasLUInvQuda (`include/quda.h:1788`), which ignores
+    leading dims / offsets / strides for inversions
+    (`lib/interface/blas_interface.cpp`: "Leading dims, strides, and
+    offsets are irrelevant for LU inversions") — matrices are densely
+    packed (batch, n, n) in the data order given.  Returns the packed
+    inverses as a flat array.
+    """
+    param.validate()
+    _check(param.blas_type == "lu-inv",
+           "blas_lu_inv_quda needs blas_type=lu-inv")
+    n = param.inv_mat_size
+    dt = BLAS_DTYPES[param.data_type]
+    a = np.asarray(array_a).ravel().astype(dt, copy=False)
+    _check(a.size >= param.batch_count * n * n,
+           f"array too small for {param.batch_count} {n}x{n} matrices")
+    # inv(A^T) = inv(A)^T, so the packed blocks invert identically in
+    # either data order — no transposes needed.
+    mats = a[:param.batch_count * n * n].reshape(param.batch_count, n, n)
+    if use_native and param.data_type in ("S", "C"):
+        inv = np.asarray(jnp.linalg.inv(jnp.asarray(mats)))
+    else:
+        inv = np.linalg.inv(mats)
+    return inv.astype(dt).reshape(-1)

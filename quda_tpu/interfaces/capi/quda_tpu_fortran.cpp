@@ -1,0 +1,98 @@
+/* Fortran ABI shim — the quda_fortran interface for the TPU build.
+ *
+ * Reference behavior: include/quda_fortran.h + lib/quda_fortran.F90
+ * expose the C API to Fortran hosts (BQCD-class codes) as trailing-
+ * underscore symbols with pass-by-reference arguments; errors abort the
+ * process (errorQuda semantics) since Fortran subroutines carry no
+ * status return.
+ *
+ * Strings do not cross this ABI (hidden-length argument conventions
+ * differ across Fortran compilers); enumerated options are integer
+ * codes, declared in quda_tpu_fortran.f90 alongside typed interface
+ * blocks.  The shim wraps the C entry points of quda_tpu_c.cpp, so the
+ * same libquda_tpu.so serves C and Fortran hosts.
+ */
+
+#include "quda_tpu.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <iterator>
+
+namespace {
+
+const char *DSLASH_CODES[] = {"wilson",        "clover",        "staggered",
+                              "asqtad",        "hisq",          "twisted-mass",
+                              "twisted-clover", "domain-wall",  "domain-wall-4d",
+                              "mobius",        "laplace"};
+const char *INV_CODES[] = {"cg",  "bicgstab", "gcr",    "mr",
+                           "ca-cg", "bicgstab-l", "ca-gcr"};
+const char *SOLVE_CODES[] = {"normop-pc", "direct-pc", "normop", "direct"};
+
+const char *decode(const char **table, int n, int code, const char *what) {
+  if (code < 0 || code >= n) {
+    std::fprintf(stderr, "quda_tpu fortran: bad %s code %d\n", what, code);
+    std::abort();
+  }
+  return table[code];
+}
+
+void check(int rc, const char *what) {
+  if (rc != 0) {
+    std::fprintf(stderr, "quda_tpu fortran: %s failed: %s\n", what,
+                 qtpu_error_string());
+    std::abort();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+/* init_quda_(device): device selection is owned by the JAX runtime on
+ * TPU; the argument is accepted for source compatibility. */
+void init_quda_(int *device) {
+  (void)device;
+  check(qtpu_init(), "init_quda");
+}
+
+void end_quda_(void) { check(qtpu_end(), "end_quda"); }
+
+/* load_gauge_quda_(links, X, antiperiodic_t): links in the
+ * direction-major layout of quda_tpu.h; X = {Lx,Ly,Lz,Lt}. */
+void load_gauge_quda_(double *links, int *X, int *antiperiodic_t) {
+  check(qtpu_load_gauge(links, X, *antiperiodic_t), "load_gauge_quda");
+}
+
+void plaq_quda_(double plaq[3]) { check(qtpu_plaq(plaq), "plaq_quda"); }
+
+/* invert_quda_(x, b, dslash_code, inv_code, solve_code, kappa, mass,
+ *              mu, csw, tol, maxiter, true_res, iters, secs)
+ * Integer codes per the tables in quda_tpu_fortran.f90. */
+void invert_quda_(double *x, double *b, int *dslash_code, int *inv_code,
+                  int *solve_code, double *kappa, double *mass, double *mu,
+                  double *csw, double *tol, int *maxiter, double *true_res,
+                  int *iters, double *secs) {
+  QTpuInvertArgs args;
+  args.dslash_type = decode(DSLASH_CODES, std::size(DSLASH_CODES),
+                            *dslash_code, "dslash_type");
+  args.inv_type = decode(INV_CODES, std::size(INV_CODES), *inv_code,
+                         "inv_type");
+  args.solve_type = decode(SOLVE_CODES, std::size(SOLVE_CODES),
+                           *solve_code, "solve_type");
+  args.kappa = *kappa;
+  args.mass = *mass;
+  args.mu = *mu;
+  args.csw = *csw;
+  args.tol = *tol;
+  args.maxiter = *maxiter;
+  args.true_res = 0.0;
+  args.iter_count = 0;
+  args.secs = 0.0;
+  check(qtpu_invert(x, b, &args), "invert_quda");
+  *true_res = args.true_res;
+  *iters = args.iter_count;
+  *secs = args.secs;
+}
+
+}  // extern "C"

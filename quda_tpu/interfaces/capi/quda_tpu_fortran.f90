@@ -1,0 +1,64 @@
+! quda_tpu Fortran bindings — typed interface blocks for the trailing-
+! underscore ABI of quda_tpu_fortran.cpp (reference: lib/quda_fortran.F90,
+! include/quda_fortran.h).
+!
+! Enumerated options cross the ABI as integer codes:
+!   dslash_type: 0 wilson, 1 clover, 2 staggered, 3 asqtad, 4 hisq,
+!                5 twisted-mass, 6 twisted-clover, 7 domain-wall,
+!                8 domain-wall-4d, 9 mobius, 10 laplace
+!   inv_type:    0 cg, 1 bicgstab, 2 gcr, 3 mr, 4 ca-cg, 5 bicgstab-l,
+!                6 ca-gcr
+!   solve_type:  0 normop-pc, 1 direct-pc, 2 normop, 3 direct
+!
+! Field layouts match quda_tpu.h: links are direction-major
+! [mu][t][z][y][x][row][col] complex(8); fermions site-major
+! [t][z][y][x][spin][color] complex(8).
+
+module quda_tpu
+  implicit none
+
+  integer, parameter :: QTPU_DSLASH_WILSON = 0, QTPU_DSLASH_CLOVER = 1, &
+       QTPU_DSLASH_STAGGERED = 2, QTPU_DSLASH_ASQTAD = 3, &
+       QTPU_DSLASH_HISQ = 4, QTPU_DSLASH_TWISTED_MASS = 5, &
+       QTPU_DSLASH_TWISTED_CLOVER = 6, QTPU_DSLASH_DOMAIN_WALL = 7, &
+       QTPU_DSLASH_DOMAIN_WALL_4D = 8, QTPU_DSLASH_MOBIUS = 9, &
+       QTPU_DSLASH_LAPLACE = 10
+  integer, parameter :: QTPU_INV_CG = 0, QTPU_INV_BICGSTAB = 1, &
+       QTPU_INV_GCR = 2, QTPU_INV_MR = 3, QTPU_INV_CA_CG = 4, &
+       QTPU_INV_BICGSTAB_L = 5, QTPU_INV_CA_GCR = 6
+  integer, parameter :: QTPU_SOLVE_NORMOP_PC = 0, &
+       QTPU_SOLVE_DIRECT_PC = 1, QTPU_SOLVE_NORMOP = 2, &
+       QTPU_SOLVE_DIRECT = 3
+
+  interface
+
+     subroutine init_quda(device)
+       integer, intent(in) :: device
+     end subroutine init_quda
+
+     subroutine end_quda()
+     end subroutine end_quda
+
+     subroutine load_gauge_quda(links, x, antiperiodic_t)
+       complex(8), intent(in) :: links(*)
+       integer, intent(in) :: x(4)
+       integer, intent(in) :: antiperiodic_t
+     end subroutine load_gauge_quda
+
+     subroutine plaq_quda(plaq)
+       real(8), intent(out) :: plaq(3)
+     end subroutine plaq_quda
+
+     subroutine invert_quda(x, b, dslash_code, inv_code, solve_code, &
+          kappa, mass, mu, csw, tol, maxiter, true_res, iters, secs)
+       complex(8), intent(inout) :: x(*)
+       complex(8), intent(in) :: b(*)
+       integer, intent(in) :: dslash_code, inv_code, solve_code
+       real(8), intent(in) :: kappa, mass, mu, csw, tol
+       integer, intent(in) :: maxiter
+       real(8), intent(out) :: true_res, secs
+       integer, intent(out) :: iters
+     end subroutine invert_quda
+
+  end interface
+end module quda_tpu

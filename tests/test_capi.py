@@ -46,6 +46,53 @@ def test_c_host_program(libpath, tmp_path):
     assert "C ABI test passed" in r.stdout
 
 
+def test_fortran_abi(libpath):
+    """Drive the trailing-underscore Fortran ABI (quda_tpu_fortran.cpp).
+
+    Calls go through ctypes with pass-by-reference arguments — the same
+    ABI a Fortran host (BQCD-class, reference include/quda_fortran.h)
+    produces for these interface blocks, so this validates the shim
+    without needing a Fortran compiler in the image.
+    """
+    lib = ctypes.CDLL(libpath)
+    byref, c_int, c_double = ctypes.byref, ctypes.c_int, ctypes.c_double
+
+    lib.init_quda_(byref(c_int(0)))
+
+    L = 4
+    vol = L ** 4
+    links = np.zeros((4, L, L, L, L, 3, 3), dtype=np.complex128)
+    links[..., 0, 0] = links[..., 1, 1] = links[..., 2, 2] = 1.0
+    X = (c_int * 4)(L, L, L, L)
+    lib.load_gauge_quda_(
+        links.ctypes.data_as(ctypes.POINTER(c_double)), X,
+        byref(c_int(1)))
+
+    plaq = (c_double * 3)()
+    lib.plaq_quda_(plaq)
+    assert abs(plaq[0] - 1.0) < 1e-12
+
+    rng = np.random.default_rng(0)
+    b = (rng.standard_normal((vol, 4, 3))
+         + 1j * rng.standard_normal((vol, 4, 3))).astype(np.complex128)
+    x = np.zeros_like(b)
+    true_res, secs = c_double(0.0), c_double(0.0)
+    iters = c_int(0)
+    lib.invert_quda_(
+        x.ctypes.data_as(ctypes.POINTER(c_double)),
+        b.ctypes.data_as(ctypes.POINTER(c_double)),
+        byref(c_int(0)),            # dslash: wilson
+        byref(c_int(0)),            # inv: cg
+        byref(c_int(0)),            # solve: normop-pc
+        byref(c_double(0.11)),      # kappa
+        byref(c_double(0.0)), byref(c_double(0.0)), byref(c_double(0.0)),
+        byref(c_double(1e-8)), byref(c_int(200)),
+        byref(true_res), byref(iters), byref(secs))
+    assert true_res.value <= 1e-7
+    assert iters.value > 0
+    assert np.abs(x).sum() > 0
+
+
 def test_ctypes_in_process(libpath):
     """Load the ABI into this (already-running) interpreter: the shim must
     detect Py_IsInitialized and reuse it."""
