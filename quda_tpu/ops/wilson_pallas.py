@@ -241,6 +241,11 @@ def _tuned_candidates(lat, dtype_str, backend):
                                            to_pallas_layout)
 
         def pallas_packed(g, p):
+            # canonical-entry one-shot path: the layout conversions AND
+            # the backward-gauge rolls are honestly part of the cost (a
+            # caller amortising over a fixed gauge should hold packed
+            # arrays and pass gauge_bw explicitly instead — see
+            # DiracWilsonPCPackedSloppy(use_pallas=True))
             gp = to_pallas_layout(wpk.pack_gauge(g))
             pp = to_pallas_layout(wpk.pack_spinor(p))
             out = from_pallas_layout(dslash_pallas_packed(gp, pp, X),

@@ -12,23 +12,39 @@ ops/wilson_packed.py, split into float re/im planes:
 so every (Z, Y*X) plane is a fully-utilised vector tile.  Grid =
 (T, Z/BZ): each program owns one (t, z-block) tile of the lattice.
 BlockSpec index maps deliver psi at (t, zb), its t+-1 and zb+-1
-neighbour tiles, the gauge tile at (t, zb), and the single-direction
-U_t(t-1) / U_z(zb-1) slices — each psi element is read 5x per
-application (own tile + 2 t-neighbours + 2 z-neighbours), gauge
-(18+4.5)/18x, vs full-array materialised copies per direction on the
-XLA path.  x/y shifts are lane rolls with an x-boundary mask built from
-an in-kernel iota; z shifts splice one boundary row from the
-neighbouring z-block; the spin algebra is the derived projection-table
-project -> 3x3 color multiply -> reconstruct of ops/wilson_pallas
-(reference include/kernels/dslash_wilson.cuh:84-162), in explicit
-re/im-pair arithmetic on (BZ, Y*X) tiles.
+neighbour tiles, the forward gauge tile at (t, zb) and the PRE-SHIFTED
+backward gauge tile (see below).  The spin algebra is the derived
+projection-table project -> 3x3 color multiply -> reconstruct of
+ops/wilson_pallas (reference include/kernels/dslash_wilson.cuh:84-162),
+in explicit re/im-pair arithmetic on (BZ, Y*X) tiles.
+
+Two design points keep the kernel off the VPU-issue wall (the first
+version measured ~50% of its HBM roofline, instruction-bound):
+
+1. **Project before shifting.**  The spin projection commutes with the
+   site shift (it is pointwise in space), so each hop projects the
+   4-spinor down to a half spinor FIRST and shifts 6 (spin,color) pairs
+   instead of 12 — halving the roll/select traffic of the x/y/z shift
+   network.  (QUDA's dslash reads shifted neighbours directly; on TPU
+   the shift is vector ALU work, so minimising shifted planes matters.)
+2. **Pre-shifted backward gauge.**  The backward hop needs
+   U_mu(x-mu)^dag.  Instead of shifting 18 link planes per direction
+   in-kernel, `backward_gauge(gauge_pl, X)` rolls the whole gauge field
+   once OUTSIDE the kernel (per gauge load, amortised over the solve)
+   and the kernel reads the pre-shifted tile — zero in-kernel link
+   shifts, at the cost of one extra resident gauge copy (+288 B/site
+   HBM read, a good trade while ALU-bound).
+
+x/y shifts are lane rolls with an x-boundary mask built from an
+in-kernel iota; z shifts splice one boundary row of the PROJECTED
+neighbour tile; t neighbours arrive as whole tiles via the index map.
 
 The z-block size BZ is chosen as the largest divisor of Z whose working
 set fits the scoped-VMEM budget (~16 MB on v5e, halved for Mosaic's
-double buffering): 276 planes of (BZ, YX padded to lane multiples) f32.
-Measured on a real v5e chip (2026-07-29): 1.65 TFLOPS at 16^4 — above
-the 1.4 TFLOPS A100-class baseline (BASELINE.md) and ~75% of the
-3-psi-fetch HBM roofline.
+double buffering).  Measured on a real v5e chip (2026-07-29): 1.49-1.65
+TFLOPS f32 at 24^4 for the 5x-psi-fetch version — above the 1.4 TFLOPS
+A100-class baseline (BASELINE.md); this version removes ~40% of its
+vector shift instructions.
 """
 
 from __future__ import annotations
@@ -56,6 +72,21 @@ def to_pallas_layout(arr: jnp.ndarray) -> jnp.ndarray:
 def from_pallas_layout(arr: jnp.ndarray, dtype=jnp.complex64) -> jnp.ndarray:
     from .wilson_packed import from_packed_pairs
     return from_packed_pairs(arr, dtype)
+
+
+def backward_gauge(gauge_pl: jnp.ndarray, X: int) -> jnp.ndarray:
+    """Gauge field shifted one site backward in its own direction:
+    out[mu](x) = U_mu(x - mu), on the pair layout (4,3,3,2,T,Z,YX).
+
+    Computed once per gauge load (outside the kernel) so backward hops
+    read links directly instead of shifting 18 planes per direction
+    in-kernel.  Delegates to wilson_packed.shift_packed (sign=-1) so the
+    packed-layout boundary logic lives in exactly one place.
+    """
+    from .wilson_packed import shift_packed
+    Y = gauge_pl.shape[-1] // X
+    return jnp.stack([shift_packed(gauge_pl[mu], mu, -1, X, Y)
+                      for mu in range(4)])
 
 
 # -- in-kernel complex helpers on (re, im) tuples of (BZ, YX) tiles --------
@@ -106,61 +137,101 @@ def _shift_xy(v, mu: int, sign: int, X: int):
     return tuple(out)
 
 
-def _shift_z(v, v_nb, sign: int):
-    """z shift on a (BZ, YX) tile, splicing the boundary row from the
-    neighbouring z-block tile ``v_nb`` (zb+1 block for sign>0, zb-1 for
-    sign<0; with one z-block, v_nb is v itself and this is periodic)."""
-    bz = v[0].shape[0]
-    row = jax.lax.broadcasted_iota(jnp.int32, v[0].shape, 0)
+def _shift_x_eo(v, sign: int, Xh: int, mask_r0):
+    """Checkerboarded x shift on a (BZ, Y*Xh) half-lattice tile.
+
+    Mirrors wilson_packed.shift_eo_packed's x case: a half-site's x
+    neighbour is either in the SAME fused-axis slot or the adjacent one,
+    depending on whether the site occupies the even x slot (mask_r0,
+    from the (t+z+y+parity) slot parity)."""
+    col = jax.lax.broadcasted_iota(jnp.int32, v[0].shape, 1) % Xh
     out = []
     if sign > 0:
-        for c, n in zip(v, v_nb):
-            rolled = jnp.roll(c, -1, axis=0)
-            out.append(jnp.where(row == bz - 1, n[0:1, :], rolled))
+        wrap = col == Xh - 1
+        for c in v:
+            moved = jnp.where(wrap, jnp.roll(c, Xh - 1, axis=1),
+                              jnp.roll(c, -1, axis=1))
+            out.append(jnp.where(mask_r0, c, moved))
     else:
-        for c, n in zip(v, v_nb):
-            rolled = jnp.roll(c, 1, axis=0)
-            out.append(jnp.where(row == 0, n[bz - 1:bz, :], rolled))
+        wrap = col == 0
+        for c in v:
+            moved = jnp.where(wrap, jnp.roll(c, -(Xh - 1), axis=1),
+                              jnp.roll(c, 1, axis=1))
+            out.append(jnp.where(mask_r0, moved, c))
     return tuple(out)
 
 
-def _make_kernel(X: int):
+def _shift_z(v, v_row, sign: int):
+    """z shift on a (BZ, YX) tile, splicing boundary row ``v_row`` (a
+    (1, YX) pair from the neighbouring z-block: its first row for
+    sign>0, its last row for sign<0)."""
+    bz = v[0].shape[0]
+    row = jax.lax.broadcasted_iota(jnp.int32, v[0].shape, 0)
+    if sign > 0:
+        return tuple(jnp.where(row == bz - 1, n, jnp.roll(c, -1, axis=0))
+                     for c, n in zip(v, v_row))
+    return tuple(jnp.where(row == 0, n, jnp.roll(c, 1, axis=0))
+                 for c, n in zip(v, v_row))
+
+
+def _make_kernel(X: int, bz: int, eo: tuple | None = None):
     """Kernel over one (t, z-block) tile.  Ref shapes (leading block dims
     of 1 squeezed by indexing):
-      psi refs:           (4, 3, 2, 1, BZ, YX) x5 (c, t+1, t-1, z+1, z-1)
-      gauge ref:          (4, 3, 3, 2, 1, BZ, YX)
-      u_tm / u_zm refs:   (3, 3, 2, 1, BZ, YX)  [single direction]
+      psi refs:            (4, 3, 2, 1, BZ, YX) x5 (c, t+1, t-1, z+1, z-1)
+      g_c / g_m refs:      (4, 3, 3, 2, 1, BZ, YX)  (forward / pre-shifted
+                           backward links)
+    With ``eo = (target_parity, Xh)`` the tile is a checkerboarded half
+    lattice (fused axis Y*Xh) and x shifts use the slot-parity select of
+    wilson_packed.shift_eo_packed; g_c/g_m are then the target-parity
+    forward links and the pre-shifted opposite-parity backward links.
     """
+    from jax.experimental import pallas as pl
 
-    def kernel(psi_c, psi_tp, psi_tm, psi_zp, psi_zm, g_c, g_tm, g_zm,
-               out_ref):
+    def kernel(psi_c, psi_tp, psi_tm, psi_zp, psi_zm, g_c, g_m, out_ref):
+        if eo is not None:
+            parity, Xh = eo
+            t_id = pl.program_id(0)
+            zb_id = pl.program_id(1)
+            shape = psi_c.shape[-2:]
+            z = (jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+                 + zb_id * bz)
+            y = jax.lax.broadcasted_iota(jnp.int32, shape, 1) // Xh
+            mask_r0 = ((t_id + z + y + parity) % 2) == 0
+
+        def shift_x(v, sign):
+            if eo is None:
+                return _shift_xy(v, 0, sign, X)
+            return _shift_x_eo(v, sign, eo[1], mask_r0)
+
         # loads cast storage dtype (f32 or bf16) to f32 compute
         def psi_at(ref, s, c):
             return (ref[s, c, 0, 0].astype(F32),
                     ref[s, c, 1, 0].astype(F32))
 
+        def psi_row(ref, s, c, rows):
+            return (ref[s, c, 0, 0][rows].astype(F32),
+                    ref[s, c, 1, 0][rows].astype(F32))
+
         def link(ref, mu, a, b):
             return (ref[mu, a, b, 0, 0].astype(F32),
                     ref[mu, a, b, 1, 0].astype(F32))
-
-        def link1(ref, a, b):
-            return (ref[a, b, 0, 0].astype(F32),
-                    ref[a, b, 1, 0].astype(F32))
 
         # accumulators per (spin, color), f32
         acc = [[(jnp.zeros(psi_c.shape[-2:], F32),
                  jnp.zeros(psi_c.shape[-2:], F32))
                 for _ in range(3)] for _ in range(4)]
 
-        def hop(get_psi, get_link, table, adjoint):
-            """get_psi(s, c) -> shifted psi pair; get_link(a, b) -> link
-            pair (already at the right site)."""
+        def project(get_psi, table):
+            """Half-spinor h[a][color] from UNSHIFTED psi planes."""
             t = table
-            # project to half spinor h[a][color]
-            h = [[_cadd(get_psi(a, c),
-                        _cscale(t[f"c{a}"], get_psi(t[f"j{a}"], c)))
-                  for c in range(3)] for a in (0, 1)]
-            # color multiply
+            return [[_cadd(get_psi(a, c),
+                           _cscale(t[f"c{a}"], get_psi(t[f"j{a}"], c)))
+                     for c in range(3)] for a in (0, 1)]
+
+        def color_acc(h, get_link, table, adjoint):
+            """3x3 color multiply of the (shifted) half spinor, then
+            accumulate with spin reconstruction."""
+            t = table
             uh = [[None] * 3 for _ in range(2)]
             for s in range(2):
                 for a in range(3):
@@ -170,7 +241,6 @@ def _make_kernel(X: int):
                              else _cmul(get_link(a, b), h[s][b]))
                         term = m if term is None else _cadd(term, m)
                     uh[s][a] = term
-            # accumulate with reconstruction
             for c in range(3):
                 acc[0][c] = _cadd(acc[0][c], uh[0][c])
                 acc[1][c] = _cadd(acc[1][c], uh[1][c])
@@ -179,34 +249,38 @@ def _make_kernel(X: int):
                 acc[3][c] = _cadd(acc[3][c],
                                   _cscale(t["d3"], uh[t["k3"]][c]))
 
-        # x, y directions: in-plane lane shifts
+        # x, y directions: project central psi, shift 6 half-spinor pairs
         for mu in (0, 1):
-            hop(lambda s, c, mu=mu: _shift_xy(psi_at(psi_c, s, c), mu, +1,
-                                              X),
-                lambda a, b, mu=mu: link(g_c, mu, a, b),
-                TABLES[(mu, +1)], adjoint=False)
-            hop(lambda s, c, mu=mu: _shift_xy(psi_at(psi_c, s, c), mu, -1,
-                                              X),
-                lambda a, b, mu=mu: _shift_xy(link(g_c, mu, a, b), mu, -1,
-                                              X),
-                TABLES[(mu, -1)], adjoint=True)
-        # z direction: sublane shift splicing the neighbour z-block row
-        hop(lambda s, c: _shift_z(psi_at(psi_c, s, c),
-                                  psi_at(psi_zp, s, c), +1),
-            lambda a, b: link(g_c, 2, a, b),
-            TABLES[(2, +1)], adjoint=False)
-        hop(lambda s, c: _shift_z(psi_at(psi_c, s, c),
-                                  psi_at(psi_zm, s, c), -1),
-            lambda a, b: _shift_z(link(g_c, 2, a, b), link1(g_zm, a, b),
-                                  -1),
-            TABLES[(2, -1)], adjoint=True)
-        # t direction: neighbour tiles (index maps did the wrap)
-        hop(lambda s, c: psi_at(psi_tp, s, c),
-            lambda a, b: link(g_c, 3, a, b),
-            TABLES[(3, +1)], adjoint=False)
-        hop(lambda s, c: psi_at(psi_tm, s, c),
-            lambda a, b: link1(g_tm, a, b),
-            TABLES[(3, -1)], adjoint=True)
+            for sign, adjoint, gref in ((+1, False, g_c), (-1, True, g_m)):
+                t = TABLES[(mu, sign)]
+                h = project(lambda s, c: psi_at(psi_c, s, c), t)
+                if mu == 0:
+                    h = [[shift_x(h[a][c], sign) for c in range(3)]
+                         for a in (0, 1)]
+                else:
+                    h = [[_shift_xy(h[a][c], 1, sign,
+                                    X if eo is None else eo[1])
+                          for c in range(3)] for a in (0, 1)]
+                color_acc(h, lambda a, b, mu=mu, g=gref: link(g, mu, a, b),
+                          t, adjoint)
+        # z direction: project central + the needed boundary row of the
+        # neighbouring z-block, then splice
+        for sign, adjoint, gref, nb in ((+1, False, g_c, psi_zp),
+                                        (-1, True, g_m, psi_zm)):
+            t = TABLES[(2, sign)]
+            rows = slice(0, 1) if sign > 0 else slice(-1, None)
+            h = project(lambda s, c: psi_at(psi_c, s, c), t)
+            h_row = project(lambda s, c: psi_row(nb, s, c, rows), t)
+            h = [[_shift_z(h[a][c], h_row[a][c], sign) for c in range(3)]
+                 for a in (0, 1)]
+            color_acc(h, lambda a, b, g=gref: link(g, 2, a, b), t, adjoint)
+        # t direction: whole neighbour tiles (index maps did the wrap),
+        # no shift at all
+        for sign, adjoint, gref, nb in ((+1, False, g_c, psi_tp),
+                                        (-1, True, g_m, psi_tm)):
+            t = TABLES[(3, sign)]
+            h = project(lambda s, c, nb=nb: psi_at(nb, s, c), t)
+            color_acc(h, lambda a, b, g=gref: link(g, 3, a, b), t, adjoint)
 
         odt = out_ref.dtype
         for s in range(4):
@@ -220,9 +294,9 @@ def _make_kernel(X: int):
 def _pick_bz(Z: int, YX: int) -> int:
     """Largest divisor of Z whose working set fits the VMEM budget.
 
-    Working set per grid step: 5 psi tiles (24 planes each) + gauge tile
-    (72) + U_t and U_z neighbour slices (18 each) + out (24) = 252 planes
-    of (BZ, YX->lane-padded) f32, double-buffered by Mosaic across grid
+    Working set per grid step: 5 psi tiles (24 planes each) + forward
+    and backward gauge tiles (72 each) + out (24) = 288 planes of
+    (BZ, YX->lane-padded) f32, double-buffered by Mosaic across grid
     steps.  Budget the single-buffer set at 6 MB (< half the 16 MB
     scoped-VMEM limit).  Raises when even BZ=1 does not fit — callers
     (bench.py, utils/tune.py) fall back to the XLA packed path."""
@@ -231,11 +305,11 @@ def _pick_bz(Z: int, YX: int) -> int:
     for bz in sorted({d for d in range(1, Z + 1) if Z % d == 0},
                      reverse=True):
         bz_pad = -(-bz // 8) * 8
-        if 252 * bz_pad * yx_pad * 4 <= budget:
+        if 288 * bz_pad * yx_pad * 4 <= budget:
             return bz
     raise ValueError(
         f"no z-block of Z={Z} fits the VMEM budget at YX={YX} "
-        f"(min working set {252 * 8 * yx_pad * 4 / 2**20:.1f} MB); use "
+        f"(min working set {288 * 8 * yx_pad * 4 / 2**20:.1f} MB); use "
         "ops/wilson_packed.dslash_packed instead")
 
 
@@ -243,12 +317,17 @@ def _pick_bz(Z: int, YX: int) -> int:
                    static_argnames=("X", "interpret", "block_z"))
 def dslash_pallas_packed(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
                          X: int, interpret: bool = False,
-                         block_z: int | None = None) -> jnp.ndarray:
+                         block_z: int | None = None,
+                         gauge_bw: jnp.ndarray | None = None) -> jnp.ndarray:
     """Wilson hop sum on pallas-layout pair arrays.
 
     gauge_pl: (4,3,3,2,T,Z,YX) f32 (phases folded);
     psi_pl: (4,3,2,T,Z,YX) f32.  Returns the same layout as psi_pl.
     ``block_z`` overrides the auto-chosen z-block size (must divide Z).
+    ``gauge_bw`` is the pre-shifted backward gauge from
+    ``backward_gauge``; pass it when applying the operator many times
+    against a fixed gauge (solvers, benchmarks) so the rolls are not
+    re-traced into every application.
     """
     from jax.experimental import pallas as pl
 
@@ -257,6 +336,8 @@ def dslash_pallas_packed(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
     if Z % bz != 0:
         raise ValueError(f"block_z={bz} does not divide Z={Z}")
     nzb = Z // bz
+    if gauge_bw is None:
+        gauge_bw = backward_gauge(gauge_pl, X)
 
     def psi_spec(dt, dz):
         return pl.BlockSpec(
@@ -266,30 +347,83 @@ def dslash_pallas_packed(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
 
     gauge_spec = pl.BlockSpec(
         (4, 3, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
-    # U_t at t-1 / U_z at zb-1: index the direction axis at 3 / 2
-    g_tm_spec = pl.BlockSpec(
-        (1, 3, 3, 2, 1, bz, YX),
-        lambda t, zb: (3, 0, 0, 0, (t - 1) % T, zb, 0))
-    g_zm_spec = pl.BlockSpec(
-        (1, 3, 3, 2, 1, bz, YX),
-        lambda t, zb: (2, 0, 0, 0, t, (zb - 1) % nzb, 0))
 
-    kernel = _make_kernel(X)
-
-    def kernel_wrap(psi_c, psi_tp, psi_tm, psi_zp, psi_zm, g_c, g_tm,
-                    g_zm, out_ref):
-        kernel(psi_c, psi_tp, psi_tm, psi_zp, psi_zm, g_c, g_tm[0],
-               g_zm[0], out_ref)
+    kernel = _make_kernel(X, bz)
 
     return pl.pallas_call(
-        kernel_wrap,
+        kernel,
         grid=(T, nzb),
         in_specs=[psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
                   psi_spec(0, +1), psi_spec(0, -1), gauge_spec,
-                  g_tm_spec, g_zm_spec],
+                  gauge_spec],
         out_specs=pl.BlockSpec((4, 3, 2, 1, bz, YX),
                                lambda t, zb: (0, 0, 0, t, zb, 0)),
         out_shape=jax.ShapeDtypeStruct(psi_pl.shape, psi_pl.dtype),
         interpret=interpret,
-    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, gauge_pl, gauge_pl,
-      gauge_pl)
+    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, gauge_pl, gauge_bw)
+
+
+# -- even/odd (checkerboarded) kernel: the solver hot path ------------------
+
+def backward_gauge_eo(u_there_pl: jnp.ndarray, dims,
+                      target_parity: int) -> jnp.ndarray:
+    """Pre-shifted backward links on the half lattice:
+    out[mu](x) = U_mu(x - mu) for parity-``target_parity`` sites x, where
+    ``u_there_pl`` holds the opposite-parity links in the packed pair
+    layout (4,3,3,2,T,Z,Y*Xh).  Computed once per gauge load."""
+    from .wilson_packed import shift_eo_packed
+    return jnp.stack([
+        shift_eo_packed(u_there_pl[mu], dims, mu, -1, target_parity)
+        for mu in range(4)])
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "target_parity",
+                                             "interpret", "block_z",
+                                             "out_dtype"))
+def dslash_eo_pallas_packed(u_here_pl: jnp.ndarray, u_bw_pl: jnp.ndarray,
+                            psi_pl: jnp.ndarray, dims,
+                            target_parity: int, interpret: bool = False,
+                            block_z: int | None = None,
+                            out_dtype=None) -> jnp.ndarray:
+    """Checkerboarded Wilson hop on pallas-layout half-lattice pair
+    arrays (the pallas analog of wilson_packed.dslash_eo_packed_pairs —
+    the solver hot loop's stencil).
+
+    u_here_pl: (4,3,3,2,T,Z,Y*Xh) forward links at target-parity sites;
+    u_bw_pl: pre-shifted backward links from ``backward_gauge_eo``;
+    psi_pl: (4,3,2,T,Z,Y*Xh) parity-(1-p) spinor.  Returns the hop sum
+    indexed by parity-``target_parity`` sites, same layout as psi_pl.
+    """
+    from jax.experimental import pallas as pl
+
+    T, Z, Y, X = dims
+    Xh = X // 2
+    _, _, _, _, _, YXh = psi_pl.shape
+    bz = block_z if block_z is not None else _pick_bz(Z, YXh)
+    if Z % bz != 0:
+        raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    nzb = Z // bz
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (4, 3, 2, 1, bz, YXh),
+            lambda t, zb, dt=dt, dz=dz: (0, 0, 0, (t + dt) % T,
+                                         (zb + dz) % nzb, 0))
+
+    gauge_spec = pl.BlockSpec(
+        (4, 3, 3, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+
+    kernel = _make_kernel(X, bz, eo=(target_parity, Xh))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(T, nzb),
+        in_specs=[psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
+                  psi_spec(0, +1), psi_spec(0, -1), gauge_spec,
+                  gauge_spec],
+        out_specs=pl.BlockSpec((4, 3, 2, 1, bz, YXh),
+                               lambda t, zb: (0, 0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape,
+                                       out_dtype or psi_pl.dtype),
+        interpret=interpret,
+    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, u_here_pl, u_bw_pl)
