@@ -220,6 +220,31 @@ def main(argv):
                      g, p, X, gauge_bw=gbw),
                  (g_bf,), p_pairs.astype(jnp.bfloat16), 1320,
                  (gauge_bytes + 2 * spinor_bytes) // 2))
+            # improved staggered (fat + Naik): the second headline family
+            # on its pallas kernel; links reuse the wilson pair gauge
+            # draws (phases are folded upstream in real use)
+            from quda_tpu.ops import staggered_pallas as stp
+            stag_p = p_pairs[0]      # (3,2,T,Z,YX) color planes
+            fat_bw = jax.jit(lambda g: stp.backward_links(g, X, 1))(
+                g_pairs)
+            long_bw = jax.jit(lambda g: stp.backward_links(g, X, 3))(
+                g_pairs)
+            fat_bw.block_until_ready(), long_bw.block_until_ready()
+            # flops/site: 8 hop-sets (fat+long, fwd+bwd, 4 dirs) x 3x3
+            # cmul-sum (66 f) + combine ~ 1146.  Bytes use the SAME
+            # nominal c64-equivalent convention as the wilson rows
+            # (links read once per hop set, psi read + out written once;
+            # backward copies and the two-pass psi re-read are real
+            # extra traffic but are excluded there too)
+            stag_flops = 1146
+            stag_spinor_bytes = vol * 3 * 8
+            stag_bytes = 2 * gauge_bytes + 2 * stag_spinor_bytes
+            cases.append(
+                ("improved_staggered_pallas",
+                 lambda g, p, fb=fat_bw, lb=long_bw: (
+                     stp.dslash_staggered_pallas(
+                         g, fb, p, X, long_pl=g, long_bw_pl=lb)),
+                 (g_pairs,), stag_p, stag_flops, stag_bytes))
         if complex_ok:
             from quda_tpu.ops import wilson as wops
             from quda_tpu.models.clover import DiracClover

@@ -77,3 +77,72 @@ def matvec_staggered_packed(fat_p, psi_p, mass: float, X: int, Y: int,
     """M psi = 2m psi + D psi on packed arrays."""
     return 2.0 * mass * psi_p + dslash_staggered_packed(
         fat_p, psi_p, X, Y, long_p)
+
+
+# ---------------------------------------------------------------------------
+# pair-form stencil (complex-free: required on TPU runtimes without
+# complex64 execution; also the bf16 sloppy staggered stencil)
+# ---------------------------------------------------------------------------
+#
+# Layout: spinor (3, 2, T, Z, Y*X), links (3, 3, 2, T, Z, Y*X) per
+# direction — re/im planes exactly as wilson_packed.to_packed_pairs
+# produces from the complex packed arrays above.
+
+from .wilson_packed import (_pp_add, _pp_cmul,  # noqa: E402
+                            _pp_cmul_conj, to_packed_pairs,
+                            from_packed_pairs)
+
+
+def _color_planes(arr):
+    """(3,2,...) pair storage -> [(re, im)] f32 planes per color."""
+    a = arr.astype(jnp.float32)
+    return [(a[c, 0], a[c, 1]) for c in range(3)]
+
+
+def _u_planes(arr):
+    a = arr.astype(jnp.float32)
+    return {(i, j): (a[i, j, 0], a[i, j, 1])
+            for i in range(3) for j in range(3)}
+
+
+def _mat_vec_pairs(u, v, adjoint: bool):
+    out = []
+    for a in range(3):
+        acc = None
+        for b in range(3):
+            t = (_pp_cmul_conj(u[(b, a)], v[b]) if adjoint
+                 else _pp_cmul(u[(a, b)], v[b]))
+            acc = t if acc is None else _pp_add(acc, t)
+        out.append(acc)
+    return out
+
+
+def dslash_staggered_packed_pairs(fat_pp: jnp.ndarray, psi_pp: jnp.ndarray,
+                                  X: int, Y: int,
+                                  long_pp: jnp.ndarray = None,
+                                  out_dtype=None) -> jnp.ndarray:
+    """Pair-form D psi (mirrors dslash_staggered_packed; phases folded).
+
+    fat_pp/long_pp: (4,3,3,2,T,Z,YX); psi_pp: (3,2,T,Z,YX) storage
+    arrays (f32 or bf16).  Compute f32; output cast to ``out_dtype``
+    (default: psi storage dtype).
+    """
+    out_dtype = out_dtype or psi_pp.dtype
+    acc = None
+    for links, nhop in (((fat_pp, 1),) if long_pp is None
+                        else ((fat_pp, 1), (long_pp, 3))):
+        for mu in range(4):
+            u = _u_planes(links[mu])
+            fwd = _mat_vec_pairs(
+                u, _color_planes(shift_packed(psi_pp, mu, +1, X, Y, nhop)),
+                adjoint=False)
+            ub = _u_planes(shift_packed(links[mu], mu, -1, X, Y, nhop))
+            bwd = _mat_vec_pairs(
+                ub, _color_planes(shift_packed(psi_pp, mu, -1, X, Y, nhop)),
+                adjoint=True)
+            term = [(0.5 * (f[0] - b[0]), 0.5 * (f[1] - b[1]))
+                    for f, b in zip(fwd, bwd)]
+            acc = term if acc is None else [_pp_add(a, t)
+                                            for a, t in zip(acc, term)]
+    return jnp.stack([jnp.stack([re, im]) for re, im in acc]).astype(
+        out_dtype)

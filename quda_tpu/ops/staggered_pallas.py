@@ -1,0 +1,188 @@
+"""Pallas TPU staggered / improved-staggered dslash on the packed pair
+layout — the hand-tuned hot path for the second headline family.
+
+Reference behavior: include/kernels/dslash_staggered.cuh (fat 1-hop +
+Naik long 3-hop, phases folded into the links).  Same design as the
+Wilson kernel (ops/wilson_pallas_packed.py): grid (T, Z/BZ), (BZ, Y*X)
+vector tiles, re/im-pair arithmetic, pre-shifted backward links
+computed once per link load so the kernel does zero in-kernel link
+shifts.  Staggered has no spin structure, so each hop is a bare 3x3
+color multiply of the shifted color planes:
+
+    out = sum_mu 0.5 * [ U_mu(x) psi(x+n mu) - U_mu(x-n mu)^dag psi(x-n mu) ]
+
+The fat (nhop=1) and long (nhop=3) hop sets run as SEPARATE pallas
+calls summed in XLA: together their working set (9 psi neighbour tiles
++ 4 link tiles) busts the VMEM budget at useful block sizes, while each
+pass alone (5 psi tiles + 2 link tiles, 180 planes) fits comfortably —
+and the extra psi re-read costs only 24 B/site against 576 B/site of
+links.
+
+Layouts:  psi (3, 2, T, Z, Y*X); links (4, 3, 3, 2, T, Z, Y*X).
+A 3-hop z shift splices three boundary rows from the single adjacent
+z-block tile, so the long pass requires BZ >= 3 (or one z-block).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .wilson_pallas_packed import (_cadd, _cmul, _cmul_conj, _pick_bz,
+                                   _shift_xy)
+
+F32 = jnp.float32
+
+
+def backward_links(links_pl: jnp.ndarray, X: int, nhop: int) -> jnp.ndarray:
+    """Pre-shifted backward links: out[mu](x) = U_mu(x - nhop*mu), on the
+    pair layout (4,3,3,2,T,Z,YX).  Computed once per link load
+    (KS fat/long residency), like wilson_pallas_packed.backward_gauge."""
+    from .wilson_packed import shift_packed
+    Y = links_pl.shape[-1] // X
+    return jnp.stack([shift_packed(links_pl[mu], mu, -1, X, Y, nhop)
+                      for mu in range(4)])
+
+
+def _shift_z_n(v, v_nb, sign: int, nhop: int):
+    """z shift by nhop rows, splicing nhop boundary rows from the
+    neighbouring z-block tile ``v_nb`` (requires nhop <= BZ)."""
+    bz = v[0].shape[0]
+    row = jax.lax.broadcasted_iota(jnp.int32, v[0].shape, 0)
+    out = []
+    if sign > 0:
+        for c, n in zip(v, v_nb):
+            spliced = jnp.roll(n, -nhop, axis=0)  # rows 0..nhop-1 -> tail
+            out.append(jnp.where(row >= bz - nhop, spliced,
+                                 jnp.roll(c, -nhop, axis=0)))
+    else:
+        for c, n in zip(v, v_nb):
+            spliced = jnp.roll(n, nhop, axis=0)   # last nhop rows -> head
+            out.append(jnp.where(row < nhop, spliced,
+                                 jnp.roll(c, nhop, axis=0)))
+    return tuple(out)
+
+
+def _make_stag_kernel(X: int, nhop: int):
+    """One hop-set pass over a (t, z-block) tile.  Ref shapes:
+      psi refs:   (3, 2, 1, BZ, YX) x5 (central, t+n, t-n, z+n, z-n)
+      u / u_bw:   (4, 3, 3, 2, 1, BZ, YX)
+    """
+
+    def kernel(psi_c, psi_tp, psi_tm, psi_zp, psi_zm, u, u_bw, out_ref):
+        def psi_at(ref, c):
+            return (ref[c, 0, 0].astype(F32), ref[c, 1, 0].astype(F32))
+
+        def link(ref, mu, a, b):
+            return (ref[mu, a, b, 0, 0].astype(F32),
+                    ref[mu, a, b, 1, 0].astype(F32))
+
+        acc = [(jnp.zeros(psi_c.shape[-2:], F32),
+                jnp.zeros(psi_c.shape[-2:], F32)) for _ in range(3)]
+
+        def hop(get_psi, mu, adjoint):
+            gref = u_bw if adjoint else u
+            for a in range(3):
+                term = None
+                for b in range(3):
+                    m = (_cmul_conj(link(gref, mu, b, a), get_psi(b))
+                         if adjoint else
+                         _cmul(link(gref, mu, a, b), get_psi(b)))
+                    term = m if term is None else _cadd(term, m)
+                s = -0.5 if adjoint else 0.5
+                acc[a] = (acc[a][0] + s * term[0],
+                          acc[a][1] + s * term[1])
+
+        # x, y: in-plane lane shifts of the central tile
+        for mu in (0, 1):
+            for sign, adjoint in ((+1, False), (-1, True)):
+                hop(lambda c, mu=mu, sign=sign: _shift_xy(
+                    psi_at(psi_c, c), mu, sign, X, nhop), mu, adjoint)
+        # z: roll + nhop-row splice from the neighbour z-block tile
+        hop(lambda c: _shift_z_n(psi_at(psi_c, c), psi_at(psi_zp, c),
+                                 +1, nhop), 2, False)
+        hop(lambda c: _shift_z_n(psi_at(psi_c, c), psi_at(psi_zm, c),
+                                 -1, nhop), 2, True)
+        # t: whole neighbour tiles via the index map
+        hop(lambda c: psi_at(psi_tp, c), 3, False)
+        hop(lambda c: psi_at(psi_tm, c), 3, True)
+
+        odt = out_ref.dtype
+        for c in range(3):
+            out_ref[c, 0, 0] = acc[c][0].astype(odt)
+            out_ref[c, 1, 0] = acc[c][1].astype(odt)
+
+    return kernel
+
+
+# working set per pass: 5 psi tiles (6 planes) + u + u_bw (72 each) +
+# out (6) = 180 planes
+_STAG_PLANES = 180
+
+
+def _stag_pass(links_pl, links_bw_pl, psi_pl, X, nhop, bz, interpret):
+    from jax.experimental import pallas as pl
+
+    _, _, T, Z, YX = psi_pl.shape
+    nzb = Z // bz
+    if nzb > 1 and bz < nhop:
+        raise ValueError(
+            f"block_z={bz} < nhop={nhop}: the z splice only reaches the "
+            "adjacent z-block")
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (3, 2, 1, bz, YX),
+            lambda t, zb, dt=dt, dz=dz: (0, 0, (t + dt) % T,
+                                         (zb + dz) % nzb, 0))
+
+    links_spec = pl.BlockSpec(
+        (4, 3, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+
+    return pl.pallas_call(
+        _make_stag_kernel(X, nhop),
+        grid=(T, nzb),
+        in_specs=[psi_spec(0, 0), psi_spec(+nhop, 0), psi_spec(-nhop, 0),
+                  psi_spec(0, +1), psi_spec(0, -1), links_spec,
+                  links_spec],
+        out_specs=pl.BlockSpec((3, 2, 1, bz, YX),
+                               lambda t, zb: (0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape, jnp.float32),
+        interpret=interpret,
+    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, links_pl, links_bw_pl)
+
+
+@functools.partial(jax.jit, static_argnames=("X", "interpret", "block_z",
+                                             "out_dtype"))
+def dslash_staggered_pallas(fat_pl: jnp.ndarray, fat_bw_pl: jnp.ndarray,
+                            psi_pl: jnp.ndarray, X: int,
+                            long_pl: jnp.ndarray = None,
+                            long_bw_pl: jnp.ndarray = None,
+                            interpret: bool = False,
+                            block_z: int | None = None,
+                            out_dtype=None) -> jnp.ndarray:
+    """Staggered (fat-only) or improved-staggered (fat+long) D psi on
+    pallas-layout pair arrays; matches
+    staggered_packed.dslash_staggered_packed_pairs.
+
+    fat_pl/long_pl: (4,3,3,2,T,Z,YX) with phases folded; the _bw arrays
+    are from ``backward_links`` (computed once per KS-link load —
+    keep them out of solver loops, see PERF.md).  psi_pl: (3,2,T,Z,YX).
+    """
+    _, _, _, Z, YX = psi_pl.shape
+    if block_z is not None:
+        bz = block_z
+        if Z % bz != 0:
+            raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    else:
+        bz = _pick_bz(Z, YX, psi_pl.dtype, planes=_STAG_PLANES,
+                      min_bz=3 if (long_pl is not None and Z > 3) else 1)
+
+    out = _stag_pass(fat_pl, fat_bw_pl, psi_pl, X, 1, bz, interpret)
+    if long_pl is not None:
+        out = out + _stag_pass(long_pl, long_bw_pl, psi_pl, X, 3, bz,
+                               interpret)
+    odt = out_dtype or psi_pl.dtype
+    return out.astype(odt)

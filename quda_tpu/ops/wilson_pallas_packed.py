@@ -113,27 +113,30 @@ def _cscale(c: complex, x):
     return (cr * x[0] - ci * x[1], cr * x[1] + ci * x[0])
 
 
-def _shift_xy(v, mu: int, sign: int, X: int):
-    """x/y shifts on a (BZ, YX) tile: result(z, i) = v at site + sign*mu."""
+def _shift_xy(v, mu: int, sign: int, X: int, nhop: int = 1):
+    """x/y shifts by nhop sites on a (BZ, YX) tile (fused Y*X axis):
+    result(z, i) = v at site + sign*nhop*mu.  Also serves the staggered
+    kernel's Naik 3-hop shifts (ops/staggered_pallas.py)."""
     if mu == 1:
-        return (jnp.roll(v[0], -sign * X, axis=1),
-                jnp.roll(v[1], -sign * X, axis=1))
-    # x: lane roll + boundary-column fix
+        return (jnp.roll(v[0], -sign * nhop * X, axis=1),
+                jnp.roll(v[1], -sign * nhop * X, axis=1))
+    # x: lane roll + boundary-column fix (x arithmetic is mod X, as in
+    # wilson_packed.shift_packed)
+    n = nhop % X
+    if n == 0:
+        return v
     col = jax.lax.broadcasted_iota(jnp.int32, v[0].shape, 1) % X
-    if sign > 0:
-        mask = col == X - 1
-        out = []
-        for c in v:
-            interior = jnp.roll(c, -1, axis=1)
-            wrapped = jnp.roll(c, X - 1, axis=1)
-            out.append(jnp.where(mask, wrapped, interior))
-        return tuple(out)
-    mask = col == 0
     out = []
+    if sign > 0:
+        mask = col >= X - n
+        for c in v:
+            out.append(jnp.where(mask, jnp.roll(c, X - n, axis=1),
+                                 jnp.roll(c, -n, axis=1)))
+        return tuple(out)
+    mask = col < n
     for c in v:
-        interior = jnp.roll(c, 1, axis=1)
-        wrapped = jnp.roll(c, -(X - 1), axis=1)
-        out.append(jnp.where(mask, wrapped, interior))
+        out.append(jnp.where(mask, jnp.roll(c, -(X - n), axis=1),
+                             jnp.roll(c, n, axis=1)))
     return tuple(out)
 
 
@@ -291,26 +294,44 @@ def _make_kernel(X: int, bz: int, eo: tuple | None = None):
     return kernel
 
 
-def _pick_bz(Z: int, YX: int) -> int:
-    """Largest divisor of Z whose working set fits the VMEM budget.
+def _pick_bz(Z: int, YX: int, dtype=jnp.float32, planes: int = 288,
+             min_bz: int = 1) -> int:
+    """Divisor of Z maximising sublane-tile utilisation within the VMEM
+    budget.
 
     Working set per grid step: 5 psi tiles (24 planes each) + forward
     and backward gauge tiles (72 each) + out (24) = 288 planes of
-    (BZ, YX->lane-padded) f32, double-buffered by Mosaic across grid
+    (BZ, YX->lane-padded) storage, double-buffered by Mosaic across grid
     steps.  Budget the single-buffer set at 6 MB (< half the 16 MB
-    scoped-VMEM limit).  Raises when even BZ=1 does not fit — callers
-    (bench.py, utils/tune.py) fall back to the XLA packed path."""
+    scoped-VMEM limit).
+
+    The z-block axis is the SUBLANE axis of every tile, so BZ pads to
+    the dtype's sublane tile: 8 rows for f32, 16 for bf16.  A bz=8
+    block of a bf16 array occupies a half-empty (16,128) tile — loads
+    run at 50% utilisation (measured: bf16 SLOWER than f32 at bz=8) —
+    so candidates are ranked by (utilisation, size), not size alone.
+    Raises when even BZ=1 does not fit — callers fall back to the XLA
+    packed path."""
+    sub = 16 if jnp.dtype(dtype).itemsize < 4 else 8
+    nbytes = jnp.dtype(dtype).itemsize
     yx_pad = -(-YX // 128) * 128
     budget = 6 * 2 ** 20
-    for bz in sorted({d for d in range(1, Z + 1) if Z % d == 0},
-                     reverse=True):
-        bz_pad = -(-bz // 8) * 8
-        if 288 * bz_pad * yx_pad * 4 <= budget:
-            return bz
-    raise ValueError(
-        f"no z-block of Z={Z} fits the VMEM budget at YX={YX} "
-        f"(min working set {288 * 8 * yx_pad * 4 / 2**20:.1f} MB); use "
-        "ops/wilson_packed.dslash_packed instead")
+    fitting = []
+    for bz in sorted({d for d in range(min_bz, Z + 1)
+                      if Z % d == 0}):
+        bz_pad = -(-bz // sub) * sub
+        if planes * bz_pad * yx_pad * nbytes <= budget:
+            fitting.append((bz / bz_pad, bz, bz_pad))
+    if not fitting:
+        min_ws = planes * sub * yx_pad * nbytes / 2 ** 20
+        hint = ("" if min_bz <= 1 else
+                f" (candidates restricted to bz >= {min_bz} by the "
+                "multi-hop z-splice)")
+        raise ValueError(
+            f"no z-block of Z={Z} fits the VMEM budget at YX={YX} "
+            f"(min working set {min_ws:.1f} MB){hint}; fall back to the "
+            "XLA packed stencil for this operator")
+    return max(fitting)[1]
 
 
 @functools.partial(jax.jit,
@@ -332,7 +353,7 @@ def dslash_pallas_packed(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
     from jax.experimental import pallas as pl
 
     _, _, _, T, Z, YX = psi_pl.shape
-    bz = block_z if block_z is not None else _pick_bz(Z, YX)
+    bz = block_z if block_z is not None else _pick_bz(Z, YX, psi_pl.dtype)
     if Z % bz != 0:
         raise ValueError(f"block_z={bz} does not divide Z={Z}")
     nzb = Z // bz
@@ -399,7 +420,7 @@ def dslash_eo_pallas_packed(u_here_pl: jnp.ndarray, u_bw_pl: jnp.ndarray,
     T, Z, Y, X = dims
     Xh = X // 2
     _, _, _, _, _, YXh = psi_pl.shape
-    bz = block_z if block_z is not None else _pick_bz(Z, YXh)
+    bz = block_z if block_z is not None else _pick_bz(Z, YXh, psi_pl.dtype)
     if Z % bz != 0:
         raise ValueError(f"block_z={bz} does not divide Z={Z}")
     nzb = Z // bz
