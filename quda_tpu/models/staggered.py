@@ -127,3 +127,109 @@ class DiracStaggeredPC(DiracPC):
         b_q = b_odd if p == EVEN else b_even
         x_q = (b_q - self.D_to(x_p, 1 - p)) / (2.0 * self.mass)
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+    def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
+              pallas_interpret: bool = False) -> "DiracStaggeredPCPairs":
+        """Complex-free packed companion (f32 = the precise TPU solve
+        path; bf16 = the sloppy operator); see DiracStaggeredPCPairs."""
+        return DiracStaggeredPCPairs(self, store_dtype, use_pallas,
+                                     pallas_interpret)
+
+
+class DiracStaggeredPCPairs:
+    """Complex-free packed pair-form of DiracStaggeredPC — the staggered
+    solver operator for TPU runtimes without complex64 execution, and
+    (with bf16 storage) the sloppy staggered operator of mixed solves.
+
+    Mirrors models/wilson.DiracWilsonPCPackedSloppy: half-lattice links
+    packed to (4,3,3,2,T,Z,Y*Xh) re/im planes at ``store_dtype``, spinors
+    (3,2,T,Z,Y*Xh); compute f32.  ``use_pallas`` swaps the stencil for
+    the hand-tuned eo kernel (ops/staggered_pallas) with its pre-shifted
+    backward links computed once here (per KS-link load).
+
+    Reference behavior: QUDA solves staggered systems in float2-pair
+    native orders on device too (include/color_spinor_field_order.h);
+    this is that representation made explicit.
+    """
+
+    hermitian = True
+
+    def __init__(self, dpc: DiracStaggeredPC, store_dtype=jnp.float32,
+                 use_pallas: bool = False, pallas_interpret: bool = False):
+        from ..ops import staggered_packed as spk
+        from ..ops.wilson_packed import to_packed_pairs
+        self.geom = dpc.geom
+        self.mass = float(dpc.mass)
+        self.matpc = dpc.matpc
+        self.dims = tuple(dpc.geom.lattice_shape)
+        self.store_dtype = store_dtype
+        self.fat_eo_pp = tuple(
+            to_packed_pairs(spk.pack_links(g), store_dtype)
+            for g in dpc.fat_eo)
+        self.long_eo_pp = (tuple(
+            to_packed_pairs(spk.pack_links(g), store_dtype)
+            for g in dpc.long_eo) if dpc.long_eo is not None else None)
+        self.use_pallas = use_pallas
+        self._pallas_interpret = pallas_interpret
+        if use_pallas:
+            from ..ops import staggered_pallas as spl
+            self._fat_bw = tuple(
+                spl.backward_links_eo(self.fat_eo_pp[1 - p], self.dims,
+                                      p, 1) for p in (0, 1))
+            self._long_bw = (tuple(
+                spl.backward_links_eo(self.long_eo_pp[1 - p], self.dims,
+                                      p, 3) for p in (0, 1))
+                if self.long_eo_pp is not None else None)
+
+    def D_to_pairs(self, psi_pp, target_parity, out_dtype=None):
+        out_dtype = out_dtype or self.store_dtype
+        if self.use_pallas:
+            from ..ops import staggered_pallas as spl
+            p = target_parity
+            return spl.dslash_staggered_eo_pallas(
+                self.fat_eo_pp[p], self._fat_bw[p], psi_pp, self.dims, p,
+                long_here_pl=(self.long_eo_pp[p]
+                              if self.long_eo_pp is not None else None),
+                long_bw_pl=(self._long_bw[p]
+                            if self._long_bw is not None else None),
+                interpret=self._pallas_interpret, out_dtype=out_dtype)
+        from ..ops import staggered_packed as spk
+        return spk.dslash_staggered_eo_packed_pairs(
+            self.fat_eo_pp, psi_pp, self.dims, target_parity,
+            self.long_eo_pp, out_dtype=out_dtype)
+
+    def M_pairs(self, x_pp):
+        """(4m^2 - D_pq D_qp) on pair arrays — Hermitian positive
+        definite; cg(op.M_pairs, rhs_pairs) solves it directly."""
+        p = self.matpc
+        dd = self.D_to_pairs(self.D_to_pairs(x_pp, 1 - p), p,
+                             out_dtype=jnp.float32)
+        out = (4.0 * self.mass ** 2) * x_pp.astype(jnp.float32) - dd
+        return out.astype(self.store_dtype)
+
+    Mdag_pairs = M_pairs
+
+    def MdagM_pairs(self, x_pp):
+        return self.M_pairs(self.M_pairs(x_pp))
+
+    # -- complex in/out wrappers (interface boundary) -------------------
+    def _to_pairs(self, x):
+        from ..ops import staggered_packed as spk
+        from ..ops.wilson_packed import to_packed_pairs
+        return to_packed_pairs(spk.pack_staggered(x), self.store_dtype)
+
+    def _from_pairs(self, x_pp, dtype):
+        from ..ops import staggered_packed as spk
+        from ..ops.wilson_packed import from_packed_pairs
+        T, Z, Y, X = self.dims
+        return spk.unpack_staggered(from_packed_pairs(x_pp, dtype),
+                                    (T, Z, Y, X // 2))
+
+    def M(self, x):
+        return self._from_pairs(self.M_pairs(self._to_pairs(x)), x.dtype)
+
+    Mdag = M
+
+    def MdagM(self, x):
+        return self._from_pairs(self.MdagM_pairs(self._to_pairs(x)),
+                                x.dtype)

@@ -88,21 +88,15 @@ def matvec_staggered_packed(fat_p, psi_p, mass: float, X: int, Y: int,
 # direction — re/im planes exactly as wilson_packed.to_packed_pairs
 # produces from the complex packed arrays above.
 
-from .wilson_packed import (_pp_add, _pp_cmul,  # noqa: E402
-                            _pp_cmul_conj, to_packed_pairs,
-                            from_packed_pairs)
+from .wilson_packed import (_planes_u as _u_planes,  # noqa: E402
+                            _pp_add, _pp_cmul, _pp_cmul_conj,
+                            to_packed_pairs, from_packed_pairs)
 
 
 def _color_planes(arr):
     """(3,2,...) pair storage -> [(re, im)] f32 planes per color."""
     a = arr.astype(jnp.float32)
     return [(a[c, 0], a[c, 1]) for c in range(3)]
-
-
-def _u_planes(arr):
-    a = arr.astype(jnp.float32)
-    return {(i, j): (a[i, j, 0], a[i, j, 1])
-            for i in range(3) for j in range(3)}
 
 
 def _mat_vec_pairs(u, v, adjoint: bool):
@@ -139,6 +133,48 @@ def dslash_staggered_packed_pairs(fat_pp: jnp.ndarray, psi_pp: jnp.ndarray,
             ub = _u_planes(shift_packed(links[mu], mu, -1, X, Y, nhop))
             bwd = _mat_vec_pairs(
                 ub, _color_planes(shift_packed(psi_pp, mu, -1, X, Y, nhop)),
+                adjoint=True)
+            term = [(0.5 * (f[0] - b[0]), 0.5 * (f[1] - b[1]))
+                    for f, b in zip(fwd, bwd)]
+            acc = term if acc is None else [_pp_add(a, t)
+                                            for a, t in zip(acc, term)]
+    return jnp.stack([jnp.stack([re, im]) for re, im in acc]).astype(
+        out_dtype)
+
+
+def dslash_staggered_eo_packed_pairs(fat_eo_pp, psi_pp: jnp.ndarray, dims,
+                                     target_parity: int,
+                                     long_eo_pp=None,
+                                     out_dtype=None) -> jnp.ndarray:
+    """Checkerboarded pair-form staggered hop (mirrors
+    ops/staggered.dslash_eo; the complex-free staggered solver stencil).
+
+    fat_eo_pp/long_eo_pp: (even, odd) of (4,3,3,2,T,Z,Y*Xh) half-site
+    link storage (phases folded); psi_pp: (3,2,T,Z,Y*Xh) of parity 1-p.
+    Result indexed by parity-p sites.  Both 1-hop (fat) and 3-hop (Naik)
+    neighbours flip parity (odd hop counts), so forward links live at
+    the target parity and backward links are the opposite-parity links
+    shifted back nhop sites.
+    """
+    from .wilson_packed import shift_eo_packed
+    out_dtype = out_dtype or psi_pp.dtype
+    p = target_parity
+    acc = None
+    for links_eo, nhop in (((fat_eo_pp, 1),) if long_eo_pp is None
+                           else ((fat_eo_pp, 1), (long_eo_pp, 3))):
+        u_here = links_eo[p]
+        u_there = links_eo[1 - p]
+        for mu in range(4):
+            fwd = _mat_vec_pairs(
+                _u_planes(u_here[mu]),
+                _color_planes(shift_eo_packed(psi_pp, dims, mu, +1, p,
+                                              nhop)),
+                adjoint=False)
+            ub = shift_eo_packed(u_there[mu], dims, mu, -1, p, nhop)
+            bwd = _mat_vec_pairs(
+                _u_planes(ub),
+                _color_planes(shift_eo_packed(psi_pp, dims, mu, -1, p,
+                                              nhop)),
                 adjoint=True)
             term = [(0.5 * (f[0] - b[0]), 0.5 * (f[1] - b[1]))
                     for f, b in zip(fwd, bwd)]

@@ -65,15 +65,53 @@ def _shift_z_n(v, v_nb, sign: int, nhop: int):
     return tuple(out)
 
 
-def _make_stag_kernel(X: int, nhop: int):
+def _shift_x_eo_n(v, sign: int, Xh: int, mask_r0, nhop: int):
+    """Checkerboarded x shift by nhop sites on a (BZ, Y*Xh) tile —
+    in-kernel analog of wilson_packed.shift_eo_packed's x case: even
+    hops are pure xh-slot rolls, odd hops add one slot-parity flip."""
+    if nhop % 2 == 0:
+        return _shift_xy(v, 0, sign, Xh, nhop // 2) if nhop else v
+    k = (nhop - 1) // 2
+    base = _shift_xy(v, 0, sign, Xh, k) if k else v
+    moved = _shift_xy(base, 0, sign, Xh, 1)
+    if sign > 0:
+        return tuple(jnp.where(mask_r0, b, m) for b, m in zip(base, moved))
+    return tuple(jnp.where(mask_r0, m, b) for b, m in zip(base, moved))
+
+
+def _make_stag_kernel(X: int, nhop: int, bz: int, eo: tuple | None = None):
     """One hop-set pass over a (t, z-block) tile.  Ref shapes:
       psi refs:   (3, 2, 1, BZ, YX) x5 (central, t+n, t-n, z+n, z-n)
       u / u_bw:   (4, 3, 3, 2, 1, BZ, YX)
+    With ``eo = (target_parity, Xh)`` the tile is a checkerboarded half
+    lattice: x shifts use the slot-parity select, u is the target-parity
+    forward links and u_bw the pre-shifted opposite-parity backward
+    links (backward_links_eo).
     """
+    from jax.experimental import pallas as pl
 
     def kernel(psi_c, psi_tp, psi_tm, psi_zp, psi_zm, u, u_bw, out_ref):
         def psi_at(ref, c):
             return (ref[c, 0, 0].astype(F32), ref[c, 1, 0].astype(F32))
+
+        if eo is not None:
+            parity, Xh = eo
+            t_id = pl.program_id(0)
+            zb_id = pl.program_id(1)
+            shape = psi_c.shape[-2:]
+            z = (jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+                 + zb_id * bz)
+            y = jax.lax.broadcasted_iota(jnp.int32, shape, 1) // Xh
+            mask_r0 = ((t_id + z + y + parity) % 2) == 0
+
+        def shift_x(v, sign):
+            if eo is None:
+                return _shift_xy(v, 0, sign, X, nhop)
+            return _shift_x_eo_n(v, sign, eo[1], mask_r0, nhop)
+
+        def shift_y(v, sign):
+            return _shift_xy(v, 1, sign, X if eo is None else eo[1],
+                             nhop)
 
         def link(ref, mu, a, b):
             return (ref[mu, a, b, 0, 0].astype(F32),
@@ -96,10 +134,11 @@ def _make_stag_kernel(X: int, nhop: int):
                           acc[a][1] + s * term[1])
 
         # x, y: in-plane lane shifts of the central tile
-        for mu in (0, 1):
-            for sign, adjoint in ((+1, False), (-1, True)):
-                hop(lambda c, mu=mu, sign=sign: _shift_xy(
-                    psi_at(psi_c, c), mu, sign, X, nhop), mu, adjoint)
+        for sign, adjoint in ((+1, False), (-1, True)):
+            hop(lambda c, sign=sign: shift_x(psi_at(psi_c, c), sign),
+                0, adjoint)
+            hop(lambda c, sign=sign: shift_y(psi_at(psi_c, c), sign),
+                1, adjoint)
         # z: roll + nhop-row splice from the neighbour z-block tile
         hop(lambda c: _shift_z_n(psi_at(psi_c, c), psi_at(psi_zp, c),
                                  +1, nhop), 2, False)
@@ -122,7 +161,8 @@ def _make_stag_kernel(X: int, nhop: int):
 _STAG_PLANES = 180
 
 
-def _stag_pass(links_pl, links_bw_pl, psi_pl, X, nhop, bz, interpret):
+def _stag_pass(links_pl, links_bw_pl, psi_pl, X, nhop, bz, interpret,
+               eo=None):
     from jax.experimental import pallas as pl
 
     _, _, T, Z, YX = psi_pl.shape
@@ -142,7 +182,7 @@ def _stag_pass(links_pl, links_bw_pl, psi_pl, X, nhop, bz, interpret):
         (4, 3, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
 
     return pl.pallas_call(
-        _make_stag_kernel(X, nhop),
+        _make_stag_kernel(X, nhop, bz, eo),
         grid=(T, nzb),
         in_specs=[psi_spec(0, 0), psi_spec(+nhop, 0), psi_spec(-nhop, 0),
                   psi_spec(0, +1), psi_spec(0, -1), links_spec,
@@ -184,5 +224,58 @@ def dslash_staggered_pallas(fat_pl: jnp.ndarray, fat_bw_pl: jnp.ndarray,
     if long_pl is not None:
         out = out + _stag_pass(long_pl, long_bw_pl, psi_pl, X, 3, bz,
                                interpret)
+    odt = out_dtype or psi_pl.dtype
+    return out.astype(odt)
+
+
+# -- even/odd (checkerboarded) variant: the staggered CG hot path -----------
+
+def backward_links_eo(u_there_pl: jnp.ndarray, dims, target_parity: int,
+                      nhop: int) -> jnp.ndarray:
+    """Pre-shifted backward links on the half lattice:
+    out[mu](x) = U_mu(x - nhop*mu) for parity-``target_parity`` sites,
+    where ``u_there_pl`` holds the opposite-parity links (odd nhop) in
+    the packed pair layout (4,3,3,2,T,Z,Y*Xh)."""
+    from .wilson_packed import shift_eo_packed
+    return jnp.stack([
+        shift_eo_packed(u_there_pl[mu], dims, mu, -1, target_parity, nhop)
+        for mu in range(4)])
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "target_parity",
+                                             "interpret", "block_z",
+                                             "out_dtype"))
+def dslash_staggered_eo_pallas(fat_here_pl, fat_bw_pl, psi_pl, dims,
+                               target_parity: int,
+                               long_here_pl=None, long_bw_pl=None,
+                               interpret: bool = False,
+                               block_z: int | None = None,
+                               out_dtype=None) -> jnp.ndarray:
+    """Checkerboarded staggered / improved-staggered hop on
+    pallas-layout half-lattice pair arrays; matches
+    staggered_packed.dslash_staggered_eo_packed_pairs.
+
+    fat_here_pl/long_here_pl: (4,3,3,2,T,Z,Y*Xh) target-parity forward
+    links; the _bw arrays come from ``backward_links_eo`` (once per KS
+    link load).  psi_pl: (3,2,T,Z,Y*Xh) parity-(1-p) color planes.
+    """
+    T, Z, Y, X = dims
+    Xh = X // 2
+    _, _, _, _, YXh = psi_pl.shape
+    if block_z is not None:
+        bz = block_z
+        if Z % bz != 0:
+            raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    else:
+        bz = _pick_bz(Z, YXh, psi_pl.dtype, planes=_STAG_PLANES,
+                      min_bz=3 if (long_here_pl is not None and Z > 3)
+                      else 1)
+
+    eo = (target_parity, Xh)
+    out = _stag_pass(fat_here_pl, fat_bw_pl, psi_pl, X, 1, bz, interpret,
+                     eo)
+    if long_here_pl is not None:
+        out = out + _stag_pass(long_here_pl, long_bw_pl, psi_pl, X, 3,
+                               bz, interpret, eo)
     odt = out_dtype or psi_pl.dtype
     return out.astype(odt)

@@ -194,35 +194,36 @@ def _slot_mask_packed(T: int, Z: int, Y: int, Xh: int, parity: int):
 
 
 def shift_eo_packed(arr: jnp.ndarray, dims, mu: int, sign: int,
-                    target_parity: int) -> jnp.ndarray:
-    """Checkerboarded shift on the packed half lattice.
+                    target_parity: int, nhop: int = 1) -> jnp.ndarray:
+    """Checkerboarded shift by nhop sites on the packed half lattice.
 
-    arr: (..., T, Z, Y*Xh) holding a parity-(1-p) field; result indexed by
-    parity-p half-sites is arr evaluated at x + sign*mu_hat.  ``dims`` is
-    the full (T, Z, Y, X).
+    arr: (..., T, Z, Y*Xh) holding a parity-(1-p) field when nhop is odd
+    (parity-p when even); result indexed by parity-p half-sites is arr
+    evaluated at x + sign*nhop*mu_hat.  ``dims`` is the full (T, Z, Y, X).
+    x decomposition follows ops/shift.shift_eo: an even hop is a pure
+    xh-slot roll; an odd hop is (nhop-1)/2 slot rolls plus one
+    slot-parity flip selected by the target site's x slot.
     """
     T, Z, Y, X = dims
     Xh = X // 2
     if mu == 3:
-        return jnp.roll(arr, -sign, axis=-3)
+        return jnp.roll(arr, -sign * nhop, axis=-3)
     if mu == 2:
-        return jnp.roll(arr, -sign, axis=-2)
+        return jnp.roll(arr, -sign * nhop, axis=-2)
     if mu == 1:
-        return jnp.roll(arr, -sign * Xh, axis=-1)
-    # x direction: same-xh or neighbouring-xh depending on slot parity
-    last, first = _x_wrap_masks(Y, Xh)
-    if sign > 0:
-        interior = jnp.roll(arr, -1, axis=-1)
-        wrapped = jnp.roll(arr, Xh - 1, axis=-1)
-        moved = jnp.where(jnp.asarray(last), wrapped, interior)
-    else:
-        interior = jnp.roll(arr, 1, axis=-1)
-        wrapped = jnp.roll(arr, -(Xh - 1), axis=-1)
-        moved = jnp.where(jnp.asarray(first), wrapped, interior)
+        return jnp.roll(arr, -sign * nhop * Xh, axis=-1)
+    # x direction: slot rolls ride shift_packed's fused-axis x case with
+    # the HALF extent Xh as the wrap width
+    if nhop % 2 == 0:
+        return (shift_packed(arr, 0, sign, Xh, Y, nhop // 2)
+                if nhop else arr)
+    k = (nhop - 1) // 2
+    base = shift_packed(arr, 0, sign, Xh, Y, k) if k else arr
+    moved = shift_packed(base, 0, sign, Xh, Y, 1)
     mask_r0 = jnp.asarray(_slot_mask_packed(T, Z, Y, Xh, target_parity))
     if sign > 0:
-        return jnp.where(mask_r0, arr, moved)
-    return jnp.where(mask_r0, moved, arr)
+        return jnp.where(mask_r0, base, moved)
+    return jnp.where(mask_r0, moved, base)
 
 
 def dslash_eo_packed(gauge_eo_p, psi_p: jnp.ndarray, dims,

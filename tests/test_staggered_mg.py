@@ -17,6 +17,17 @@ GEOM = LatticeGeometry((8, 8, 8, 8))
 MASS = 0.02
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_compile_caches():
+    """The MG solves in this module compile some of the largest graphs in
+    the suite; after ~250 earlier tests' executables accumulate in the
+    process, the XLA:CPU compile of the GCR+V-cycle program has been
+    observed to segfault (backend_compile_and_load).  Dropping the cached
+    executables first keeps peak compiler memory bounded."""
+    jax.clear_caches()
+    yield
+
+
 @pytest.fixture(scope="module")
 def setup():
     key = jax.random.PRNGKey(808)
