@@ -247,6 +247,45 @@ def _pair_refined_solve(mv, sys_rhs, dtype, param, inner_solver,
     return res._replace(iters=jnp.int32(sum(inner_iters)))
 
 
+class _StaggeredPairsSolve:
+    """Solve-loop adapter presenting DiracStaggeredPCPairs through the
+    generic invert flow (prepare/M/reconstruct), so every Krylov iterate
+    stays complex-free (pair representation), with the pallas eo stencil
+    on real TPU.  The mixed-precision hooks (sloppy/codec) hand back a
+    bf16 pair operator + plain-cast codec on the SAME layout."""
+
+    hermitian = True
+
+    def __init__(self, dpc, use_pallas: bool):
+        self._dpc = dpc
+        self.op = dpc.pairs(jnp.float32, use_pallas=use_pallas)
+
+    def prepare(self, b_even, b_odd):
+        return self.op.prepare_pairs(b_even, b_odd)
+
+    def M(self, x_pp):
+        return self.op.M_pairs(x_pp)
+
+    Mdag = M
+
+    def MdagM(self, x_pp):
+        return self.op.M_pairs(self.op.M_pairs(x_pp))
+
+    def reconstruct(self, x_pp, b_even, b_odd):
+        return self.op.reconstruct_pairs(x_pp, b_even, b_odd)
+
+    def sloppy(self, prec: str = "half"):
+        return self._dpc.pairs(jnp.bfloat16,
+                               use_pallas=self.op.use_pallas)
+
+    def codec(self, precise_dtype, store_dtype):
+        from ..solvers.mixed import pair_inplace_codec
+        return pair_inplace_codec(store_dtype)
+
+    def flops_per_site_M(self) -> int:
+        return getattr(self._dpc, "flops_per_site_M", lambda: 0)()
+
+
 def invert_quda(source, param: InvertParam):
     """invertQuda: solve M x = b per param; returns x, mutates param
     result fields (true_res, iter_count, secs, gflops)."""
@@ -268,12 +307,33 @@ def invert_quda(source, param: InvertParam):
     # sloppy levels: a lower complex dtype (double->single, CPU only) and
     # bf16/int8 pair storage ("half"/"quarter" — ops/pair.py).
     sloppy_prec = _resolve_sloppy(param)
+    import os
+    on_tpu = jax.default_backend() == "tpu"
+    packed_default = "1" if on_tpu else "0"
+    # complex-free staggered pair adapter: CG-family solves only (its
+    # coefficients are real on the Hermitian PC operator, so the pair
+    # representation is exact; bicgstab/gcr would feed pair residuals
+    # into the complex wrappers), and never silently degrade an f64
+    # solve to the f32 pair representation (on TPU f64 does not exist,
+    # so the adapter is the only executable path there)
+    stag_pairs = (param.dslash_type in ("staggered", "asqtad", "hisq")
+                  and pc
+                  and param.inv_type in ("cg", "pcg", "cg3", "cgne",
+                                         "cgnr")
+                  and (param.cuda_prec == "single" or on_tpu)
+                  and os.environ.get("QUDA_TPU_PACKED",
+                                     packed_default) == "1")
     pair_sloppy = (sloppy_prec in ("half", "quarter")
-                   and param.dslash_type == "wilson" and pc)
+                   and ((param.dslash_type == "wilson" and pc)
+                        or stag_pairs))
     dtype_sloppy = (sloppy_prec != param.cuda_prec
                     and complex_dtype(sloppy_prec) != complex_dtype(
                         param.cuda_prec))
     mixed = (param.inv_type == "cg" and (pair_sloppy or dtype_sloppy))
+    # a canonical dtype-sloppy operator cannot consume pair iterates
+    # (same exclusion as the wilson packed gate below)
+    stag_pairs = stag_pairs and not (mixed and dtype_sloppy
+                                     and not pair_sloppy)
 
     # TPU-native packed device order for the Wilson PC solve path (QUDA
     # keeps solver fields in native FloatN order the same way); default
@@ -281,13 +341,16 @@ def invert_quda(source, param: InvertParam):
     # the dtype-sloppy mixed path (its canonical sloppy operator cannot
     # consume packed iterates) and for 'quarter' (the int8 gauge codec
     # lives on the canonical layout).
-    import os
-    packed_default = "1" if jax.default_backend() == "tpu" else "0"
     if (param.dslash_type == "wilson" and pc
             and os.environ.get("QUDA_TPU_PACKED", packed_default) == "1"
             and not (mixed and dtype_sloppy and not pair_sloppy)
             and sloppy_prec != "quarter"):
         d = d.packed()
+    if stag_pairs:
+        # complex-free staggered solve loop (pair representation end to
+        # end; the pallas eo stencil on real TPU).  'quarter' storage has
+        # no staggered int8 codec — the sloppy op falls back to bf16.
+        d = _StaggeredPairsSolve(d, jax.default_backend() == "tpu")
 
     if pc:
         be, bo = _split(b, param, d)
@@ -334,8 +397,10 @@ def invert_quda(source, param: InvertParam):
             codec = (d.codec(dtype, sl.store_dtype)
                      if hasattr(d, "codec")
                      else solvers.pair_codec(sl.store_dtype, dtype))
+            # staggered PC is already the (Hermitian) normal operator
+            mv_lo = sl.M_pairs if hermitian_pc else sl.MdagM_pairs
             res = solvers.cg_reliable(
-                mv, sl.MdagM_pairs, sys_rhs, tol=param.tol,
+                mv, mv_lo, sys_rhs, tol=param.tol,
                 maxiter=param.maxiter, delta=param.reliable_delta,
                 codec=codec)
         else:
@@ -519,6 +584,27 @@ def invert_multishift_quda(source, param: InvertParam):
     b = jnp.asarray(source, complex_dtype(param.cuda_prec))
     d = _build_dirac(param, True)
     be, bo = _split(b, param, d)
+
+    import os
+    on_tpu = jax.default_backend() == "tpu"
+    packed_default = "1" if on_tpu else "0"
+    if (param.dslash_type in ("staggered", "asqtad", "hisq")
+            and (param.cuda_prec == "single" or on_tpu)
+            and os.environ.get("QUDA_TPU_PACKED", packed_default) == "1"):
+        # complex-free multishift (the RHMC rational-force hot path):
+        # shared-Krylov solve entirely on pair arrays (CG coefficients
+        # on the Hermitian PC operator are real, so the pair
+        # representation is exact), pallas eo stencil on real TPU
+        t0 = time.perf_counter()
+        ad = _StaggeredPairsSolve(d, jax.default_backend() == "tpu")
+        rhs_pp = ad.prepare(be, bo)
+        res = multishift_cg(ad.M, rhs_pp, tuple(param.offset),
+                            tol=param.tol, maxiter=param.maxiter)
+        param.iter_count = int(res.iters)
+        param.secs = time.perf_counter() - t0
+        return jnp.stack([ad.op._from_pairs(res.x[i], b.dtype)
+                          for i in range(len(param.offset))])
+
     rhs = d.prepare(be, bo)
     if getattr(d, "hermitian", False):
         mv = d.M

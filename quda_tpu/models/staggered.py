@@ -233,3 +233,27 @@ class DiracStaggeredPCPairs:
     def MdagM(self, x):
         return self._from_pairs(self.MdagM_pairs(self._to_pairs(x)),
                                 x.dtype)
+
+
+    # -- pair-space Schur boundary (the whole solve stays complex-free) --
+    def prepare_pairs(self, b_even, b_odd):
+        """Canonical complex parity sources -> pair-form PC rhs:
+        2m b_p - D_pq b_q, computed on pair arrays."""
+        p = self.matpc
+        b_p, b_q = (b_even, b_odd) if p == EVEN else (b_odd, b_even)
+        bp = self._to_pairs(b_p).astype(jnp.float32)
+        dq = self.D_to_pairs(self._to_pairs(b_q), p,
+                             out_dtype=jnp.float32)
+        return ((2.0 * self.mass) * bp - dq).astype(self.store_dtype)
+
+    def reconstruct_pairs(self, x_pp, b_even, b_odd):
+        """Pair-form PC solution -> canonical complex (x_even, x_odd):
+        x_q = (b_q - D_qp x_p) / 2m, the D applied on pair arrays."""
+        p = self.matpc
+        b_q = b_odd if p == EVEN else b_even
+        dq = self.D_to_pairs(x_pp, 1 - p, out_dtype=jnp.float32)
+        x_q_pp = (self._to_pairs(b_q).astype(jnp.float32) - dq) / (
+            2.0 * self.mass)
+        x_p = self._from_pairs(x_pp, b_q.dtype)
+        x_q = self._from_pairs(x_q_pp, b_q.dtype)
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)
