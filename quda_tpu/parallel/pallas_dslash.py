@@ -1,0 +1,117 @@
+"""Multi-chip Wilson dslash with the pallas interior kernel — the
+"fused" manual policy.
+
+Reference behavior: QUDA's interior/exterior kernel split
+(lib/dslash_policy.hpp: interior kernel overlapped with halo comms,
+then exterior kernels fix the boundary faces; NVSHMEM variant in
+include/dslash_shmem.h).  The TPU re-design:
+
+1. run the single-chip pallas kernel (ops/wilson_pallas_packed) on the
+   LOCAL block with its periodic wraps — every interior site is final,
+   boundary faces carry a wrong-wrap contribution;
+2. `lax.ppermute` the psi boundary planes to the neighbouring shards
+   (backward-hop links need no exchange: `backward_gauge` runs on the
+   GLOBAL field before sharding, so cross-shard links are already
+   resident in each shard's pre-shifted block);
+3. fix the faces in XLA: subtract the wrong-wrap hop term, add the
+   halo hop term — O(surface) work that XLA's latency-hiding scheduler
+   overlaps with the next interior launch.
+
+Sharding model: mesh axes "t" and "z" partition the packed layout's
+T and Z axes; y/x stay shard-local (their shifts are in-plane lane
+rolls — fusing Y*X is what makes the kernel fast, so those axes are
+the natural local ones).  This matches how 4-d lattices are usually
+decomposed (outer axes first).
+
+All arrays are the packed PAIR layout: psi (4,3,2,T,Z,YX) storage,
+gauge/gauge_bw (4,3,3,2,T,Z,YX) — per-shard LOCAL blocks inside
+shard_map.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.wilson_pallas import TABLES
+from ..ops.wilson_packed import (_hop_packed_pairs, _planes_psi, _planes_u,
+                                 _stack_pairs)
+from .halo import _permute_slice as _nbr
+
+
+def _hop_term(psi_slab, u_slab, table, adjoint):
+    """Single hop-direction contribution on a boundary slab (f32)."""
+    return _stack_pairs(
+        _hop_packed_pairs(_planes_psi(psi_slab), _planes_u(u_slab),
+                          table, adjoint), jnp.float32)
+
+
+def _face(arr, axis, lo: bool):
+    L = arr.shape[axis]
+    return (lax.slice_in_dim(arr, 0, 1, axis=axis) if lo
+            else lax.slice_in_dim(arr, L - 1, L, axis=axis))
+
+
+def _add_face(out, corr, axis, lo: bool):
+    L = out.shape[axis]
+    idx = 0 if lo else L - 1
+    face = lax.slice_in_dim(out, idx, idx + 1, axis=axis)
+    fixed = (face.astype(jnp.float32) + corr).astype(out.dtype)
+    return lax.dynamic_update_slice_in_dim(out, fixed, idx, axis)
+
+
+def dslash_pallas_sharded(gauge_pl, gauge_bw_pl, psi_pl, X: int, mesh,
+                          interpret: bool = False):
+    """Wilson hop sum on per-shard local packed pair blocks — call
+    INSIDE shard_map over ``mesh`` with the t/z mesh axes partitioning
+    the T/Z array axes (y and x mesh axes must be size 1).
+
+    gauge_bw_pl is the LOCAL block of the pre-shifted backward gauge of
+    the GLOBAL field (compute wilson_pallas_packed.backward_gauge on
+    the global array before sharding — its t/z shifts then already
+    carry the cross-shard links, and only psi halos plus the wrong
+    local wraps remain to fix).
+    """
+    from ..ops.wilson_pallas_packed import dslash_pallas_packed
+
+    n_t, n_z = mesh.shape["t"], mesh.shape["z"]
+    if mesh.shape["y"] != 1 or mesh.shape["x"] != 1:
+        raise ValueError(
+            "dslash_pallas_sharded shards t/z only (y/x mesh axes must "
+            "be 1; their shifts are in-plane lane rolls)")
+
+    # interior pass: periodic single-chip kernel on the local block.
+    # gauge_bw is exact even on the boundary (pre-shifted globally);
+    # only psi wraps are wrong on the faces.
+    out = dslash_pallas_packed(gauge_pl, psi_pl, X,
+                               gauge_bw=gauge_bw_pl, interpret=interpret)
+
+    t_ax, z_ax = -3, -2
+    for axis, name, n, mu in ((t_ax, "t", n_t, 3), (z_ax, "z", n_z, 2)):
+        if n == 1:
+            continue                      # periodic wrap is correct
+        u_fwd_hi = _face(gauge_pl[mu], axis, lo=False)     # U_mu at last plane
+        u_bwd_lo = _face(gauge_bw_pl[mu], axis, lo=True)   # U_mu(x-mu) at 0
+        # forward hop on the HIGH face: psi(x+mu) must come from the
+        # next shard's first plane (kernel used the local first plane)
+        halo_hi = _nbr(_face(psi_pl, axis, lo=True), name,
+                       towards_lower=True, n=n)
+        wrong_hi = _face(psi_pl, axis, lo=True)
+        corr_hi = (_hop_term(halo_hi, u_fwd_hi, TABLES[(mu, +1)], False)
+                   - _hop_term(wrong_hi, u_fwd_hi, TABLES[(mu, +1)],
+                               False))
+        out = _add_face(out, corr_hi, axis, lo=False)
+        # backward hop on the LOW face: psi(x-mu) from the previous
+        # shard's last plane (the backward link u_bwd_lo is already the
+        # correct cross-shard link: backward_gauge ran globally)
+        halo_lo = _nbr(_face(psi_pl, axis, lo=False), name,
+                       towards_lower=False, n=n)
+        wrong_lo = _face(psi_pl, axis, lo=False)
+        corr_lo = (_hop_term(halo_lo, u_bwd_lo, TABLES[(mu, -1)], True)
+                   - _hop_term(wrong_lo, u_bwd_lo, TABLES[(mu, -1)],
+                               True))
+        out = _add_face(out, corr_lo, axis, lo=True)
+    return out
