@@ -103,8 +103,14 @@ def trlm(matvec: Callable, example: jnp.ndarray, param: EigParam,
 
     rdt = jnp.zeros((), example.dtype).real.dtype
     re = jax.random.normal(key, example.shape, rdt)
-    im = jax.random.normal(jax.random.fold_in(key, 1), example.shape, rdt)
-    v0 = (re + 1j * im).astype(example.dtype)
+    if jnp.issubdtype(example.dtype, jnp.complexfloating):
+        im = jax.random.normal(jax.random.fold_in(key, 1), example.shape,
+                               rdt)
+        v0 = (re + 1j * im).astype(example.dtype)
+    else:
+        # real example: the REALIFIED Lanczos (eig/pair_eig.py) — the
+        # whole algorithm below is real symmetric arithmetic then
+        v0 = re.astype(example.dtype)
     v0 = v0 / jnp.sqrt(blas.norm2(v0)).astype(example.dtype)
 
     V = jnp.zeros((m,) + example.shape, example.dtype).at[0].set(v0)

@@ -1,0 +1,83 @@
+"""Complex-free eigensolves: thick-restarted Lanczos on pair arrays.
+
+Reference behavior: lib/eig_trlm.cpp computes low modes of Hermitian
+operators (deflation, eigCG spaces).  On TPU runtimes without complex64
+execution (see PERF.md) the complex TRLM cannot run at all; this module
+re-poses the problem over the REALIFICATION of the operator:
+
+A Hermitian operator A on C^n is a symmetric operator on R^{2n} under
+v = v_re + i v_im  <->  (v_re, v_im) — exactly the re/im pair arrays the
+TPU solve path already uses (ops/wilson_packed pair layouts).  Its real
+spectrum is A's spectrum with every eigenvalue DOUBLED: the complex
+eigenvector v spans the real 2-plane {v, iv}.  So:
+
+1. run the standard TRLM (eig/lanczos.py — its arithmetic is already
+   dtype-generic; real dtype means plain symmetric Lanczos) on the
+   pair-array operator asking for 2k pairs;
+2. map each converged real vector back to a complex eigenvector (the
+   pair array IS the complex vector);
+3. deduplicate the doubled spectrum: u and iu have complex overlap of
+   modulus 1, so keep a vector only if its |<v_kept, v>| stays below
+   0.5 against everything already kept.
+
+The pair axis (re/im) location varies by layout — axis 2 for Wilson
+packed (4,3,2,T,Z,YX), axis 1 for staggered (3,2,T,Z,Y*Xh) — and is a
+parameter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import blas
+from .lanczos import EigParam, EigResult, trlm
+
+
+def complex_pair_dot(a: jnp.ndarray, b: jnp.ndarray, pair_axis: int):
+    """<a, b> = sum conj(a) b of the complex vectors the pair arrays
+    represent; returns (re, im)."""
+    ar, ai = jnp.take(a, 0, pair_axis), jnp.take(a, 1, pair_axis)
+    br, bi = jnp.take(b, 0, pair_axis), jnp.take(b, 1, pair_axis)
+    return (jnp.sum(ar * br + ai * bi), jnp.sum(ar * bi - ai * br))
+
+
+def trlm_pairs(matvec: Callable, example: jnp.ndarray, param: EigParam,
+               pair_axis: int, key=None) -> EigResult:
+    """TRLM for a Hermitian operator given in pair representation.
+
+    ``matvec`` maps pair arrays to pair arrays (e.g.
+    DiracStaggeredPCPairs.M_pairs, DiracWilsonPCPackedSloppy.MdagM_pairs
+    at f32 storage); ``example`` is a pair array of the operator's
+    vector shape.  Returns param.n_ev complex eigenpairs AS PAIR ARRAYS
+    (convert with the layout's from_packed_pairs for complex output).
+    """
+    assert not jnp.issubdtype(example.dtype, jnp.complexfloating), \
+        "trlm_pairs wants a REAL pair-array example"
+    doubled = dataclasses.replace(param, n_ev=2 * param.n_ev,
+                                  n_kr=2 * param.n_kr)
+    res = trlm(matvec, example, doubled, key=key)
+
+    kept, kept_vals, kept_res = [], [], []
+    for i in range(len(res.evals)):
+        v = res.evecs[i]
+        dup = False
+        for u in kept:
+            dr, di = complex_pair_dot(u, v, pair_axis)
+            n2u = blas.norm2(u)
+            n2v = blas.norm2(v)
+            if float(dr ** 2 + di ** 2) > 0.25 * float(n2u * n2v):
+                dup = True
+                break
+        if not dup:
+            kept.append(v)
+            kept_vals.append(res.evals[i])
+            kept_res.append(res.residua[i])
+        if len(kept) == param.n_ev:
+            break
+    converged = res.converged and len(kept) == param.n_ev
+    return EigResult(np.asarray(kept_vals), jnp.stack(kept),
+                     np.asarray(kept_res), res.restarts, converged)
