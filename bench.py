@@ -53,6 +53,10 @@ import time
 BASELINE_GFLOPS = 1400.0
 
 
+LAST_TPU_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_TPU_LAST.json")
+
+
 def _probe_subprocess() -> dict:
     """Probe platform + complex64 execution support in a child process
     (a failed complex op can wedge the backend, and device init can hang
@@ -125,7 +129,24 @@ def main():
         # (its answer would misattribute the platform of the timings)
         probe = {"platform": "cpu", "complex_ok": True}
     else:
-        probe = _probe_subprocess()
+        # The tunnel to the chip goes down for stretches of minutes; a
+        # single failed probe must not condemn the round's number to the
+        # CPU fallback.  Retry with a spaced backoff before giving up —
+        # except when the caller explicitly pinned the CPU backend (a
+        # genuine CPU-only host should not pay ~6 min of dead waits).
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            attempts = 1
+        else:
+            attempts = int(os.environ.get("QUDA_TPU_BENCH_PROBE_RETRIES",
+                                          "5"))
+        wait_s = float(os.environ.get("QUDA_TPU_BENCH_PROBE_WAIT_S", "90"))
+        probe = {}
+        for i in range(max(attempts, 1)):
+            probe = _probe_subprocess()
+            if probe.get("platform") not in (None, "cpu"):
+                break
+            if i + 1 < attempts:
+                time.sleep(wait_s)
         if "platform" not in probe:
             # device init hung or failed: fall back to CPU via re-exec
             os.environ["QUDA_TPU_BENCH_CPU"] = "1"
@@ -264,6 +285,24 @@ def main():
                     f"gate failed: rel err {pallas_rel_err:.3e}")
         except Exception as e:
             paths["pallas_packed_error"] = str(e)[:160]
+        # v3 kernel: scatter-form backward hops, no backward-gauge copy
+        try:
+            @jax.jit
+            def _gate3(g, p):
+                a = wpp.dslash_pallas_packed_v3(g, p, X)
+                b = wpk.dslash_packed_pairs(g, p, X, Y)
+                return (jnp.max(jnp.abs(a - b)), jnp.max(jnp.abs(b)))
+            d3, m3 = _gate3(g_d, p_d)
+            v3_rel_err = _fetch(d3) / _fetch(m3)
+            if v3_rel_err < 1e-4:
+                run_path("pallas_v3",
+                         lambda g, v: wpp.dslash_pallas_packed_v3(g, v, X),
+                         (g_d, p_d))
+            else:
+                paths["pallas_v3_error"] = (
+                    f"gate failed: rel err {v3_rel_err:.3e}")
+        except Exception as e:
+            paths["pallas_v3_error"] = str(e)[:160]
         # bf16-storage sloppy variants (f32 compute) — the half-precision
         # operator number; pallas reads bf16 blocks if given bf16 arrays
         g_bf = g_d.astype(jnp.bfloat16)
@@ -278,6 +317,9 @@ def main():
         run_path("pallas_bf16",
                  lambda g, v: wpp.dslash_pallas_packed(
                      g, v, X, gauge_bw=gbw_bf),
+                 (g_bf, p_bf))
+        run_path("pallas_v3_bf16",
+                 lambda g, v: wpp.dslash_pallas_packed_v3(g, v, X),
                  (g_bf, p_bf))
 
     if complex_ok or platform == "cpu":
@@ -308,7 +350,7 @@ def main():
     best_path = min(f32_paths, key=f32_paths.get) if f32_paths else "none"
     gflops = flops / f32_paths[best_path] / 1e9 if f32_paths else 0.0
 
-    print(json.dumps({
+    record = {
         "metric": "wilson_dslash_gflops_chip",
         "value": round(gflops, 1),
         "unit": "GFLOPS",
@@ -327,7 +369,21 @@ def main():
             "complex_ok": complex_ok,
         },
         "paths": paths,
-    }))
+    }
+    # Persist good TPU runs; if this run had to fall back to CPU (the
+    # tunnel drops for stretches), carry the last attributable TPU
+    # measurement alongside so the round still records a chip number.
+    try:
+        if platform == "tpu" and gflops > 0:
+            with open(LAST_TPU_FILE, "w") as f:
+                json.dump(dict(record, measured_at=time.strftime(
+                    "%Y-%m-%d %H:%M:%S")), f, indent=1)
+        elif platform == "cpu" and os.path.exists(LAST_TPU_FILE):
+            with open(LAST_TPU_FILE) as f:
+                record["last_tpu"] = json.load(f)
+    except Exception:
+        pass
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -340,12 +340,13 @@ def main(argv):
         def pairs_op(store, use_pallas=False):
             # the model-class pair operator (one home for the Schur
             # composition / gamma5 trick), with its gauge pair arrays
-            # device_put onto the benchmark backend
+            # device_put onto the benchmark backend (the v3 pallas
+            # kernel reads the unshifted links — no _u_bw to move)
             with jax.default_device(cpu0):
                 sl = dpk_h.pairs(store, use_pallas=use_pallas)
             sl.gauge_eo_pp = tuple(
                 jax.device_put(np.asarray(g)) for g in sl.gauge_eo_pp)
-            if use_pallas:
+            if getattr(sl, "_u_bw", None) is not None:
                 sl._u_bw = tuple(
                     jax.device_put(np.asarray(g)) for g in sl._u_bw)
             return sl

@@ -57,8 +57,14 @@ def trlm_pairs(matvec: Callable, example: jnp.ndarray, param: EigParam,
     """
     assert not jnp.issubdtype(example.dtype, jnp.complexfloating), \
         "trlm_pairs wants a REAL pair-array example"
-    doubled = dataclasses.replace(param, n_ev=2 * param.n_ev,
-                                  n_kr=2 * param.n_kr)
+    dim = int(example.size)  # realified space dimension
+    n_kr = min(2 * param.n_kr, dim)
+    if 2 * param.n_ev > n_kr:
+        raise ValueError(
+            f"n_ev={param.n_ev} needs a doubled Krylov space of "
+            f"{2 * param.n_ev} but the realified dimension caps it at "
+            f"{n_kr}")
+    doubled = dataclasses.replace(param, n_ev=2 * param.n_ev, n_kr=n_kr)
     res = trlm(matvec, example, doubled, key=key)
 
     kept, kept_vals, kept_res = [], [], []
@@ -78,6 +84,11 @@ def trlm_pairs(matvec: Callable, example: jnp.ndarray, param: EigParam,
             kept_res.append(res.residua[i])
         if len(kept) == param.n_ev:
             break
+    if not kept:
+        raise RuntimeError(
+            "trlm_pairs: deduplication kept no eigenpairs — the doubled "
+            "spectrum did not converge (inspect trlm residua or raise "
+            "n_kr/max_restarts)")
     converged = res.converged and len(kept) == param.n_ev
     return EigResult(np.asarray(kept_vals), jnp.stack(kept),
                      np.asarray(kept_res), res.restarts, converged)

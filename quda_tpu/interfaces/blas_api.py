@@ -72,6 +72,10 @@ class BLASParam:
                f"bad data_order {self.data_order}")
         _check(self.batch_count > 0, "batch_count must be positive")
         if self.blas_type == "gemm":
+            if self.data_type in ("S", "D"):
+                _check(np.imag(self.alpha) == 0 and np.imag(self.beta) == 0,
+                       "complex alpha/beta with real data_type "
+                       f"{self.data_type}")
             _check(self.trans_a in ("n", "t", "c"), "bad trans_a")
             _check(self.trans_b in ("n", "t", "c"), "bad trans_b")
             _check(self.m > 0 and self.n > 0 and self.k > 0,

@@ -11,6 +11,12 @@
  * codes, declared in quda_tpu_fortran.f90 alongside typed interface
  * blocks.  The shim wraps the C entry points of quda_tpu_c.cpp, so the
  * same libquda_tpu.so serves C and Fortran hosts.
+ *
+ * Symbols carry a qtpu_ prefix (qtpu_invert_quda_, not invert_quda_):
+ * the argument lists here are NOT those of the reference's
+ * quda_fortran.h, and exporting the reference's exact symbol names
+ * would let a host built against the upstream header link successfully
+ * and then silently misinterpret every argument.
  */
 
 #include "quda_tpu.h"
@@ -49,27 +55,27 @@ void check(int rc, const char *what) {
 
 extern "C" {
 
-/* init_quda_(device): device selection is owned by the JAX runtime on
+/* qtpu_init_quda_(device): device selection is owned by the JAX runtime on
  * TPU; the argument is accepted for source compatibility. */
-void init_quda_(int *device) {
+void qtpu_init_quda_(int *device) {
   (void)device;
   check(qtpu_init(), "init_quda");
 }
 
-void end_quda_(void) { check(qtpu_end(), "end_quda"); }
+void qtpu_end_quda_(void) { check(qtpu_end(), "end_quda"); }
 
-/* load_gauge_quda_(links, X, antiperiodic_t): links in the
+/* qtpu_load_gauge_quda_(links, X, antiperiodic_t): links in the
  * direction-major layout of quda_tpu.h; X = {Lx,Ly,Lz,Lt}. */
-void load_gauge_quda_(double *links, int *X, int *antiperiodic_t) {
+void qtpu_load_gauge_quda_(double *links, int *X, int *antiperiodic_t) {
   check(qtpu_load_gauge(links, X, *antiperiodic_t), "load_gauge_quda");
 }
 
-void plaq_quda_(double plaq[3]) { check(qtpu_plaq(plaq), "plaq_quda"); }
+void qtpu_plaq_quda_(double plaq[3]) { check(qtpu_plaq(plaq), "plaq_quda"); }
 
-/* invert_quda_(x, b, dslash_code, inv_code, solve_code, kappa, mass,
+/* qtpu_invert_quda_(x, b, dslash_code, inv_code, solve_code, kappa, mass,
  *              mu, csw, tol, maxiter, true_res, iters, secs)
  * Integer codes per the tables in quda_tpu_fortran.f90. */
-void invert_quda_(double *x, double *b, int *dslash_code, int *inv_code,
+void qtpu_invert_quda_(double *x, double *b, int *dslash_code, int *inv_code,
                   int *solve_code, double *kappa, double *mass, double *mu,
                   double *csw, double *tol, int *maxiter, double *true_res,
                   int *iters, double *secs) {

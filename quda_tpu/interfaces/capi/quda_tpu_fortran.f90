@@ -32,24 +32,24 @@ module quda_tpu
 
   interface
 
-     subroutine init_quda(device)
+     subroutine qtpu_init_quda(device)
        integer, intent(in) :: device
-     end subroutine init_quda
+     end subroutine qtpu_init_quda
 
-     subroutine end_quda()
-     end subroutine end_quda
+     subroutine qtpu_end_quda()
+     end subroutine qtpu_end_quda
 
-     subroutine load_gauge_quda(links, x, antiperiodic_t)
+     subroutine qtpu_load_gauge_quda(links, x, antiperiodic_t)
        complex(8), intent(in) :: links(*)
        integer, intent(in) :: x(4)
        integer, intent(in) :: antiperiodic_t
-     end subroutine load_gauge_quda
+     end subroutine qtpu_load_gauge_quda
 
-     subroutine plaq_quda(plaq)
+     subroutine qtpu_plaq_quda(plaq)
        real(8), intent(out) :: plaq(3)
-     end subroutine plaq_quda
+     end subroutine qtpu_plaq_quda
 
-     subroutine invert_quda(x, b, dslash_code, inv_code, solve_code, &
+     subroutine qtpu_invert_quda(x, b, dslash_code, inv_code, solve_code, &
           kappa, mass, mu, csw, tol, maxiter, true_res, iters, secs)
        complex(8), intent(inout) :: x(*)
        complex(8), intent(in) :: b(*)
@@ -58,7 +58,7 @@ module quda_tpu
        integer, intent(in) :: maxiter
        real(8), intent(out) :: true_res, secs
        integer, intent(out) :: iters
-     end subroutine invert_quda
+     end subroutine qtpu_invert_quda
 
   end interface
 end module quda_tpu

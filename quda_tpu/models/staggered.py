@@ -129,11 +129,12 @@ class DiracStaggeredPC(DiracPC):
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
 
     def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
-              pallas_interpret: bool = False) -> "DiracStaggeredPCPairs":
+              pallas_interpret: bool = False,
+              pallas_version: int = 3) -> "DiracStaggeredPCPairs":
         """Complex-free packed companion (f32 = the precise TPU solve
         path; bf16 = the sloppy operator); see DiracStaggeredPCPairs."""
         return DiracStaggeredPCPairs(self, store_dtype, use_pallas,
-                                     pallas_interpret)
+                                     pallas_interpret, pallas_version)
 
 
 class DiracStaggeredPCPairs:
@@ -155,7 +156,8 @@ class DiracStaggeredPCPairs:
     hermitian = True
 
     def __init__(self, dpc: DiracStaggeredPC, store_dtype=jnp.float32,
-                 use_pallas: bool = False, pallas_interpret: bool = False):
+                 use_pallas: bool = False, pallas_interpret: bool = False,
+                 pallas_version: int = 3):
         from ..ops import staggered_packed as spk
         from ..ops.wilson_packed import to_packed_pairs
         self.geom = dpc.geom
@@ -171,7 +173,13 @@ class DiracStaggeredPCPairs:
             for g in dpc.long_eo) if dpc.long_eo is not None else None)
         self.use_pallas = use_pallas
         self._pallas_interpret = pallas_interpret
-        if use_pallas:
+        if pallas_version not in (2, 3):
+            raise ValueError(f"pallas_version must be 2 or 3, got "
+                             f"{pallas_version}")
+        self._pallas_version = pallas_version
+        # v2 pallas path only: resident pre-shifted backward links (the
+        # v3 scatter-form kernel reads the opposite-parity links as-is)
+        if use_pallas and pallas_version == 2:
             from ..ops import staggered_pallas as spl
             self._fat_bw = tuple(
                 spl.backward_links_eo(self.fat_eo_pp[1 - p], self.dims,
@@ -186,6 +194,16 @@ class DiracStaggeredPCPairs:
         if self.use_pallas:
             from ..ops import staggered_pallas as spl
             p = target_parity
+            if self._pallas_version == 3:
+                return spl.dslash_staggered_eo_pallas_v3(
+                    self.fat_eo_pp[p], self.fat_eo_pp[1 - p], psi_pp,
+                    self.dims, p,
+                    long_here_pl=(self.long_eo_pp[p]
+                                  if self.long_eo_pp is not None else None),
+                    long_there_pl=(self.long_eo_pp[1 - p]
+                                   if self.long_eo_pp is not None
+                                   else None),
+                    interpret=self._pallas_interpret, out_dtype=out_dtype)
             return spl.dslash_staggered_eo_pallas(
                 self.fat_eo_pp[p], self._fat_bw[p], psi_pp, self.dims, p,
                 long_here_pl=(self.long_eo_pp[p]

@@ -237,7 +237,8 @@ class DiracWilsonPCPacked:
         return DiracWilsonPCPackedSloppy(self)
 
     def pairs(self, store_dtype=jnp.bfloat16, use_pallas: bool = False,
-              pallas_interpret: bool = False) -> "DiracWilsonPCPackedSloppy":
+              pallas_interpret: bool = False,
+              pallas_version: int = 3) -> "DiracWilsonPCPackedSloppy":
         """Pair-storage companion at an arbitrary storage dtype.
 
         With f32 storage this is the PRECISE operator in a fully
@@ -246,9 +247,11 @@ class DiracWilsonPCPacked:
         native-order analog of QUDA keeping solver fields in float2/
         float4 orders (no complex type on the device either).
         ``use_pallas`` swaps the stencil for the hand-tuned pallas eo
-        kernel (ops/wilson_pallas_packed.dslash_eo_pallas_packed)."""
+        kernel; ``pallas_version`` 3 (default) uses the scatter-form
+        kernel that needs no resident pre-shifted backward links, 2 the
+        round-2 gather kernel."""
         return DiracWilsonPCPackedSloppy(self, store_dtype, use_pallas,
-                                         pallas_interpret)
+                                         pallas_interpret, pallas_version)
 
     def codec(self, precise_dtype, store_dtype=None):
         """StorageCodec matching this operator's sloppy representation
@@ -266,7 +269,8 @@ class DiracWilsonPCPackedSloppy(_PairSloppyBase):
     _spin_axis = 0
 
     def __init__(self, dpk: "DiracWilsonPCPacked", store_dtype=jnp.bfloat16,
-                 use_pallas: bool = False, pallas_interpret: bool = False):
+                 use_pallas: bool = False, pallas_interpret: bool = False,
+                 pallas_version: int = 3):
         from ..ops import wilson_packed as wpk
         self.geom = dpk.geom
         self.kappa = float(dpk.kappa)
@@ -275,12 +279,16 @@ class DiracWilsonPCPackedSloppy(_PairSloppyBase):
         self.store_dtype = store_dtype
         self.gauge_eo_pp = tuple(
             wpk.to_packed_pairs(g, store_dtype) for g in dpk.gauge_eo_p)
-        # pallas hot path: pre-shift the backward links once per gauge
-        # (the kernel then does zero in-kernel link shifts; see
-        # ops/wilson_pallas_packed.backward_gauge_eo)
         self.use_pallas = use_pallas
         self._pallas_interpret = pallas_interpret
-        if use_pallas:
+        if pallas_version not in (2, 3):
+            raise ValueError(f"pallas_version must be 2 or 3, got "
+                             f"{pallas_version}")
+        self._pallas_version = pallas_version
+        # v2 pallas path only: pre-shift the backward links once per
+        # gauge (the v3 scatter-form kernel reads the unshifted
+        # opposite-parity links directly — no resident copy)
+        if use_pallas and pallas_version == 2:
             from ..ops import wilson_pallas_packed as wpp
             self._u_bw = tuple(
                 wpp.backward_gauge_eo(self.gauge_eo_pp[1 - p],
@@ -291,6 +299,13 @@ class DiracWilsonPCPackedSloppy(_PairSloppyBase):
         from ..ops import wilson_packed as wpk
         if self.use_pallas:
             from ..ops import wilson_pallas_packed as wpp
+            if self._pallas_version == 3:
+                return wpp.dslash_eo_pallas_packed_v3(
+                    self.gauge_eo_pp[target_parity],
+                    self.gauge_eo_pp[1 - target_parity], psi_pp,
+                    tuple(self.dims), target_parity,
+                    interpret=self._pallas_interpret,
+                    out_dtype=out_dtype)
             return wpp.dslash_eo_pallas_packed(
                 self.gauge_eo_pp[target_parity],
                 self._u_bw[target_parity], psi_pp, tuple(self.dims),

@@ -228,6 +228,305 @@ def dslash_staggered_pallas(fat_pl: jnp.ndarray, fat_bw_pl: jnp.ndarray,
     return out.astype(odt)
 
 
+# -- v3: scatter-form backward hops (no backward-links copy) ----------------
+#
+# Same restructuring as wilson_pallas_packed v3: the backward hop
+#     -0.5 U_mu(x-n mu)^dag psi(x-n mu)  =  m(x-n mu),
+#     m(y) := -0.5 U_mu(y)^dag psi(y),
+# is computed pointwise with the ALREADY-LOADED forward links and the
+# product (3 color pairs) is shifted by -n mu — the pre-shifted
+# backward-links array (288 B/site of reads + a resident copy PER HOP
+# SET, so 576 B/site for improved staggered) disappears.  Boundary data:
+# psi z-neighbours shrink from whole (bz, YX) tiles to nhop-row blocks,
+# backward-t reads the U_t plane at t-nhop and psi at t-nhop directly,
+# and the backward-z boundary product is built from nhop-row psi/U_z
+# inputs.  Per-site traffic per pass drops from ~744 B to ~460 B.
+#
+# The nhop-row z inputs block the z axis in units of nhop, so the long
+# pass (nhop=3) needs bz % 3 == 0 (checked; `_pick_bz_v3` below).
+
+
+def _splice_z(v, rows, sign: int, nhop: int):
+    """Shift a (BZ, YX) tile by nhop rows, splicing the nhop-row block
+    ``rows`` in at the wrapping edge (sign>0: rows are the NEXT block's
+    first nhop rows; sign<0: the PREVIOUS block's last nhop rows)."""
+    out = []
+    for c, r in zip(v, rows):
+        if sign > 0:
+            out.append(jnp.concatenate([c[nhop:], r], axis=0))
+        else:
+            out.append(jnp.concatenate([r, c[:c.shape[0] - nhop]], axis=0))
+    return tuple(out)
+
+
+def _make_stag_kernel_v3(X: int, nhop: int, bz: int,
+                         eo: tuple | None = None,
+                         single_zb: bool = False):
+    """v3 hop-set pass.  Ref shapes:
+      psi_c/tp/tm:   (3, 2, 1, bz, YX)
+      psi_zp/zm:     (3, 2, 1, nhop, YX)   boundary row blocks
+      u:             (4, 3, 3, 2, 1, bz, YX)  forward links
+      u_t_tm:        (1, 3, 3, 2, 1, bz, YX)  U_t plane at t-nhop
+      u_z_zm:        (1, 3, 3, 2, 1, nhop, YX) U_z rows at z-nhop
+    With ``eo`` the backward links live on the opposite parity, carried
+    by an extra u_there_xyz ref (odd nhop: both fat and Naik hops flip
+    parity)."""
+    from jax.experimental import pallas as pl
+
+    def kernel(*refs):
+        if eo is None:
+            (psi_c, psi_tp, psi_tm, psi_zp, psi_zm,
+             u, u_t_tm, u_z_zm, out_ref) = refs
+            u_bwd = u
+        else:
+            (psi_c, psi_tp, psi_tm, psi_zp, psi_zm,
+             u, u_there_xyz, u_t_tm, u_z_zm, out_ref) = refs
+            u_bwd = u_there_xyz
+            parity, Xh = eo
+            t_id = pl.program_id(0)
+            zb_id = pl.program_id(1)
+            shape = psi_c.shape[-2:]
+            z = (jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+                 + zb_id * bz)
+            y = jax.lax.broadcasted_iota(jnp.int32, shape, 1) // Xh
+            mask_r0 = ((t_id + z + y + parity) % 2) == 0
+
+        def psi_at(ref, c):
+            return (ref[c, 0, 0].astype(F32), ref[c, 1, 0].astype(F32))
+
+        def shift_x(v, sign):
+            if eo is None:
+                return _shift_xy(v, 0, sign, X, nhop)
+            return _shift_x_eo_n(v, sign, eo[1], mask_r0, nhop)
+
+        def shift_y(v, sign):
+            return _shift_xy(v, 1, sign, X if eo is None else eo[1],
+                             nhop)
+
+        def link(ref, mu, a, b):
+            return (ref[mu, a, b, 0, 0].astype(F32),
+                    ref[mu, a, b, 1, 0].astype(F32))
+
+        acc = [(jnp.zeros(psi_c.shape[-2:], F32),
+                jnp.zeros(psi_c.shape[-2:], F32)) for _ in range(3)]
+
+        def mul(get_psi, get_link, adjoint, scale):
+            """out[a] = scale * sum_b op(U)_ab psi_b as a list of 3
+            color pairs (no accumulate)."""
+            res = []
+            for a in range(3):
+                term = None
+                for b in range(3):
+                    m = (_cmul_conj(get_link(b, a), get_psi(b))
+                         if adjoint else _cmul(get_link(a, b), get_psi(b)))
+                    term = m if term is None else _cadd(term, m)
+                res.append((scale * term[0], scale * term[1]))
+            return res
+
+        def acc_add(vals):
+            for a in range(3):
+                acc[a] = _cadd(acc[a], vals[a])
+
+        # x, y: forward = shift psi then multiply; backward = multiply
+        # with LOCAL links then shift the product
+        for mu, shifter in ((0, shift_x), (1, shift_y)):
+            acc_add(mul(lambda c: shifter(psi_at(psi_c, c), +1),
+                        lambda a, b: link(u, mu, a, b), False, 0.5))
+            m = mul(lambda c: psi_at(psi_c, c),
+                    lambda a, b: link(u_bwd, mu, a, b), True, -0.5)
+            acc_add([shifter(mc, -1) for mc in m])
+
+        # z forward: nhop-row splice of the shifted central tile (a pure
+        # in-tile roll when the block covers the whole Z axis)
+        if single_zb:
+            acc_add(mul(
+                lambda c: tuple(jnp.roll(p, -nhop, axis=0)
+                                for p in psi_at(psi_c, c)),
+                lambda a, b: link(u, 2, a, b), False, 0.5))
+            m = mul(lambda c: psi_at(psi_c, c),
+                    lambda a, b: link(u_bwd, 2, a, b), True, -0.5)
+            acc_add([tuple(jnp.roll(p, nhop, axis=0) for p in mc)
+                     for mc in m])
+        else:
+            acc_add(mul(lambda c: _splice_z(psi_at(psi_c, c),
+                                            psi_at(psi_zp, c), +1, nhop),
+                        lambda a, b: link(u, 2, a, b), False, 0.5))
+            # z backward: local product shifted down, boundary rows
+            # built from the z-nhop psi/U_z row inputs
+            m = mul(lambda c: psi_at(psi_c, c),
+                    lambda a, b: link(u_bwd, 2, a, b), True, -0.5)
+            m_b = mul(lambda c: psi_at(psi_zm, c),
+                      lambda a, b: link(u_z_zm, 0, a, b), True, -0.5)
+            acc_add([_splice_z(mc, mbc, -1, nhop)
+                     for mc, mbc in zip(m, m_b)])
+
+        # t: whole neighbour planes, no shift
+        acc_add(mul(lambda c: psi_at(psi_tp, c),
+                    lambda a, b: link(u, 3, a, b), False, 0.5))
+        acc_add(mul(lambda c: psi_at(psi_tm, c),
+                    lambda a, b: link(u_t_tm, 0, a, b), True, -0.5))
+
+        odt = out_ref.dtype
+        for c in range(3):
+            out_ref[c, 0, 0] = acc[c][0].astype(odt)
+            out_ref[c, 1, 0] = acc[c][1].astype(odt)
+
+    return kernel
+
+
+# v3 working set per pass: 3 psi tiles (6 planes) + u (72) + u_t plane
+# (18) + out (6) = 114 bz-row planes (+ tiny nhop-row inputs); the EO
+# variant carries an extra u_there_xyz ref (54 planes) -> 168
+_STAG_PLANES_V3 = 120
+_STAG_PLANES_V3_EO = 174
+
+
+def _stag_pass_v3(links_pl, psi_pl, X, nhop, bz, interpret, eo=None,
+                  links_there_pl=None):
+    from jax.experimental import pallas as pl
+
+    _, _, T, Z, YX = psi_pl.shape
+    nzb = Z // bz
+    if nzb > 1 and bz % nhop != 0:
+        raise ValueError(
+            f"block_z={bz} not a multiple of nhop={nhop}: the nhop-row "
+            "z boundary inputs must align to row-block boundaries")
+
+    def psi_spec(dt):
+        return pl.BlockSpec(
+            (3, 2, 1, bz, YX),
+            lambda t, zb, dt=dt: (0, 0, (t + dt) % T, zb, 0))
+
+    def psi_row_spec(pos):
+        # z blocked in units of nhop -> indices count nhop-row blocks.
+        # With a single z-block the kernel uses in-tile rolls and these
+        # refs are unread; pin them to block 0 (Z may not divide nhop).
+        if nzb == 1:
+            return pl.BlockSpec((3, 2, 1, nhop, YX),
+                                lambda t, zb: (0, 0, t, 0, 0))
+        if pos == "zp":
+            return pl.BlockSpec(
+                (3, 2, 1, nhop, YX),
+                lambda t, zb: (0, 0, t, ((zb + 1) * bz // nhop) % (Z // nhop),
+                               0))
+        return pl.BlockSpec(
+            (3, 2, 1, nhop, YX),
+            lambda t, zb: (0, 0, t, (zb * bz // nhop - 1) % (Z // nhop), 0))
+
+    links_spec = pl.BlockSpec(
+        (4, 3, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+    links_xyz_spec = pl.BlockSpec(
+        (3, 3, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+    u_t_spec = pl.BlockSpec(
+        (1, 3, 3, 2, 1, bz, YX),
+        lambda t, zb: (3, 0, 0, 0, (t - nhop) % T, zb, 0))
+    if nzb == 1:
+        u_z_spec = pl.BlockSpec((1, 3, 3, 2, 1, nhop, YX),
+                                lambda t, zb: (2, 0, 0, 0, t, 0, 0))
+    else:
+        u_z_spec = pl.BlockSpec(
+            (1, 3, 3, 2, 1, nhop, YX),
+            lambda t, zb: (2, 0, 0, 0, t, (zb * bz // nhop - 1) % (Z // nhop),
+                           0))
+
+    bwd_src = links_pl if links_there_pl is None else links_there_pl
+    in_specs = [psi_spec(0), psi_spec(+nhop), psi_spec(-nhop),
+                psi_row_spec("zp"), psi_row_spec("zm"), links_spec]
+    args = [psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, links_pl]
+    if links_there_pl is not None:
+        in_specs.append(links_xyz_spec)
+        args.append(links_there_pl)
+    in_specs += [u_t_spec, u_z_spec]
+    args += [bwd_src, bwd_src]
+
+    return pl.pallas_call(
+        _make_stag_kernel_v3(X, nhop, bz, eo, single_zb=(nzb == 1)),
+        grid=(T, nzb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((3, 2, 1, bz, YX),
+                               lambda t, zb: (0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape, jnp.float32),
+        interpret=interpret,
+    )(*args)
+
+
+def _pick_bz_v3(Z, YX, dtype, with_long: bool, eo: bool = False):
+    """z-block for the v3 passes: multiple of 3 when the Naik pass runs
+    (so its 3-row boundary inputs align to block boundaries)."""
+    planes = _STAG_PLANES_V3_EO if eo else _STAG_PLANES_V3
+    bz = _pick_bz(Z, YX, dtype, planes=planes,
+                  min_bz=3 if (with_long and Z > 3) else 1)
+    if with_long and bz != Z and bz % 3 != 0:
+        # Naik boundary inputs need bz % 3 == 0 (or a single z-block)
+        cands = [d for d in range(3, bz + 1)
+                 if Z % d == 0 and d % 3 == 0]
+        if cands:
+            bz = max(cands)
+        else:
+            # fall back to the whole-Z block; _pick_bz re-checks VMEM
+            bz = _pick_bz(Z, YX, dtype, planes=planes, min_bz=Z)
+    return bz
+
+
+@functools.partial(jax.jit, static_argnames=("X", "interpret", "block_z",
+                                             "out_dtype"))
+def dslash_staggered_pallas_v3(fat_pl: jnp.ndarray, psi_pl: jnp.ndarray,
+                               X: int, long_pl: jnp.ndarray = None,
+                               interpret: bool = False,
+                               block_z: int | None = None,
+                               out_dtype=None) -> jnp.ndarray:
+    """Staggered / improved-staggered D psi, v3: scatter-form backward
+    hops — no ``backward_links`` precompute or resident copies (saves
+    576 B/site of HBM reads for the improved operator)."""
+    _, _, _, Z, YX = psi_pl.shape
+    if block_z is not None:
+        bz = block_z
+        if Z % bz != 0:
+            raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    else:
+        bz = _pick_bz_v3(Z, YX, psi_pl.dtype, long_pl is not None)
+
+    out = _stag_pass_v3(fat_pl, psi_pl, X, 1, bz, interpret)
+    if long_pl is not None:
+        out = out + _stag_pass_v3(long_pl, psi_pl, X, 3, bz, interpret)
+    odt = out_dtype or psi_pl.dtype
+    return out.astype(odt)
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "target_parity",
+                                             "interpret", "block_z",
+                                             "out_dtype"))
+def dslash_staggered_eo_pallas_v3(fat_here_pl, fat_there_pl, psi_pl, dims,
+                                  target_parity: int,
+                                  long_here_pl=None, long_there_pl=None,
+                                  interpret: bool = False,
+                                  block_z: int | None = None,
+                                  out_dtype=None) -> jnp.ndarray:
+    """Checkerboarded v3 staggered hop: backward hops read the UNSHIFTED
+    opposite-parity links (both hop sets flip parity — odd nhop), so no
+    ``backward_links_eo`` copies are kept resident."""
+    T, Z, Y, X = dims
+    Xh = X // 2
+    _, _, _, _, YXh = psi_pl.shape
+    if block_z is not None:
+        bz = block_z
+        if Z % bz != 0:
+            raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    else:
+        bz = _pick_bz_v3(Z, YXh, psi_pl.dtype, long_here_pl is not None,
+                         eo=True)
+
+    eo = (target_parity, Xh)
+    out = _stag_pass_v3(fat_here_pl, psi_pl, X, 1, bz, interpret, eo,
+                        links_there_pl=fat_there_pl)
+    if long_here_pl is not None:
+        out = out + _stag_pass_v3(long_here_pl, psi_pl, X, 3, bz,
+                                  interpret, eo,
+                                  links_there_pl=long_there_pl)
+    odt = out_dtype or psi_pl.dtype
+    return out.astype(odt)
+
+
 # -- even/odd (checkerboarded) variant: the staggered CG hot path -----------
 
 def backward_links_eo(u_there_pl: jnp.ndarray, dims, target_parity: int,

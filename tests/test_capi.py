@@ -57,19 +57,19 @@ def test_fortran_abi(libpath):
     lib = ctypes.CDLL(libpath)
     byref, c_int, c_double = ctypes.byref, ctypes.c_int, ctypes.c_double
 
-    lib.init_quda_(byref(c_int(0)))
+    lib.qtpu_init_quda_(byref(c_int(0)))
 
     L = 4
     vol = L ** 4
     links = np.zeros((4, L, L, L, L, 3, 3), dtype=np.complex128)
     links[..., 0, 0] = links[..., 1, 1] = links[..., 2, 2] = 1.0
     X = (c_int * 4)(L, L, L, L)
-    lib.load_gauge_quda_(
+    lib.qtpu_load_gauge_quda_(
         links.ctypes.data_as(ctypes.POINTER(c_double)), X,
         byref(c_int(1)))
 
     plaq = (c_double * 3)()
-    lib.plaq_quda_(plaq)
+    lib.qtpu_plaq_quda_(plaq)
     assert abs(plaq[0] - 1.0) < 1e-12
 
     rng = np.random.default_rng(0)
@@ -78,7 +78,7 @@ def test_fortran_abi(libpath):
     x = np.zeros_like(b)
     true_res, secs = c_double(0.0), c_double(0.0)
     iters = c_int(0)
-    lib.invert_quda_(
+    lib.qtpu_invert_quda_(
         x.ctypes.data_as(ctypes.POINTER(c_double)),
         b.ctypes.data_as(ctypes.POINTER(c_double)),
         byref(c_int(0)),            # dslash: wilson

@@ -84,6 +84,81 @@ def test_staggered_pallas_small_z_periodic():
     assert err < 1e-6
 
 
+@pytest.mark.parametrize("with_long", [False, True])
+@pytest.mark.parametrize("bz", [None, 3])
+def test_staggered_pallas_v3_matches_pairs(with_long, bz):
+    """Round-3 kernel (scatter-form backward hops, no backward-links
+    copies) == the pair-form XLA stencil (interpret mode)."""
+    geom, fat_p, long_p, psi_p = _setup(jax.random.PRNGKey(6), (4, 6, 6, 4))
+    T, Z, Y, X = geom.lattice_shape
+    fat_pp = to_packed_pairs(fat_p, jnp.float32)
+    long_pp = to_packed_pairs(long_p, jnp.float32) if with_long else None
+    psi_pp = to_packed_pairs(psi_p, jnp.float32)
+    ref = spk.dslash_staggered_packed_pairs(fat_pp, psi_pp, X, Y, long_pp)
+    out = spl.dslash_staggered_pallas_v3(fat_pp, psi_pp, X,
+                                         long_pl=long_pp,
+                                         interpret=True, block_z=bz)
+    err = float(jnp.sqrt(
+        blas.norm2(ref.astype(jnp.float32) - out.astype(jnp.float32))
+        / blas.norm2(ref.astype(jnp.float32))))
+    assert err < 1e-6
+
+
+def test_staggered_pallas_v3_small_z_periodic():
+    """v3 with nzb == 1 and Z % 3 != 0: the 3-hop z boundary inputs are
+    bypassed for in-tile periodic rolls."""
+    geom, fat_p, long_p, psi_p = _setup(jax.random.PRNGKey(7), (4, 4, 4, 4))
+    T, Z, Y, X = geom.lattice_shape
+    fat_pp = to_packed_pairs(fat_p, jnp.float32)
+    long_pp = to_packed_pairs(long_p, jnp.float32)
+    psi_pp = to_packed_pairs(psi_p, jnp.float32)
+    ref = spk.dslash_staggered_packed_pairs(fat_pp, psi_pp, X, Y, long_pp)
+    out = spl.dslash_staggered_pallas_v3(fat_pp, psi_pp, X, long_pl=long_pp,
+                                         interpret=True, block_z=Z)
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-6
+
+
+@pytest.mark.parametrize("parity", [0, 1])
+@pytest.mark.parametrize("improved,bz", [(False, None), (True, 3)])
+def test_staggered_eo_pallas_v3_matches_pairs(parity, improved, bz):
+    """Round-3 EO staggered kernel: backward hops read the UNSHIFTED
+    opposite-parity links — must match the eo pair stencil."""
+    from quda_tpu.fields.spinor import even_odd_split
+    from quda_tpu.ops.wilson import split_gauge_eo
+
+    geom = LatticeGeometry((4, 6, 6, 4))
+    T, Z, Y, X = geom.lattice_shape
+    dims = (T, Z, Y, X)
+    key = jax.random.PRNGKey(8)
+    k1, k2, k3 = jax.random.split(key, 3)
+    fat = GaugeField.random(k1, geom).data.astype(jnp.complex64)
+    lng = GaugeField.random(k2, geom).data.astype(jnp.complex64)
+    psi = (jax.random.normal(k3, (T, Z, Y, X, 1, 3), jnp.float32)
+           + 1j * jax.random.normal(jax.random.fold_in(k3, 1),
+                                    (T, Z, Y, X, 1, 3), jnp.float32)
+           ).astype(jnp.complex64)
+    fat_eo = split_gauge_eo(fat, geom)
+    long_eo = split_gauge_eo(lng, geom) if improved else None
+    pe, po = even_odd_split(psi, geom)
+    src = pe if parity == 1 else po
+
+    fat_eo_pp = tuple(to_packed_pairs(spk.pack_links(g), jnp.float32)
+                      for g in fat_eo)
+    long_eo_pp = (tuple(to_packed_pairs(spk.pack_links(g), jnp.float32)
+                        for g in long_eo) if improved else None)
+    src_pp = to_packed_pairs(spk.pack_staggered(src), jnp.float32)
+    ref = spk.dslash_staggered_eo_packed_pairs(
+        fat_eo_pp, src_pp, dims, parity, long_eo_pp)
+    out = spl.dslash_staggered_eo_pallas_v3(
+        fat_eo_pp[parity], fat_eo_pp[1 - parity], src_pp, dims, parity,
+        long_here_pl=long_eo_pp[parity] if improved else None,
+        long_there_pl=long_eo_pp[1 - parity] if improved else None,
+        interpret=True, block_z=bz)
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-6
+
+
 @pytest.mark.parametrize("parity", [0, 1])
 @pytest.mark.parametrize("improved", [False, True])
 def test_staggered_eo_pairs_matches_canonical(parity, improved):
