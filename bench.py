@@ -57,6 +57,13 @@ LAST_TPU_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_TPU_LAST.json")
 
 
+def _conf(name):
+    """Benchmark knobs go through the central registry
+    (quda_tpu.utils.config) — one source of truth for defaults/docs."""
+    from quda_tpu.utils import config as qconf
+    return qconf.get(name, fresh=True)
+
+
 def _probe_subprocess() -> dict:
     """Probe platform + complex64 execution support in a child process
     (a failed complex op can wedge the backend, and device init can hang
@@ -82,8 +89,7 @@ print(json.dumps(out))
     try:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                            text=True, timeout=float(
-                               os.environ.get("QUDA_TPU_BENCH_PROBE_S",
-                                              "300")))
+                               _conf("QUDA_TPU_BENCH_PROBE_S")))
         for line in r.stdout.splitlines():
             line = line.strip()
             if line.startswith("{"):
@@ -123,7 +129,7 @@ def _time_marginal(make_chain, args, n1: int, n2: int, reps: int):
 
 
 def main():
-    force_cpu = bool(os.environ.get("QUDA_TPU_BENCH_CPU"))
+    force_cpu = _conf("QUDA_TPU_BENCH_CPU")
     if force_cpu:
         # everything below runs on the CPU backend; don't probe the TPU
         # (its answer would misattribute the platform of the timings)
@@ -137,9 +143,8 @@ def main():
         if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
             attempts = 1
         else:
-            attempts = int(os.environ.get("QUDA_TPU_BENCH_PROBE_RETRIES",
-                                          "5"))
-        wait_s = float(os.environ.get("QUDA_TPU_BENCH_PROBE_WAIT_S", "90"))
+            attempts = _conf("QUDA_TPU_BENCH_PROBE_RETRIES")
+        wait_s = _conf("QUDA_TPU_BENCH_PROBE_WAIT_S")
         probe = {}
         for i in range(max(attempts, 1)):
             probe = _probe_subprocess()
@@ -165,8 +170,7 @@ def main():
     from quda_tpu.ops import wilson as wops
     from quda_tpu.ops import wilson_packed as wpk
 
-    L = int(os.environ.get("QUDA_TPU_BENCH_L",
-                           "24" if platform != "cpu" else "8"))
+    L = _conf("QUDA_TPU_BENCH_L") or (24 if platform != "cpu" else 8)
     T = Z = Y = X = L
     rng = np.random.default_rng(0)
 
@@ -223,9 +227,9 @@ def main():
     # chain spread sets the timing SNR: the marginal difference must be
     # large against the tunnel's per-call RPC noise (~5-10 ms), so the
     # long chain is ~200 applications (~50 ms of real dslash work).
-    n1 = int(os.environ.get("QUDA_TPU_BENCH_N1", "8"))
-    n2 = int(os.environ.get("QUDA_TPU_BENCH_N2", "200"))
-    reps = int(os.environ.get("QUDA_TPU_BENCH_REPS", "5"))
+    n1 = _conf("QUDA_TPU_BENCH_N1")
+    n2 = _conf("QUDA_TPU_BENCH_N2")
+    reps = _conf("QUDA_TPU_BENCH_REPS")
     flops = 1320 * (L ** 4)
 
     def chain_of(fn):

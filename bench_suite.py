@@ -25,7 +25,7 @@ import json
 import sys
 import time
 
-from bench import _fetch, _probe_subprocess, _time_marginal
+from bench import _conf, _fetch, _probe_subprocess, _time_marginal
 
 
 def _emit(suite, name, secs, flops, bytes_, platform, lattice, **extra):
@@ -112,7 +112,7 @@ def _bench_fused_reduce(fn, arg, consts=(), n1=8, n2=200, reps=3):
 def main(argv):
     import os
 
-    force_cpu = bool(os.environ.get("QUDA_TPU_BENCH_CPU"))
+    force_cpu = _conf("QUDA_TPU_BENCH_CPU")
     if force_cpu:
         probe = {"platform": "cpu", "complex_ok": True}
     else:
@@ -137,8 +137,7 @@ def main(argv):
     from quda_tpu.fields.geometry import LatticeGeometry
     from quda_tpu.ops import wilson_packed as wpk
 
-    L = int(os.environ.get("QUDA_TPU_BENCH_L",
-                           "24" if platform != "cpu" else "8"))
+    L = _conf("QUDA_TPU_BENCH_L") or (24 if platform != "cpu" else 8)
     T = Z = Y = X = L
     geom = LatticeGeometry((L, L, L, L))
     lat = geom.lattice_shape
@@ -296,7 +295,7 @@ def main(argv):
                                             pair_inplace_codec)
 
         # solver lattice: 16^4 (BASELINE config 2's size)
-        Ls = int(os.environ.get("QUDA_TPU_BENCH_SOLVER_L", "16"))
+        Ls = _conf("QUDA_TPU_BENCH_SOLVER_L")
         geo_s = LatticeGeometry((Ls, Ls, Ls, Ls))
         # SU(3)-projected links (QR per site): a physical, convergent
         # operator — raw gaussian links are not unitary and stall CG.

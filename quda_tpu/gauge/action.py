@@ -110,6 +110,23 @@ def update_gauge(gauge: jnp.ndarray, p: jnp.ndarray,
     return mat_mul(expm_su3(eps * p), gauge)
 
 
+def _force_monitor(f: jnp.ndarray, label: str):
+    """QUDA_TPU_ENABLE_FORCE_MONITOR: log per-kick force norms
+    (reference: QUDA_ENABLE_FORCE_MONITOR in lib/momentum.cu —
+    forceRecord prints the max/L2 force per update).  Inactive under
+    jit tracing (no host values there)."""
+    from ..utils import config as qconf
+    from ..utils import logging as qlog
+    if not qconf.get("QUDA_TPU_ENABLE_FORCE_MONITOR", fresh=True):
+        return
+    if isinstance(f, jax.core.Tracer):
+        return
+    site2 = jnp.sum(jnp.abs(f) ** 2, axis=(-2, -1))
+    qlog.printq(f"force {label}: max {float(jnp.max(site2)) ** 0.5:.6e} "
+                f"rms {float(jnp.mean(site2)) ** 0.5:.6e}",
+                qlog.SUMMARIZE)
+
+
 # -- integrators / HMC -----------------------------------------------------
 
 class HMCResult(NamedTuple):
@@ -122,10 +139,12 @@ class HMCResult(NamedTuple):
 def leapfrog(action_fn, gauge, p, n_steps: int, dt: float):
     """Standard leapfrog: half-kick, n drifts/kicks, half-kick."""
     f = gauge_force(action_fn, gauge)
+    _force_monitor(f, "leapfrog kick 0")
     p = p - (0.5 * dt) * f
     for i in range(n_steps):
         gauge = update_gauge(gauge, p, dt)
         f = gauge_force(action_fn, gauge)
+        _force_monitor(f, f"leapfrog kick {i + 1}")
         p = p - (dt if i < n_steps - 1 else 0.5 * dt) * f
     return gauge, p
 

@@ -39,8 +39,27 @@ _ctx = {
 
 def init_quda(device: int = 0):
     """initQuda analog (device selection is PJRT's job on TPU)."""
+    from ..utils import config as qconf
+    from ..utils import monitor as qmon
+    qconf.check_environment()  # warn on typoed / CUDA-era env knobs
+    qmon.start_default()       # QUDA_TPU_ENABLE_MONITOR sampling thread
     _ctx["initialized"] = True
     qlog.printq("initialized", qlog.VERBOSE)
+
+
+def _packed_enabled(on_tpu: bool) -> bool:
+    """QUDA_TPU_PACKED override, else the platform default (packed
+    device order on TPU)."""
+    from ..utils import config as qconf
+    v = qconf.get("QUDA_TPU_PACKED", fresh=True)
+    return on_tpu if v == "" else v == "1"
+
+
+def _pallas_enabled(on_tpu: bool) -> bool:
+    """QUDA_TPU_PALLAS override, else pallas on real TPU."""
+    from ..utils import config as qconf
+    v = qconf.get("QUDA_TPU_PALLAS", fresh=True)
+    return on_tpu if v == "" else v == "1"
 
 
 def end_quda():
@@ -53,6 +72,8 @@ def end_quda():
         _ctx[k] = None if k != "initialized" else False
     _ctx["gauge_epoch"] = keep_epoch
     _ctx["mg_epoch"] = -1
+    from ..utils import monitor as qmon
+    qmon.stop_default()
     from ..utils.timer import print_summary
     print_summary()
 
@@ -221,6 +242,12 @@ def _resolve_sloppy(param: InvertParam) -> str:
     (including sloppy == prec for a pure-precision solve) is honored."""
     if param.cuda_prec_sloppy != "auto":
         return param.cuda_prec_sloppy
+    from ..utils import config as qconf
+    env = qconf.get("QUDA_TPU_SLOPPY_PRECISION", fresh=True)
+    if env:
+        qlog.printq(f"cuda_prec_sloppy=auto -> {env} "
+                    "(QUDA_TPU_SLOPPY_PRECISION)", qlog.VERBOSE)
+        return env
     if jax.default_backend() == "tpu":
         qlog.printq("cuda_prec_sloppy=auto -> half (bf16) on TPU",
                     qlog.VERBOSE)
@@ -307,9 +334,7 @@ def invert_quda(source, param: InvertParam):
     # sloppy levels: a lower complex dtype (double->single, CPU only) and
     # bf16/int8 pair storage ("half"/"quarter" — ops/pair.py).
     sloppy_prec = _resolve_sloppy(param)
-    import os
     on_tpu = jax.default_backend() == "tpu"
-    packed_default = "1" if on_tpu else "0"
     # complex-free staggered pair adapter: CG-family solves only (its
     # coefficients are real on the Hermitian PC operator, so the pair
     # representation is exact; bicgstab/gcr would feed pair residuals
@@ -321,8 +346,7 @@ def invert_quda(source, param: InvertParam):
                   and param.inv_type in ("cg", "pcg", "cg3", "cgne",
                                          "cgnr")
                   and (param.cuda_prec == "single" or on_tpu)
-                  and os.environ.get("QUDA_TPU_PACKED",
-                                     packed_default) == "1")
+                  and _packed_enabled(on_tpu))
     pair_sloppy = (sloppy_prec in ("half", "quarter")
                    and ((param.dslash_type == "wilson" and pc)
                         or stag_pairs))
@@ -342,7 +366,7 @@ def invert_quda(source, param: InvertParam):
     # consume packed iterates) and for 'quarter' (the int8 gauge codec
     # lives on the canonical layout).
     if (param.dslash_type == "wilson" and pc
-            and os.environ.get("QUDA_TPU_PACKED", packed_default) == "1"
+            and _packed_enabled(on_tpu)
             and not (mixed and dtype_sloppy and not pair_sloppy)
             and sloppy_prec != "quarter"):
         d = d.packed()
@@ -350,7 +374,7 @@ def invert_quda(source, param: InvertParam):
         # complex-free staggered solve loop (pair representation end to
         # end; the pallas eo stencil on real TPU).  'quarter' storage has
         # no staggered int8 codec — the sloppy op falls back to bf16.
-        d = _StaggeredPairsSolve(d, jax.default_backend() == "tpu")
+        d = _StaggeredPairsSolve(d, _pallas_enabled(on_tpu))
 
     if pc:
         be, bo = _split(b, param, d)
@@ -585,18 +609,16 @@ def invert_multishift_quda(source, param: InvertParam):
     d = _build_dirac(param, True)
     be, bo = _split(b, param, d)
 
-    import os
     on_tpu = jax.default_backend() == "tpu"
-    packed_default = "1" if on_tpu else "0"
     if (param.dslash_type in ("staggered", "asqtad", "hisq")
             and (param.cuda_prec == "single" or on_tpu)
-            and os.environ.get("QUDA_TPU_PACKED", packed_default) == "1"):
+            and _packed_enabled(on_tpu)):
         # complex-free multishift (the RHMC rational-force hot path):
         # shared-Krylov solve entirely on pair arrays (CG coefficients
         # on the Hermitian PC operator are real, so the pair
         # representation is exact), pallas eo stencil on real TPU
         t0 = time.perf_counter()
-        ad = _StaggeredPairsSolve(d, jax.default_backend() == "tpu")
+        ad = _StaggeredPairsSolve(d, _pallas_enabled(on_tpu))
         rhs_pp = ad.prepare(be, bo)
         res = multishift_cg(ad.M, rhs_pp, tuple(param.offset),
                             tol=param.tol, maxiter=param.maxiter)

@@ -130,7 +130,7 @@ class DiracStaggeredPC(DiracPC):
 
     def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
               pallas_interpret: bool = False,
-              pallas_version: int = 3) -> "DiracStaggeredPCPairs":
+              pallas_version: int | None = None) -> "DiracStaggeredPCPairs":
         """Complex-free packed companion (f32 = the precise TPU solve
         path; bf16 = the sloppy operator); see DiracStaggeredPCPairs."""
         return DiracStaggeredPCPairs(self, store_dtype, use_pallas,
@@ -157,7 +157,7 @@ class DiracStaggeredPCPairs:
 
     def __init__(self, dpc: DiracStaggeredPC, store_dtype=jnp.float32,
                  use_pallas: bool = False, pallas_interpret: bool = False,
-                 pallas_version: int = 3):
+                 pallas_version: int | None = None):
         from ..ops import staggered_packed as spk
         from ..ops.wilson_packed import to_packed_pairs
         self.geom = dpc.geom
@@ -173,6 +173,10 @@ class DiracStaggeredPCPairs:
             for g in dpc.long_eo) if dpc.long_eo is not None else None)
         self.use_pallas = use_pallas
         self._pallas_interpret = pallas_interpret
+        if pallas_version is None:
+            from ..utils import config as qconf
+            pallas_version = qconf.get("QUDA_TPU_PALLAS_VERSION",
+                                       fresh=True)
         if pallas_version not in (2, 3):
             raise ValueError(f"pallas_version must be 2 or 3, got "
                              f"{pallas_version}")

@@ -238,7 +238,7 @@ class DiracWilsonPCPacked:
 
     def pairs(self, store_dtype=jnp.bfloat16, use_pallas: bool = False,
               pallas_interpret: bool = False,
-              pallas_version: int = 3) -> "DiracWilsonPCPackedSloppy":
+              pallas_version: int | None = None) -> "DiracWilsonPCPackedSloppy":
         """Pair-storage companion at an arbitrary storage dtype.
 
         With f32 storage this is the PRECISE operator in a fully
@@ -270,7 +270,7 @@ class DiracWilsonPCPackedSloppy(_PairSloppyBase):
 
     def __init__(self, dpk: "DiracWilsonPCPacked", store_dtype=jnp.bfloat16,
                  use_pallas: bool = False, pallas_interpret: bool = False,
-                 pallas_version: int = 3):
+                 pallas_version: int | None = None):
         from ..ops import wilson_packed as wpk
         self.geom = dpk.geom
         self.kappa = float(dpk.kappa)
@@ -281,6 +281,10 @@ class DiracWilsonPCPackedSloppy(_PairSloppyBase):
             wpk.to_packed_pairs(g, store_dtype) for g in dpk.gauge_eo_p)
         self.use_pallas = use_pallas
         self._pallas_interpret = pallas_interpret
+        if pallas_version is None:
+            from ..utils import config as qconf
+            pallas_version = qconf.get("QUDA_TPU_PALLAS_VERSION",
+                                       fresh=True)
         if pallas_version not in (2, 3):
             raise ValueError(f"pallas_version must be 2 or 3, got "
                              f"{pallas_version}")

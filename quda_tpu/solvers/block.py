@@ -23,10 +23,23 @@ from ..ops import blas
 from .cg import SolverResult
 
 
+def _check_nrhs(n: int):
+    """QUDA_TPU_MAX_MULTI_RHS cap (reference: QUDA_MAX_MULTI_RHS, a
+    compile-time instantiation bound there; a guard against
+    accidentally batching past device memory here)."""
+    from ..utils import config as qconf
+    cap = qconf.get("QUDA_TPU_MAX_MULTI_RHS", fresh=True)
+    if n > cap:
+        raise ValueError(
+            f"{n} right-hand sides exceeds QUDA_TPU_MAX_MULTI_RHS={cap}; "
+            "raise the knob or chunk the sources")
+
+
 def batched_cg(matvec: Callable, B: jnp.ndarray, tol: float = 1e-10,
                maxiter: int = 1000) -> SolverResult:
     """vmapped CG over a leading RHS axis; iterates until ALL converge."""
     from .cg import cg
+    _check_nrhs(B.shape[0])
     return jax.vmap(lambda b: cg(matvec, b, tol=tol, maxiter=maxiter))(B)
 
 
@@ -46,6 +59,7 @@ def block_cg(matvec: Callable, B: jnp.ndarray, tol: float = 1e-10,
     fewer iterations than independent CG.
     """
     n = B.shape[0]
+    _check_nrhs(n)
     b2 = jax.vmap(blas.norm2)(B)
     stop = (tol ** 2) * b2
     cdt = B.dtype

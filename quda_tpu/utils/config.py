@@ -1,0 +1,267 @@
+"""Central environment-flag registry — the QUDA_* config system analog.
+
+Reference behavior: the reference scatters ~40 ``getenv("QUDA_...")``
+calls across tune.cpp, malloc.cpp, monitor.cpp, util_quda.cpp,
+milc_interface.cpp, dslash_policy.hpp etc. (e.g. QUDA_ENABLE_TUNING,
+QUDA_RESOURCE_PATH, QUDA_ENABLE_MONITOR, QUDA_DETERMINISTIC_REDUCE,
+QUDA_MAX_MULTI_RHS, QUDA_ENABLE_DEVICE_MEMORY_POOL).  This module is the
+single TPU-native home for that surface:
+
+* every knob is REGISTERED with a type, default, and doc string;
+* reads go through typed accessors (`flag`, `intval`, `strval`) with
+  caching and validation;
+* ``describe()`` prints the full table (the analog of the reference's
+  documented env list);
+* ``check_environment()`` warns about unrecognised ``QUDA_TPU_*``
+  variables — a typoed knob silently doing nothing is the worst failure
+  mode of env-var config (fail-fast model, SURVEY §5.6).
+
+CUDA-specific knobs with no TPU meaning (memory pools, MPS, GDR,
+NVSHMEM, peer-to-peer) are intentionally NOT accepted: XLA/PJRT owns
+allocation and collectives.  They are listed in ``SUBSUMED`` with the
+subsystem that replaces them so ``describe()`` can answer "where did
+QUDA_ENABLE_DEVICE_MEMORY_POOL go?".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+_PREFIX = "QUDA_TPU_"
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str                 # full env-var name
+    kind: str                 # "bool" | "int" | "float" | "str" | "choice"
+    default: object
+    doc: str
+    choices: tuple = ()
+    reference: str = ""       # the reference knob this replaces
+
+
+_REGISTRY: dict[str, Knob] = {}
+
+
+def _register(name, kind, default, doc, choices=(), reference=""):
+    _REGISTRY[name] = Knob(name, kind, default, doc, tuple(choices),
+                           reference)
+
+
+# -- logging / verbosity ----------------------------------------------------
+_register("QUDA_TPU_VERBOSITY", "choice", "summarize",
+          "global log verbosity", ("silent", "summarize", "verbose",
+                                   "debug"), "QUDA_VERBOSITY (setVerbosity)")
+_register("QUDA_TPU_RANK_VERBOSITY", "str", "0",
+          "which process indices print ('all' or a rank number)",
+          reference="QUDA_RANK_VERBOSITY")
+_register("QUDA_TPU_PROCESS_INDEX", "int", 0,
+          "this process's index for rank-gated printing",
+          reference="comm rank")
+
+# -- autotuner --------------------------------------------------------------
+_register("QUDA_TPU_ENABLE_TUNING", "bool", True,
+          "enable the implementation-choice autotuner",
+          reference="QUDA_ENABLE_TUNING")
+_register("QUDA_TPU_RESOURCE_PATH", "str", "",
+          "directory for tunecache.json and profile output",
+          reference="QUDA_RESOURCE_PATH")
+_register("QUDA_TPU_TUNE_VERSION_CHECK", "bool", True,
+          "invalidate tunecache entries recorded by a different "
+          "jax/backend version", reference="QUDA_TUNE_VERSION_CHECK")
+
+# -- dslash implementation selection ---------------------------------------
+_register("QUDA_TPU_PACKED", "str", "",
+          "force ('1') or forbid ('0') the TPU-native packed device "
+          "order in API solves; empty = platform default (on for TPU)",
+          reference="native FloatN field orders")
+_register("QUDA_TPU_PALLAS", "str", "",
+          "force ('1') or forbid ('0') pallas dslash kernels in API "
+          "solves; empty = autotuned choice",
+          reference="QUDA_ENABLE_DSLASH_POLICY")
+_register("QUDA_TPU_PALLAS_VERSION", "int", 3,
+          "pallas kernel generation: 3 = scatter-form backward hops "
+          "(no backward-link copies), 2 = gather kernels with "
+          "pre-shifted backward links",
+          reference="dslash policy selection")
+_register("QUDA_TPU_SLOPPY_PRECISION", "choice", "",
+          "override cuda_prec_sloppy='auto' resolution",
+          ("", "single", "half", "quarter"),
+          reference="QudaInvertParam::cuda_prec_sloppy")
+
+# -- solvers ----------------------------------------------------------------
+_register("QUDA_TPU_MAX_MULTI_RHS", "int", 32,
+          "cap on simultaneously batched right-hand sides in block "
+          "solvers", reference="QUDA_MAX_MULTI_RHS")
+_register("QUDA_TPU_DETERMINISTIC_REDUCE", "bool", True,
+          "accepted for compatibility: XLA reductions are deterministic "
+          "per compiled executable already",
+          reference="QUDA_DETERMINISTIC_REDUCE")
+
+# -- monitoring / profiling -------------------------------------------------
+_register("QUDA_TPU_ENABLE_MONITOR", "bool", False,
+          "periodically sample device/host memory into the monitor log",
+          reference="QUDA_ENABLE_MONITOR")
+_register("QUDA_TPU_MONITOR_PERIOD", "float", 1.0,
+          "monitor sampling period in seconds",
+          reference="QUDA_ENABLE_MONITOR_PERIOD")
+_register("QUDA_TPU_PROFILE_OUTPUT_BASE", "str", "profile",
+          "basename for timer/profile dumps under the resource path",
+          reference="QUDA_PROFILE_OUTPUT_BASE")
+_register("QUDA_TPU_DO_NOT_PROFILE", "bool", False,
+          "disable the global TimeProfile accumulation",
+          reference="QUDA_DO_NOT_PROFILE")
+_register("QUDA_TPU_ENABLE_FORCE_MONITOR", "bool", False,
+          "log per-step force norms during HMC momentum updates",
+          reference="QUDA_ENABLE_FORCE_MONITOR")
+
+# -- benchmark harness (bench.py / bench_suite.py) --------------------------
+for _n, _k, _d, _doc in (
+        ("QUDA_TPU_BENCH_CPU", "bool", False,
+         "force the benchmark onto the CPU backend"),
+        ("QUDA_TPU_BENCH_L", "int", 0,
+         "benchmark lattice extent (0 = platform default)"),
+        ("QUDA_TPU_BENCH_N1", "int", 8, "short timing-chain length"),
+        ("QUDA_TPU_BENCH_N2", "int", 200, "long timing-chain length"),
+        ("QUDA_TPU_BENCH_REPS", "int", 5, "timing repetitions"),
+        ("QUDA_TPU_BENCH_PROBE_S", "float", 300.0,
+         "TPU probe subprocess timeout (seconds)"),
+        ("QUDA_TPU_BENCH_PROBE_RETRIES", "int", 5,
+         "TPU probe attempts before CPU fallback"),
+        ("QUDA_TPU_BENCH_PROBE_WAIT_S", "float", 90.0,
+         "wait between TPU probe attempts (seconds)"),
+        ("QUDA_TPU_BENCH_SOLVER_L", "int", 16,
+         "solver-suite lattice extent")):
+    _register(_n, _k, _d, _doc, reference="tests/ benchmark CLI flags")
+
+_register("QUDA_TPU_FORCE_CPU", "bool", False,
+          "pin the CPU backend (and enable x64) in the embedded C-API "
+          "interpreter", reference="QUDA_CPU_FIELD_LOCATION-style hosts")
+
+# CUDA-runtime knobs deliberately not carried over: the replacing
+# subsystem answers "where did it go".
+SUBSUMED = {
+    "QUDA_ENABLE_DEVICE_MEMORY_POOL": "XLA/PJRT allocator",
+    "QUDA_ENABLE_PINNED_MEMORY_POOL": "XLA/PJRT allocator",
+    "QUDA_ENABLE_MANAGED_MEMORY": "XLA/PJRT allocator",
+    "QUDA_ENABLE_MANAGED_PREFETCH": "XLA/PJRT allocator",
+    "QUDA_ENABLE_P2P": "XLA collectives over ICI",
+    "QUDA_ENABLE_GDR": "XLA collectives over ICI",
+    "QUDA_ENABLE_GDR_BLACKLIST": "XLA collectives over ICI",
+    "QUDA_ENABLE_NVSHMEM": "GSPMD collective-permute halo path",
+    "QUDA_ENABLE_MPS": "single-process PJRT runtime",
+    "QUDA_ENABLE_ZERO_COPY": "device_put / donation semantics",
+    "QUDA_REORDER_LOCATION": "host<->device packing in fields/",
+    "QUDA_ENABLE_DSLASH_POLICY": "QUDA_TPU_PALLAS + utils.tune",
+    "QUDA_ALLOW_JIT": "jit is the only execution model",
+    "QUDA_DEVICE_RESET": "PJRT owns device lifetime",
+}
+
+_cache: dict[str, object] = {}
+
+
+def _parse(knob: Knob, raw: str):
+    if knob.kind == "bool":
+        if raw.lower() in ("1", "true", "yes", "on"):
+            return True
+        if raw.lower() in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"{knob.name}={raw!r} is not a boolean "
+                         "(use 0/1)")
+    if knob.kind == "int":
+        return int(raw)
+    if knob.kind == "float":
+        return float(raw)
+    if knob.kind == "choice":
+        if raw not in knob.choices:
+            raise ValueError(f"{knob.name}={raw!r} not in "
+                             f"{knob.choices}")
+        return raw
+    return raw
+
+
+def get(name: str, *, fresh: bool = False):
+    """Typed value of a registered knob (env override or default)."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unregistered config knob {name!r}; "
+                       f"known: {sorted(_REGISTRY)}")
+    if not fresh and name in _cache:
+        return _cache[name]
+    knob = _REGISTRY[name]
+    raw = os.environ.get(name)
+    val = knob.default if raw is None or raw == "" else _parse(knob, raw)
+    _cache[name] = val
+    return val
+
+
+def flag(name: str) -> bool:
+    v = get(name)
+    assert isinstance(v, bool), f"{name} is not a bool knob"
+    return v
+
+
+def intval(name: str) -> int:
+    return int(get(name))
+
+
+def floatval(name: str) -> float:
+    return float(get(name))
+
+
+def strval(name: str) -> str:
+    return str(get(name))
+
+
+def reset_cache():
+    """Drop cached values (tests mutate os.environ)."""
+    _cache.clear()
+
+
+def knobs() -> dict[str, Knob]:
+    return dict(_REGISTRY)
+
+
+def describe() -> str:
+    """Human-readable table of every knob (value, default, doc) plus the
+    subsumed CUDA-era knobs — the analog of the reference's documented
+    environment-variable list."""
+    lines = ["# quda_tpu environment configuration"]
+    for name in sorted(_REGISTRY):
+        k = _REGISTRY[name]
+        cur = get(name)
+        src = "env" if os.environ.get(name) else "default"
+        ref = f"  [ref: {k.reference}]" if k.reference else ""
+        lines.append(f"{name} = {cur!r} ({src}; default {k.default!r}) "
+                     f"— {k.doc}{ref}")
+    lines.append("# subsumed CUDA-era knobs")
+    for name in sorted(SUBSUMED):
+        lines.append(f"{name} -> {SUBSUMED[name]}")
+    return "\n".join(lines)
+
+
+def check_environment(warn=None) -> list:
+    """Return (and warn about) environment variables that LOOK like
+    quda_tpu knobs but are not registered — typos silently doing nothing
+    are the classic env-config failure."""
+    from . import logging as qlog
+    warn = warn or qlog.warningq
+    unknown = [v for v in os.environ
+               if v.startswith(_PREFIX) and v not in _REGISTRY]
+    for v in unknown:
+        warn(f"warning: unrecognised environment variable {v} "
+             "(see quda_tpu.utils.config.describe())")
+    legacy = [v for v in os.environ if v in SUBSUMED]
+    for v in legacy:
+        warn(f"warning: {v} has no effect on TPU — subsumed by "
+             f"{SUBSUMED[v]}")
+    bad = []
+    for name in _REGISTRY:
+        if os.environ.get(name):
+            try:
+                get(name, fresh=True)
+            except ValueError as e:
+                bad.append(name)
+                warn(f"warning: {e}")
+    return unknown + legacy + bad

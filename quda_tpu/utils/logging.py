@@ -20,13 +20,30 @@ DEBUG_VERBOSE = 3
 _LEVELS = {"silent": SILENT, "summarize": SUMMARIZE, "verbose": VERBOSE,
            "debug": DEBUG_VERBOSE}
 
-_state = {
-    "verbosity": _LEVELS.get(os.environ.get("QUDA_TPU_VERBOSITY",
-                                            "summarize"), SUMMARIZE),
-    "prefix": ["quda_tpu: "],
-    "rank": int(os.environ.get("QUDA_TPU_PROCESS_INDEX", "0")),
-    "rank_verbosity_all": os.environ.get("QUDA_TPU_RANK_VERBOSITY") == "all",
-}
+def _initial_state():
+    # read through the central registry (utils/config.py) so the knobs
+    # are documented and validated in one place — but never let a bad
+    # value break `import quda_tpu`: fall back to defaults here and let
+    # config.check_environment() report the problem at init_quda time
+    from . import config as qconf
+
+    def safe(name, default):
+        try:
+            return qconf.get(name)
+        except ValueError:
+            return default
+
+    return {
+        "verbosity": _LEVELS.get(safe("QUDA_TPU_VERBOSITY", "summarize"),
+                                 SUMMARIZE),
+        "prefix": ["quda_tpu: "],
+        "rank": safe("QUDA_TPU_PROCESS_INDEX", 0),
+        "rank_verbosity_all":
+            safe("QUDA_TPU_RANK_VERBOSITY", "0") == "all",
+    }
+
+
+_state = _initial_state()
 
 
 def set_verbosity(level):

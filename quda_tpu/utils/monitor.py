@@ -76,3 +76,35 @@ class Monitor:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+_default: Optional[Monitor] = None
+
+
+def start_default():
+    """Start the env-configured global monitor (QUDA_TPU_ENABLE_MONITOR
+    / QUDA_TPU_MONITOR_PERIOD), writing monitor.tsv under the resource
+    path — init_quda calls this, mirroring monitor::init_instance."""
+    global _default
+    from . import config as qconf
+    if _default is not None or not qconf.get("QUDA_TPU_ENABLE_MONITOR",
+                                             fresh=True):
+        return None
+    path = qconf.get("QUDA_TPU_RESOURCE_PATH", fresh=True)
+    out = None
+    if path:
+        os.makedirs(path, exist_ok=True)
+        out = os.path.join(path, "monitor.tsv")
+    _default = Monitor(qconf.get("QUDA_TPU_MONITOR_PERIOD", fresh=True),
+                       out)
+    _default.start()
+    return _default
+
+
+def stop_default():
+    global _default
+    if _default is not None:
+        try:
+            _default.stop()
+        finally:
+            _default = None

@@ -66,9 +66,18 @@ def get_profile(name: str) -> TimeProfile:
     return _profiles[name]
 
 
+def _profiling_enabled() -> bool:
+    from . import config as qconf
+    return not qconf.get("QUDA_TPU_DO_NOT_PROFILE", fresh=True)
+
+
 @contextmanager
 def push_profile(name: str, category: str = "total"):
-    """pushProfile RAII analog (timer.h:243)."""
+    """pushProfile RAII analog (timer.h:243); a no-op under
+    QUDA_TPU_DO_NOT_PROFILE (reference: QUDA_DO_NOT_PROFILE)."""
+    if not _profiling_enabled():
+        yield None
+        return
     prof = get_profile(name)
     _stack.append(prof)
     prof.start(category)
@@ -87,6 +96,26 @@ def print_summary():
     from .logging import printq
     for prof in _profiles.values():
         printq(prof.summary())
+    save_profiles()
+
+
+def save_profiles():
+    """Dump per-profile summaries as <QUDA_TPU_PROFILE_OUTPUT_BASE>.tsv
+    under the resource path (reference: QUDA_PROFILE_OUTPUT_BASE tsv
+    dumps in lib/tune.cpp)."""
+    from . import config as qconf
+    path = qconf.get("QUDA_TPU_RESOURCE_PATH", fresh=True)
+    if not path or not _profiles:
+        return
+    base = qconf.get("QUDA_TPU_PROFILE_OUTPUT_BASE", fresh=True)
+    import os
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, f"{base}.tsv"), "w") as fh:
+        fh.write("profile\tcategory\tseconds\tcount\n")
+        for prof in _profiles.values():
+            for cat, t in sorted(prof.seconds.items()):
+                fh.write(f"{prof.name}\t{cat}\t{t:.6f}\t"
+                         f"{prof.count.get(cat, 0)}\n")
 
 
 # global flop/byte counters (Tunable::flops_global analog, lib/tune.cpp)

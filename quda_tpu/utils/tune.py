@@ -26,7 +26,8 @@ _loaded_path = None
 
 
 def _resource_path():
-    return os.environ.get("QUDA_TPU_RESOURCE_PATH", "")
+    from . import config as qconf
+    return qconf.get("QUDA_TPU_RESOURCE_PATH", fresh=True)
 
 
 def tune_key(name: str, volume, aux: str = "") -> str:
@@ -59,7 +60,8 @@ def save_cache():
 
 
 def tuning_enabled() -> bool:
-    return os.environ.get("QUDA_TPU_ENABLE_TUNING", "1") != "0"
+    from . import config as qconf
+    return qconf.get("QUDA_TPU_ENABLE_TUNING", fresh=True)
 
 
 def tune(name: str, volume, candidates: Dict[str, Callable], args: tuple,
