@@ -313,6 +313,23 @@ class _StaggeredPairsSolve:
         return getattr(self._dpc, "flops_per_site_M", lambda: 0)()
 
 
+class _MobiusPairsSolve(_StaggeredPairsSolve):
+    """Solve-loop adapter presenting DiracMobiusPCPairs (incl. EOFA)
+    through the generic invert flow.  Same shape as the staggered
+    adapter (which it subclasses) except the PC operator is
+    NON-Hermitian: Mdag is the genuine adjoint and cg routes through
+    the normal equations, whose coefficients are real (norms and real
+    dots are representation-exact on pair arrays)."""
+
+    hermitian = False
+
+    def Mdag(self, x_pp):
+        return self.op.Mdag_pairs(x_pp)
+
+    def MdagM(self, x_pp):
+        return self.op.MdagM_pairs(x_pp)
+
+
 def invert_quda(source, param: InvertParam):
     """invertQuda: solve M x = b per param; returns x, mutates param
     result fields (true_res, iter_count, secs, gflops)."""
@@ -341,23 +358,32 @@ def invert_quda(source, param: InvertParam):
     # into the complex wrappers), and never silently degrade an f64
     # solve to the f32 pair representation (on TPU f64 does not exist,
     # so the adapter is the only executable path there)
-    stag_pairs = (param.dslash_type in ("staggered", "asqtad", "hisq")
-                  and pc
-                  and param.inv_type in ("cg", "pcg", "cg3", "cgne",
-                                         "cgnr")
-                  and (param.cuda_prec == "single" or on_tpu)
-                  and _packed_enabled(on_tpu))
+    # shared pair-adapter gate: CG-family solves only (their
+    # coefficients are real — exact on the pair representation), never
+    # silently degrading an f64 solve to f32 pairs
+    pairs_ok = (pc
+                and param.inv_type in ("cg", "pcg", "cg3", "cgne",
+                                       "cgnr")
+                and (param.cuda_prec == "single" or on_tpu)
+                and _packed_enabled(on_tpu))
+    stag_pairs = pairs_ok and param.dslash_type in ("staggered", "asqtad",
+                                                    "hisq")
+    # complex-free Möbius/DWF-4d adapter (cg routes through the normal
+    # equations there — the PC operator is non-Hermitian)
+    dwf_pairs = pairs_ok and param.dslash_type in ("domain-wall-4d",
+                                                   "mobius", "mobius-eofa")
     pair_sloppy = (sloppy_prec in ("half", "quarter")
                    and ((param.dslash_type == "wilson" and pc)
-                        or stag_pairs))
+                        or stag_pairs or dwf_pairs))
     dtype_sloppy = (sloppy_prec != param.cuda_prec
                     and complex_dtype(sloppy_prec) != complex_dtype(
                         param.cuda_prec))
     mixed = (param.inv_type == "cg" and (pair_sloppy or dtype_sloppy))
     # a canonical dtype-sloppy operator cannot consume pair iterates
     # (same exclusion as the wilson packed gate below)
-    stag_pairs = stag_pairs and not (mixed and dtype_sloppy
-                                     and not pair_sloppy)
+    pair_excluded = mixed and dtype_sloppy and not pair_sloppy
+    stag_pairs = stag_pairs and not pair_excluded
+    dwf_pairs = dwf_pairs and not pair_excluded
 
     # TPU-native packed device order for the Wilson PC solve path (QUDA
     # keeps solver fields in native FloatN order the same way); default
@@ -375,6 +401,8 @@ def invert_quda(source, param: InvertParam):
         # end; the pallas eo stencil on real TPU).  'quarter' storage has
         # no staggered int8 codec — the sloppy op falls back to bf16.
         d = _StaggeredPairsSolve(d, _pallas_enabled(on_tpu))
+    elif dwf_pairs:
+        d = _MobiusPairsSolve(d, _pallas_enabled(on_tpu))
 
     if pc:
         be, bo = _split(b, param, d)

@@ -149,6 +149,183 @@ class DiracMobiusPC(DiracPC):
     def flops_per_site_M(self) -> int:
         return 2 * 1320 + 3 * 96 * self.ls
 
+    def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
+              pallas_interpret: bool = False) -> "DiracMobiusPCPairs":
+        """Complex-free packed companion (f32 = the precise TPU solve
+        path; bf16 = the sloppy operator) — also serves the EOFA
+        subclass, whose corrected s-blocks it reads."""
+        return DiracMobiusPCPairs(self, store_dtype, use_pallas,
+                                  pallas_interpret)
+
+
+class DiracMobiusPCPairs:
+    """Complex-free packed pair-form of DiracMobiusPC (incl. EOFA).
+
+    The domain-wall/Möbius analog of DiracWilsonPCPackedSloppy /
+    DiracStaggeredPCPairs — required end-to-end on TPU runtimes without
+    complex64 execution (see bench.py), and with bf16 storage the sloppy
+    Möbius operator of mixed solves.  Layouts: spinors
+    (Ls, 4, 3, 2, T, Z, Y*Xh) re/im planes at ``store_dtype``, per-parity
+    links (4, 3, 3, 2, T, Z, Y*Xh); compute f32.
+
+    The 4-d hop is the packed eo Wilson stencil vmapped over the Ls axis
+    (optionally the pallas v3 kernel — jax.vmap turns its grid into
+    (Ls, T, Z/bz)); the s-operators are the REAL dense (Ls, Ls)
+    chirality blocks of ops/dwf.py applied as f32 einsums (MXU), so no
+    complex arithmetic remains anywhere.
+
+    Reference behavior: QUDA's Möbius solves run in float2/half native
+    orders with the fused m5 kernels (lib/dslash_mdw_fused.in.cu); here
+    the fusion of s-block x 4d-hop chains is XLA's job.
+    """
+
+    hermitian = False
+
+    def __init__(self, dpc: DiracMobiusPC, store_dtype=jnp.float32,
+                 use_pallas: bool = False, pallas_interpret: bool = False):
+        import numpy as np
+        from ..ops import wilson_packed as wpk
+        self.geom = dpc.geom
+        self.ls = dpc.ls
+        self.matpc = dpc.matpc
+        self.dims = tuple(dpc.geom.lattice_shape)
+        self.store_dtype = store_dtype
+        self.gauge_eo_pp = tuple(
+            wpk.to_packed_pairs(wpk.pack_gauge(g), store_dtype)
+            for g in dpc.gauge_eo)
+
+        def blocks(sop):
+            ap, am = np.asarray(sop.ap), np.asarray(sop.am)
+            assert (np.allclose(np.imag(ap), 0)
+                    and np.allclose(np.imag(am), 0)), \
+                "pair-form s-ops assume real chirality blocks"
+            return (jnp.asarray(np.real(ap), jnp.float32),
+                    jnp.asarray(np.real(am), jnp.float32))
+
+        self._m5p = blocks(dpc.s_m5p)
+        self._mix = blocks(dpc.s_mix)
+        self._m5i = blocks(dpc.s_m5i)
+        self.use_pallas = use_pallas
+        self._pallas_interpret = pallas_interpret
+
+    # -- building blocks ------------------------------------------------
+    def _apply_blocks(self, blk, x, adjoint=False, out_dtype=None):
+        """Apply real (Ls,Ls) chirality blocks to (Ls,4,3,2,T,Z,YXh):
+        spins 0,1 through ap, spins 2,3 through am (chirality is
+        spin-pair diagonal in the DeGrand-Rossi basis)."""
+        ap, am = blk
+        if adjoint:
+            ap, am = ap.T, am.T
+        f = x.astype(jnp.float32)
+        up = jnp.einsum("st,t...->s...", ap, f[:, :2])
+        dn = jnp.einsum("st,t...->s...", am, f[:, 2:])
+        out = jnp.concatenate([up, dn], axis=1)
+        return out.astype(out_dtype or self.store_dtype)
+
+    def _g5(self, x):
+        sign = jnp.asarray([1.0, 1.0, -1.0, -1.0], jnp.float32)
+        return (x.astype(jnp.float32)
+                * sign.reshape(1, 4, 1, 1, 1, 1, 1)).astype(x.dtype)
+
+    def _hop_to_pairs(self, x, target_parity, out_dtype=None):
+        from ..ops import wilson_packed as wpk
+        odt = out_dtype or self.store_dtype
+        if self.use_pallas:
+            from ..ops import wilson_pallas_packed as wpp
+            f = lambda v: wpp.dslash_eo_pallas_packed_v3(
+                self.gauge_eo_pp[target_parity],
+                self.gauge_eo_pp[1 - target_parity], v,
+                tuple(self.dims), target_parity,
+                interpret=self._pallas_interpret, out_dtype=odt)
+        else:
+            f = lambda v: wpk.dslash_eo_packed_pairs(
+                self.gauge_eo_pp, v, self.dims, target_parity,
+                out_dtype=odt)
+        return jax.vmap(f)(x)
+
+    def _hop_to_dag_pairs(self, x, target_parity, out_dtype=None):
+        return self._g5(self._hop_to_pairs(self._g5(x), target_parity,
+                                           out_dtype))
+
+    # -- the operator (mirrors DiracMobiusPC.M / .Mdag) -----------------
+    def M_pairs(self, x):
+        p = self.matpc
+        t = self._hop_to_pairs(self._apply_blocks(self._m5p, x), 1 - p)
+        t = self._hop_to_pairs(self._apply_blocks(self._mix, t), p,
+                               out_dtype=jnp.float32)
+        out = (x.astype(jnp.float32)
+               - 0.25 * self._apply_blocks(self._m5i, t,
+                                           out_dtype=jnp.float32))
+        return out.astype(self.store_dtype)
+
+    def Mdag_pairs(self, x):
+        p = self.matpc
+        t = self._apply_blocks(self._m5i, x, adjoint=True)
+        t = self._apply_blocks(self._mix,
+                               self._hop_to_dag_pairs(t, 1 - p),
+                               adjoint=True)
+        t = self._apply_blocks(self._m5p,
+                               self._hop_to_dag_pairs(t, p),
+                               adjoint=True, out_dtype=jnp.float32)
+        out = x.astype(jnp.float32) - 0.25 * t
+        return out.astype(self.store_dtype)
+
+    def MdagM_pairs(self, x):
+        return self.Mdag_pairs(self.M_pairs(x))
+
+    # -- layout converters (interface boundary) -------------------------
+    def _to_pairs(self, x5):
+        from ..ops import wilson_packed as wpk
+        packed = jax.vmap(wpk.pack_spinor)(x5)
+        return wpk.to_packed_pairs(packed, self.store_dtype)
+
+    def _from_pairs(self, x_pp, dtype=jnp.complex64):
+        from ..ops import wilson_packed as wpk
+        T, Z, Y, X = self.dims
+        c = wpk.from_packed_pairs(x_pp, dtype)
+        return jax.vmap(
+            lambda v: wpk.unpack_spinor(v, (T, Z, Y, X // 2)))(c)
+
+    # -- complex wrappers (oracle tests, CPU paths) ---------------------
+    def M(self, x):
+        return self._from_pairs(self.M_pairs(self._to_pairs(x)), x.dtype)
+
+    def Mdag(self, x):
+        return self._from_pairs(self.Mdag_pairs(self._to_pairs(x)),
+                                x.dtype)
+
+    def MdagM(self, x):
+        return self._from_pairs(self.MdagM_pairs(self._to_pairs(x)),
+                                x.dtype)
+
+    # -- prepare / reconstruct in pair space ----------------------------
+    def prepare_pairs(self, b_even, b_odd):
+        """Canonical complex parity-split 5d sources -> pair-form PC rhs
+        (mirrors DiracMobiusPC.prepare)."""
+        p = self.matpc
+        b_p, b_q = (b_even, b_odd) if p == EVEN else (b_odd, b_even)
+        bp_pp, bq_pp = self._to_pairs(b_p), self._to_pairs(b_q)
+        t = self._hop_to_pairs(self._apply_blocks(self._mix, bq_pp), p,
+                               out_dtype=jnp.float32)
+        rhs = self._apply_blocks(
+            self._m5i, bp_pp.astype(jnp.float32) + 0.5 * t,
+            out_dtype=jnp.float32)
+        return rhs.astype(self.store_dtype)
+
+    def reconstruct_pairs(self, x_pp, b_even, b_odd):
+        """Pair-form PC solution -> canonical complex (x_even, x_odd)
+        (mirrors DiracMobiusPC.reconstruct)."""
+        p = self.matpc
+        b_q = b_odd if p == EVEN else b_even
+        t = self._hop_to_pairs(self._apply_blocks(self._m5p, x_pp), 1 - p,
+                               out_dtype=jnp.float32)
+        xq_pp = self._apply_blocks(
+            self._m5i, self._to_pairs(b_q).astype(jnp.float32) + 0.5 * t,
+            out_dtype=jnp.float32)
+        x_p = self._from_pairs(x_pp, b_q.dtype)
+        x_q = self._from_pairs(xq_pp, b_q.dtype)
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
 
 # ---------------------------------------------------------------------------
 # Möbius EOFA (exact one-flavor algorithm)
