@@ -313,13 +313,13 @@ class _StaggeredPairsSolve:
         return getattr(self._dpc, "flops_per_site_M", lambda: 0)()
 
 
-class _MobiusPairsSolve(_StaggeredPairsSolve):
-    """Solve-loop adapter presenting DiracMobiusPCPairs (incl. EOFA)
-    through the generic invert flow.  Same shape as the staggered
-    adapter (which it subclasses) except the PC operator is
-    NON-Hermitian: Mdag is the genuine adjoint and cg routes through
-    the normal equations, whose coefficients are real (norms and real
-    dots are representation-exact on pair arrays)."""
+class _PairOpSolve(_StaggeredPairsSolve):
+    """Solve-loop adapter presenting a non-Hermitian pair operator
+    (DiracMobiusPCPairs incl. EOFA, DiracCloverPCPairs) through the
+    generic invert flow.  Same shape as the staggered adapter (which it
+    subclasses) except Mdag is the genuine adjoint and cg routes
+    through the normal equations, whose coefficients are real (norms
+    and real dots are representation-exact on pair arrays)."""
 
     hermitian = False
 
@@ -368,13 +368,14 @@ def invert_quda(source, param: InvertParam):
                 and _packed_enabled(on_tpu))
     stag_pairs = pairs_ok and param.dslash_type in ("staggered", "asqtad",
                                                     "hisq")
-    # complex-free Möbius/DWF-4d adapter (cg routes through the normal
-    # equations there — the PC operator is non-Hermitian)
-    dwf_pairs = pairs_ok and param.dslash_type in ("domain-wall-4d",
-                                                   "mobius", "mobius-eofa")
+    # complex-free adapter for the non-Hermitian PC families (cg routes
+    # through the normal equations, whose coefficients are real)
+    pair_op = pairs_ok and param.dslash_type in (
+        "domain-wall-4d", "mobius", "mobius-eofa", "clover",
+        "twisted-mass", "twisted-clover")
     pair_sloppy = (sloppy_prec in ("half", "quarter")
                    and ((param.dslash_type == "wilson" and pc)
-                        or stag_pairs or dwf_pairs))
+                        or stag_pairs or pair_op))
     dtype_sloppy = (sloppy_prec != param.cuda_prec
                     and complex_dtype(sloppy_prec) != complex_dtype(
                         param.cuda_prec))
@@ -383,7 +384,7 @@ def invert_quda(source, param: InvertParam):
     # (same exclusion as the wilson packed gate below)
     pair_excluded = mixed and dtype_sloppy and not pair_sloppy
     stag_pairs = stag_pairs and not pair_excluded
-    dwf_pairs = dwf_pairs and not pair_excluded
+    pair_op = pair_op and not pair_excluded
 
     # TPU-native packed device order for the Wilson PC solve path (QUDA
     # keeps solver fields in native FloatN order the same way); default
@@ -401,8 +402,8 @@ def invert_quda(source, param: InvertParam):
         # end; the pallas eo stencil on real TPU).  'quarter' storage has
         # no staggered int8 codec — the sloppy op falls back to bf16.
         d = _StaggeredPairsSolve(d, _pallas_enabled(on_tpu))
-    elif dwf_pairs:
-        d = _MobiusPairsSolve(d, _pallas_enabled(on_tpu))
+    elif pair_op:
+        d = _PairOpSolve(d, _pallas_enabled(on_tpu))
 
     if pc:
         be, bo = _split(b, param, d)

@@ -24,6 +24,7 @@ from ..ops import wilson as wops
 from ..ops.boundary import apply_t_boundary
 from ..ops.clover import apply_clover, clover_blocks, invert_clover
 from .dirac import Dirac, DiracPC, MATPC_EVEN_EVEN
+from .wilson import _SchurPairOpBase
 
 
 class DiracClover(Dirac):
@@ -105,3 +106,76 @@ class DiracCloverPC(DiracPC):
 
     def flops_per_site_M(self) -> int:
         return 2 * 1320 + 2 * 504 + 48
+
+    def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
+              pallas_interpret: bool = False) -> "DiracCloverPCPairs":
+        """Complex-free packed companion (f32 = the precise TPU solve
+        path; bf16 = the sloppy clover operator of mixed solves)."""
+        return DiracCloverPCPairs(self, store_dtype, use_pallas,
+                                  pallas_interpret)
+
+
+def pack_clover_pairs(blocks: jnp.ndarray, store_dtype) -> jnp.ndarray:
+    """Chiral 6x6 blocks (T,Z,Y,Xh,2,6,6) -> packed pairs
+    (2,6,6,2,T,Z,Y*Xh): block indices leading, re/im split, fused
+    minor site axes — the clover analog of wilson_packed.pack_gauge."""
+    from ..ops.wilson_packed import to_packed_pairs
+    T, Z, Y, Xh = blocks.shape[:4]
+    packed = jnp.transpose(blocks, (4, 5, 6, 0, 1, 2, 3)).reshape(
+        2, 6, 6, T, Z, Y * Xh)
+    return to_packed_pairs(packed, store_dtype)
+
+
+def apply_clover_pairs(blk_pp: jnp.ndarray, x_pp: jnp.ndarray,
+                       out_dtype=None) -> jnp.ndarray:
+    """A psi on pair arrays: blk_pp (2,6,6,2,T,Z,YXh), x_pp
+    (4,3,2,T,Z,YXh).  The (4,3) spin-color axes reshape to (2,6)
+    chirality blocks (spins 0,1 -> chirality 0 in DeGrand-Rossi);
+    complex matvec as four real einsums at f32."""
+    odt = out_dtype or x_pp.dtype
+    f = x_pp.astype(jnp.float32)
+    chi = f.reshape((2, 6) + f.shape[2:])        # (2,6,2,T,Z,YXh)
+    ar = blk_pp[:, :, :, 0].astype(jnp.float32)  # (2,6,6,T,Z,YXh)
+    ai = blk_pp[:, :, :, 1].astype(jnp.float32)
+    xr, xi = chi[:, :, 0], chi[:, :, 1]          # (2,6,T,Z,YXh)
+    outr = (jnp.einsum("cij...,cj...->ci...", ar, xr)
+            - jnp.einsum("cij...,cj...->ci...", ai, xi))
+    outi = (jnp.einsum("cij...,cj...->ci...", ar, xi)
+            + jnp.einsum("cij...,cj...->ci...", ai, xr))
+    out = jnp.stack([outr, outi], axis=2)        # (2,6,2,T,Z,YXh)
+    return out.reshape(x_pp.shape).astype(odt)
+
+
+class DiracCloverPCPairs(_SchurPairOpBase):
+    """Complex-free packed pair-form of DiracCloverPC — Wilson-clover
+    solves on TPU runtimes without complex64 execution, and (bf16
+    storage) the sloppy clover operator of mixed solves.
+
+    The hop/Schur/prepare/reconstruct machinery is _SchurPairOpBase
+    (models/wilson.py); this class supplies the two diagonal hooks: the
+    clover term and its odd-parity inverse as resident pair-form chiral
+    blocks applied as real einsums (MXU).  The PC operator is
+    gamma5-hermitian, so the template's sign argument is ignored.
+
+    Reference behavior: QUDA runs clover solves in native FloatN orders
+    with the clover field in its own packed order
+    (include/clover_field_order.h); this is that representation.
+    """
+
+    def __init__(self, dpc: "DiracCloverPC", store_dtype=jnp.float32,
+                 use_pallas: bool = False, pallas_interpret: bool = False):
+        from ..ops import wilson_packed as wpk
+        self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
+                        store_dtype, use_pallas, pallas_interpret)
+        self.kappa = float(dpc.kappa)
+        self.matpc = dpc.matpc
+        self.clover_p_pp = pack_clover_pairs(dpc.clover[dpc.matpc],
+                                             store_dtype)
+        self.clover_inv_q_pp = pack_clover_pairs(dpc.clover_inv_q,
+                                                 store_dtype)
+
+    def _diag_sign_pairs(self, x, sign, out_dtype):
+        return apply_clover_pairs(self.clover_p_pp, x, out_dtype)
+
+    def _Ainv_q_sign_pairs(self, x, sign, out_dtype):
+        return apply_clover_pairs(self.clover_inv_q_pp, x, out_dtype)

@@ -40,6 +40,7 @@ from ..ops import wilson as wops
 from ..ops.boundary import apply_t_boundary
 from ..ops.dwf import SOp, apply_sop, identity_sop, m5_sop
 from .dirac import Dirac, DiracPC, MATPC_EVEN_EVEN, apply_gamma5
+from .wilson import _PackedHopMixin
 
 
 class DiracMobius(Dirac):
@@ -158,7 +159,7 @@ class DiracMobiusPC(DiracPC):
                                   pallas_interpret)
 
 
-class DiracMobiusPCPairs:
+class DiracMobiusPCPairs(_PackedHopMixin):
     """Complex-free packed pair-form of DiracMobiusPC (incl. EOFA).
 
     The domain-wall/Möbius analog of DiracWilsonPCPackedSloppy /
@@ -185,14 +186,10 @@ class DiracMobiusPCPairs:
                  use_pallas: bool = False, pallas_interpret: bool = False):
         import numpy as np
         from ..ops import wilson_packed as wpk
-        self.geom = dpc.geom
+        self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
+                        store_dtype, use_pallas, pallas_interpret)
         self.ls = dpc.ls
         self.matpc = dpc.matpc
-        self.dims = tuple(dpc.geom.lattice_shape)
-        self.store_dtype = store_dtype
-        self.gauge_eo_pp = tuple(
-            wpk.to_packed_pairs(wpk.pack_gauge(g), store_dtype)
-            for g in dpc.gauge_eo)
 
         def blocks(sop):
             ap, am = np.asarray(sop.ap), np.asarray(sop.am)
@@ -205,8 +202,6 @@ class DiracMobiusPCPairs:
         self._m5p = blocks(dpc.s_m5p)
         self._mix = blocks(dpc.s_mix)
         self._m5i = blocks(dpc.s_m5i)
-        self.use_pallas = use_pallas
-        self._pallas_interpret = pallas_interpret
 
     # -- building blocks ------------------------------------------------
     def _apply_blocks(self, blk, x, adjoint=False, out_dtype=None):
@@ -228,20 +223,11 @@ class DiracMobiusPCPairs:
                 * sign.reshape(1, 4, 1, 1, 1, 1, 1)).astype(x.dtype)
 
     def _hop_to_pairs(self, x, target_parity, out_dtype=None):
-        from ..ops import wilson_packed as wpk
+        """The 4d hop on every s-slice: the mixin's version-aware eo
+        stencil vmapped over the leading Ls axis."""
         odt = out_dtype or self.store_dtype
-        if self.use_pallas:
-            from ..ops import wilson_pallas_packed as wpp
-            f = lambda v: wpp.dslash_eo_pallas_packed_v3(
-                self.gauge_eo_pp[target_parity],
-                self.gauge_eo_pp[1 - target_parity], v,
-                tuple(self.dims), target_parity,
-                interpret=self._pallas_interpret, out_dtype=odt)
-        else:
-            f = lambda v: wpk.dslash_eo_packed_pairs(
-                self.gauge_eo_pp, v, self.dims, target_parity,
-                out_dtype=odt)
-        return jax.vmap(f)(x)
+        return jax.vmap(
+            lambda v: self._d_to(v, target_parity, odt))(x)
 
     def _hop_to_dag_pairs(self, x, target_parity, out_dtype=None):
         return self._g5(self._hop_to_pairs(self._g5(x), target_parity,

@@ -29,6 +29,7 @@ from ..ops import wilson as wops
 from ..ops.boundary import apply_t_boundary
 from ..ops.clover import apply_clover, clover_blocks, invert_clover
 from .dirac import Dirac, DiracPC, MATPC_EVEN_EVEN, apply_gamma5
+from .wilson import _SchurPairOpBase
 
 
 def _twist_apply(psi, a: float, sign: int = +1):
@@ -117,6 +118,93 @@ class DiracTwistedMassPC(DiracPC):
 
     def flops_per_site_M(self) -> int:
         return 2 * 1320 + 192  # two hops + twist apply/inverse + axpy
+
+    def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
+              pallas_interpret: bool = False) -> "DiracTwistedMassPCPairs":
+        """Complex-free packed companion (f32 = the precise TPU solve
+        path; bf16 = the sloppy operator)."""
+        return DiracTwistedMassPCPairs(self, store_dtype, use_pallas,
+                                       pallas_interpret)
+
+
+def _ig5_rot_pairs(x_pp: jnp.ndarray, c: float) -> jnp.ndarray:
+    """i c gamma5 on packed pair arrays (4,3,2,T,Z,YXh) at f32:
+    i*gamma5 rotates (re,im) -> (-g5*im, g5*re) with g5 = (+,+,-,-)."""
+    f = x_pp.astype(jnp.float32)
+    g5 = jnp.asarray([1.0, 1.0, -1.0, -1.0],
+                     jnp.float32).reshape(4, 1, 1, 1, 1)
+    xr, xi = f[:, :, 0], f[:, :, 1]
+    return jnp.stack([-c * g5 * xi, c * g5 * xr], axis=2)
+
+
+def _twist_pairs(x_pp: jnp.ndarray, a: float, sign: int,
+                 out_dtype=None) -> jnp.ndarray:
+    """(1 + i sign a gamma5) on packed pair arrays."""
+    out = x_pp.astype(jnp.float32) + _ig5_rot_pairs(x_pp, sign * a)
+    return out.astype(out_dtype or x_pp.dtype)
+
+
+def _twist_inv_pairs(x_pp: jnp.ndarray, a: float, sign: int,
+                     out_dtype=None) -> jnp.ndarray:
+    """(1 + i sign a gamma5)^{-1} on packed pair arrays."""
+    inv = _twist_pairs(x_pp, a, -sign, out_dtype=jnp.float32)
+    return (inv / (1.0 + a * a)).astype(out_dtype or x_pp.dtype)
+
+
+class DiracTwistedMassPCPairs(_SchurPairOpBase):
+    """Complex-free packed pair-form of DiracTwistedMassPC: the twist
+    (1 + i a g5) is a pure (re,im) rotation per chirality — no complex
+    arithmetic survives anywhere (TPU runtimes without complex64).
+    Hop/Schur/prepare/reconstruct come from _SchurPairOpBase; the
+    template's Mdag = g5 M(-s) g5 is exactly the twisted dagger."""
+
+    def __init__(self, dpc: "DiracTwistedMassPC", store_dtype=jnp.float32,
+                 use_pallas: bool = False, pallas_interpret: bool = False):
+        from ..ops import wilson_packed as wpk
+        self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
+                        store_dtype, use_pallas, pallas_interpret)
+        self.kappa = float(dpc.kappa)
+        self.a = float(dpc.a)
+        self.matpc = dpc.matpc
+
+    def _diag_sign_pairs(self, x, sign, out_dtype):
+        return _twist_pairs(x, self.a, sign, out_dtype)
+
+    def _Ainv_q_sign_pairs(self, x, sign, out_dtype):
+        return _twist_inv_pairs(x, self.a, sign, out_dtype)
+
+
+class DiracTwistedCloverPCPairs(_SchurPairOpBase):
+    """Complex-free packed pair-form of DiracTwistedCloverPC: clover
+    blocks and the +-sign twisted inverses live as resident pair-form
+    chiral 6x6 blocks (models/clover.apply_clover_pairs)."""
+
+    def __init__(self, dpc: "DiracTwistedCloverPC",
+                 store_dtype=jnp.float32, use_pallas: bool = False,
+                 pallas_interpret: bool = False):
+        from ..ops import wilson_packed as wpk
+        from .clover import pack_clover_pairs
+        self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
+                        store_dtype, use_pallas, pallas_interpret)
+        self.kappa = float(dpc.kappa)
+        self.a = float(dpc.a)
+        self.matpc = dpc.matpc
+        self.clover_p_pp = pack_clover_pairs(dpc.clover[dpc.matpc],
+                                             store_dtype)
+        self.tw_inv_q_pp = {
+            s: pack_clover_pairs(dpc.tw_inv_q[s], store_dtype)
+            for s in (+1, -1)}
+
+    def _diag_sign_pairs(self, x, sign, out_dtype):
+        # A + i s a g5: clover matvec plus the direct twist rotation
+        from .clover import apply_clover_pairs
+        out = (apply_clover_pairs(self.clover_p_pp, x, jnp.float32)
+               + _ig5_rot_pairs(x, sign * self.a))
+        return out.astype(out_dtype)
+
+    def _Ainv_q_sign_pairs(self, x, sign, out_dtype):
+        from .clover import apply_clover_pairs
+        return apply_clover_pairs(self.tw_inv_q_pp[sign], x, out_dtype)
 
 
 class DiracNdegTwistedMass(Dirac):
@@ -260,6 +348,13 @@ class DiracTwistedCloverPC(DiracPC):
         b_q = b_odd if p == EVEN else b_even
         x_q = self._Ainv_q(b_q + self.kappa * self.D_to(x_p, 1 - p))
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+    def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
+              pallas_interpret: bool = False) -> "DiracTwistedCloverPCPairs":
+        """Complex-free packed companion (f32 = the precise TPU solve
+        path; bf16 = the sloppy operator)."""
+        return DiracTwistedCloverPCPairs(self, store_dtype, use_pallas,
+                                         pallas_interpret)
 
 
 class DiracNdegTwistedClover(Dirac):

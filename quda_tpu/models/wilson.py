@@ -180,6 +180,138 @@ class _PairSloppyBase:
                                 x.dtype)
 
 
+class _PackedHopMixin:
+    """The packed eo Wilson hop on pair arrays, shared by every
+    packed-layout pair operator (Wilson, clover, twisted, Möbius hops):
+    gauge setup, the pallas-version-aware stencil dispatch, and the
+    canonical<->packed spinor converters live ONCE here."""
+
+    _spin_axis = 0
+
+    def _setup_hop(self, geom, gauge_eo_packed, store_dtype,
+                   use_pallas, pallas_interpret, pallas_version=None):
+        """gauge_eo_packed: (even, odd) complex packed (4,3,3,T,Z,Y*Xh)
+        links (wilson_packed.pack_gauge_eo output)."""
+        from ..ops import wilson_packed as wpk
+        self.geom = geom
+        self.dims = tuple(geom.lattice_shape)
+        self.store_dtype = store_dtype
+        self.gauge_eo_pp = tuple(
+            wpk.to_packed_pairs(g, store_dtype) for g in gauge_eo_packed)
+        self.use_pallas = use_pallas
+        self._pallas_interpret = pallas_interpret
+        if pallas_version is None:
+            from ..utils import config as qconf
+            pallas_version = qconf.get("QUDA_TPU_PALLAS_VERSION",
+                                       fresh=True)
+        if pallas_version not in (2, 3):
+            raise ValueError(f"pallas_version must be 2 or 3, got "
+                             f"{pallas_version}")
+        self._pallas_version = pallas_version
+        # v2 pallas path only: resident pre-shifted backward links (the
+        # v3 scatter-form kernel reads the unshifted opposite-parity
+        # links directly — no resident copy)
+        if use_pallas and pallas_version == 2:
+            from ..ops import wilson_pallas_packed as wpp
+            self._u_bw = tuple(
+                wpp.backward_gauge_eo(self.gauge_eo_pp[1 - p],
+                                      tuple(self.dims), p)
+                for p in (0, 1))
+
+    def _d_to(self, psi_pp, target_parity, out_dtype):
+        from ..ops import wilson_packed as wpk
+        if self.use_pallas:
+            from ..ops import wilson_pallas_packed as wpp
+            if self._pallas_version == 3:
+                return wpp.dslash_eo_pallas_packed_v3(
+                    self.gauge_eo_pp[target_parity],
+                    self.gauge_eo_pp[1 - target_parity], psi_pp,
+                    tuple(self.dims), target_parity,
+                    interpret=self._pallas_interpret,
+                    out_dtype=out_dtype)
+            return wpp.dslash_eo_pallas_packed(
+                self.gauge_eo_pp[target_parity],
+                self._u_bw[target_parity], psi_pp, tuple(self.dims),
+                target_parity, interpret=self._pallas_interpret,
+                out_dtype=out_dtype)
+        return wpk.dslash_eo_packed_pairs(self.gauge_eo_pp, psi_pp,
+                                          self.dims, target_parity,
+                                          out_dtype=out_dtype)
+
+    def _to_pairs(self, x):
+        """Canonical (T,Z,Y,Xh,4,3) complex -> packed pairs."""
+        from ..ops import wilson_packed as wpk
+        return wpk.to_packed_pairs(wpk.pack_spinor(x), self.store_dtype)
+
+    def _from_pairs(self, x, dtype):
+        """Packed pairs -> canonical (T,Z,Y,Xh,4,3) complex."""
+        from ..ops import wilson_packed as wpk
+        T, Z, Y, X = self.dims
+        return wpk.unpack_spinor(
+            wpk.from_packed_pairs(x, dtype), (T, Z, Y, X // 2))
+
+
+class _SchurPairOpBase(_PackedHopMixin, _PairSloppyBase):
+    """Template for clover-type Schur pair operators
+
+        M_pc(s) = diag_p(s) - kappa^2 D Ainv_q(s) D
+        prepare:      b_p + kappa D Ainv_q b_q
+        reconstruct:  x_q = Ainv_q (b_q + kappa D x_p)
+
+    written ONCE over two hooks (``_diag_sign_pairs``,
+    ``_Ainv_q_sign_pairs``; the twist sign s is ignored by the
+    g5-hermitian clover family).  Mdag = g5 M(-s) g5 is the general
+    form: for sign-symmetric operators it reduces to the g5 trick.
+    """
+
+    def _diag_sign_pairs(self, x, sign, out_dtype):
+        raise NotImplementedError
+
+    def _Ainv_q_sign_pairs(self, x, sign, out_dtype):
+        raise NotImplementedError
+
+    def _M_sign_pairs(self, x, sign):
+        p = self.matpc
+        t = self._d_to(x, 1 - p, self.store_dtype)
+        t = self._Ainv_q_sign_pairs(t, sign, self.store_dtype)
+        dd = self._d_to(t, p, jnp.float32)
+        out = (self._diag_sign_pairs(x, sign, jnp.float32)
+               - (self.kappa ** 2) * dd)
+        return out.astype(self.store_dtype)
+
+    def M_pairs(self, x):
+        return self._M_sign_pairs(x, +1)
+
+    def Mdag_pairs(self, x):
+        return self._g5_pairs(self._M_sign_pairs(self._g5_pairs(x), -1))
+
+    def MdagM_pairs(self, x):
+        return self.Mdag_pairs(self.M_pairs(x))
+
+    # -- prepare / reconstruct in pair space ----------------------------
+    def prepare_pairs(self, b_even, b_odd):
+        from ..fields.geometry import EVEN
+        p = self.matpc
+        b_p, b_q = (b_even, b_odd) if p == EVEN else (b_odd, b_even)
+        t = self._Ainv_q_sign_pairs(self._to_pairs(b_q), +1,
+                                    self.store_dtype)
+        t = self._d_to(t, p, jnp.float32)
+        rhs = self._to_pairs(b_p).astype(jnp.float32) + self.kappa * t
+        return rhs.astype(self.store_dtype)
+
+    def reconstruct_pairs(self, x_pp, b_even, b_odd):
+        from ..fields.geometry import EVEN
+        p = self.matpc
+        b_q = b_odd if p == EVEN else b_even
+        t = self._d_to(x_pp, 1 - p, jnp.float32)
+        xq_pp = self._Ainv_q_sign_pairs(
+            self._to_pairs(b_q).astype(jnp.float32) + self.kappa * t,
+            +1, jnp.float32)
+        x_p = self._from_pairs(x_pp, b_q.dtype)
+        x_q = self._from_pairs(xq_pp, b_q.dtype)
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+
 class DiracWilsonPCPacked:
     """PC Wilson operator on the TPU-native packed half-lattice layout.
 
@@ -261,63 +393,21 @@ class DiracWilsonPCPacked:
                                  precise_dtype)
 
 
-class DiracWilsonPCPackedSloppy(_PairSloppyBase):
+class DiracWilsonPCPackedSloppy(_PackedHopMixin, _PairSloppyBase):
     """bf16 pair-storage PC Wilson operator on the PACKED layout:
     spinors (4,3,2,T,Z,Y*Xh) bf16, gauge likewise — the sloppy stencil
-    of the packed solve path (ops/wilson_packed.dslash_eo_packed_pairs)."""
-
-    _spin_axis = 0
+    of the packed solve path (ops/wilson_packed.dslash_eo_packed_pairs).
+    Hop/gauge machinery comes from _PackedHopMixin; the complex
+    boundary stays in the PACKED complex order (the packed operator's
+    interface), overriding the mixin's canonical converters."""
 
     def __init__(self, dpk: "DiracWilsonPCPacked", store_dtype=jnp.bfloat16,
                  use_pallas: bool = False, pallas_interpret: bool = False,
                  pallas_version: int | None = None):
-        from ..ops import wilson_packed as wpk
-        self.geom = dpk.geom
+        self._setup_hop(dpk.geom, dpk.gauge_eo_p, store_dtype,
+                        use_pallas, pallas_interpret, pallas_version)
         self.kappa = float(dpk.kappa)
         self.matpc = dpk.matpc
-        self.dims = dpk.dims
-        self.store_dtype = store_dtype
-        self.gauge_eo_pp = tuple(
-            wpk.to_packed_pairs(g, store_dtype) for g in dpk.gauge_eo_p)
-        self.use_pallas = use_pallas
-        self._pallas_interpret = pallas_interpret
-        if pallas_version is None:
-            from ..utils import config as qconf
-            pallas_version = qconf.get("QUDA_TPU_PALLAS_VERSION",
-                                       fresh=True)
-        if pallas_version not in (2, 3):
-            raise ValueError(f"pallas_version must be 2 or 3, got "
-                             f"{pallas_version}")
-        self._pallas_version = pallas_version
-        # v2 pallas path only: pre-shift the backward links once per
-        # gauge (the v3 scatter-form kernel reads the unshifted
-        # opposite-parity links directly — no resident copy)
-        if use_pallas and pallas_version == 2:
-            from ..ops import wilson_pallas_packed as wpp
-            self._u_bw = tuple(
-                wpp.backward_gauge_eo(self.gauge_eo_pp[1 - p],
-                                      tuple(self.dims), p)
-                for p in (0, 1))
-
-    def _d_to(self, psi_pp, target_parity, out_dtype):
-        from ..ops import wilson_packed as wpk
-        if self.use_pallas:
-            from ..ops import wilson_pallas_packed as wpp
-            if self._pallas_version == 3:
-                return wpp.dslash_eo_pallas_packed_v3(
-                    self.gauge_eo_pp[target_parity],
-                    self.gauge_eo_pp[1 - target_parity], psi_pp,
-                    tuple(self.dims), target_parity,
-                    interpret=self._pallas_interpret,
-                    out_dtype=out_dtype)
-            return wpp.dslash_eo_pallas_packed(
-                self.gauge_eo_pp[target_parity],
-                self._u_bw[target_parity], psi_pp, tuple(self.dims),
-                target_parity, interpret=self._pallas_interpret,
-                out_dtype=out_dtype)
-        return wpk.dslash_eo_packed_pairs(self.gauge_eo_pp, psi_pp,
-                                          self.dims, target_parity,
-                                          out_dtype=out_dtype)
 
     def _to_pairs(self, x):
         from ..ops import wilson_packed as wpk

@@ -374,13 +374,13 @@ def test_mobius_pairs_api_adapter_selected(monkeypatch):
     from quda_tpu.interfaces import quda_api as api
     from quda_tpu.interfaces.params import GaugeParam, InvertParam
     captured = {}
-    orig = api._MobiusPairsSolve.__init__
+    orig = api._PairOpSolve.__init__
 
     def spy(self, dpc, use_pallas):
         captured["hit"] = True
         orig(self, dpc, use_pallas)
 
-    monkeypatch.setattr(api._MobiusPairsSolve, "__init__", spy)
+    monkeypatch.setattr(api._PairOpSolve, "__init__", spy)
     monkeypatch.setenv("QUDA_TPU_PACKED", "1")
     geom = LatticeGeometry((4, 4, 4, 4))
     key = jax.random.PRNGKey(78)

@@ -252,3 +252,92 @@ def test_ndeg_tm_pc_solve_matches_full(cfg, matpc):
     v = dpc.prepare(be, bo)
     assert np.allclose(np.asarray(dpc.M(v)), np.asarray(dref.M(v)),
                        atol=1e-11)
+
+
+# -- complex-free pair path (the TPU solve representation) -------------------
+
+@pytest.mark.parametrize("family", ["twisted-mass", "twisted-clover"])
+def test_twisted_pairs_matches_complex(family):
+    """Twisted pair operators == the complex PC operators (M and the
+    twist-sign Mdag), plus a full pair-space solve chain."""
+    import jax
+    import jax.numpy as jnp
+    from quda_tpu.fields.geometry import LatticeGeometry
+    from quda_tpu.fields.gauge import GaugeField
+    from quda_tpu.fields.spinor import (ColorSpinorField, even_odd_join,
+                                        even_odd_split)
+    from quda_tpu.models.twisted import (DiracTwistedClover,
+                                         DiracTwistedCloverPC,
+                                         DiracTwistedMass,
+                                         DiracTwistedMassPC)
+    from quda_tpu.ops import blas
+    from quda_tpu.solvers.cg import cg
+
+    geom = LatticeGeometry((4, 4, 4, 4))
+    g = GaugeField.random(jax.random.PRNGKey(30), geom).data.astype(
+        jnp.complex64)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(31),
+                                    geom).data.astype(jnp.complex64)
+    if family == "twisted-mass":
+        dpc = DiracTwistedMassPC(g, geom, 0.12, 0.3)
+        d = DiracTwistedMass(g, geom, 0.12, 0.3)
+    else:
+        dpc = DiracTwistedCloverPC(g, geom, 0.12, 0.3, 1.1)
+        d = DiracTwistedClover(g, geom, 0.12, 0.3, 1.1)
+    pe, po = even_odd_split(psi, geom)
+    op = dpc.pairs(jnp.float32)
+    for fn in ("M", "Mdag"):
+        ref = getattr(dpc, fn)(pe)
+        got = getattr(op, fn)(pe)
+        err = float(jnp.sqrt(blas.norm2(ref - got) / blas.norm2(ref)))
+        assert err < 1e-5, (fn, err)
+    # pallas-interpret hop
+    opp = dpc.pairs(jnp.float32, use_pallas=True, pallas_interpret=True)
+    ref, got = dpc.M(pe), opp.M(pe)
+    assert float(jnp.sqrt(blas.norm2(ref - got)
+                          / blas.norm2(ref))) < 1e-5
+    rhs = op.prepare_pairs(pe, po)
+    res = cg(op.MdagM_pairs, op.Mdag_pairs(rhs), tol=1e-7, maxiter=2000)
+    assert bool(res.converged)
+    xe, xo = op.reconstruct_pairs(res.x, pe, po)
+    x = even_odd_join(xe, xo, geom)
+    rel = float(jnp.sqrt(blas.norm2(psi - d.M(x)) / blas.norm2(psi)))
+    assert rel < 1e-4
+
+
+def test_twisted_pairs_api_adapter_selected(monkeypatch):
+    """invert_quda routes twisted-mass CG at single precision through
+    the pair adapter."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from quda_tpu.fields.geometry import LatticeGeometry
+    from quda_tpu.fields.gauge import GaugeField
+    from quda_tpu.fields.spinor import ColorSpinorField
+    from quda_tpu.interfaces import quda_api as api
+    from quda_tpu.interfaces.params import GaugeParam, InvertParam
+
+    captured = {}
+    orig = api._PairOpSolve.__init__
+
+    def spy(self, dpc, use_pallas):
+        captured["hit"] = True
+        orig(self, dpc, use_pallas)
+
+    monkeypatch.setattr(api._PairOpSolve, "__init__", spy)
+    monkeypatch.setenv("QUDA_TPU_PACKED", "1")
+    geom = LatticeGeometry((4, 4, 4, 4))
+    U = GaugeField.random(jax.random.PRNGKey(32), geom).data.astype(
+        jnp.complex64)
+    b = np.asarray(ColorSpinorField.gaussian(
+        jax.random.PRNGKey(33), geom).data).astype(np.complex64)
+    api.init_quda()
+    api.load_gauge_quda(np.asarray(U), GaugeParam(X=(4, 4, 4, 4)))
+    p = InvertParam(dslash_type="twisted-mass", kappa=0.12, mu=0.3,
+                    inv_type="cg", solve_type="direct-pc",
+                    cuda_prec="single", cuda_prec_sloppy="single",
+                    tol=1e-6, maxiter=2000)
+    api.invert_quda(b, p)
+    api.end_quda()
+    assert captured.get("hit"), "pair adapter was not selected"
+    assert p.true_res < 1e-5
