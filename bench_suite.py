@@ -407,6 +407,92 @@ def main(argv):
                               "name": "cg_reliable_bf16_pairs",
                               "error": str(e)[:140]}), flush=True)
 
+        # --- complex-free pair solves for the other PC families (the
+        # representation REQUIRED on the axon TPU; CGNR on the normal
+        # equations for the non-Hermitian ones) ------------------------
+        def family_case(name, build_op, flops_site):
+            try:
+                with jax.default_device(cpu0):
+                    op, rhs_h = build_op()
+                # move the operator's resident pair arrays to the bench
+                # device (they were built on the CPU backend)
+                for attr in ("gauge_eo_pp", "fat_eo_pp", "long_eo_pp"):
+                    v = getattr(op, attr, None)
+                    if v is not None:
+                        setattr(op, attr, tuple(
+                            jax.device_put(np.asarray(g)) for g in v))
+                for attr in ("clover_p_pp", "clover_inv_q_pp"):
+                    if hasattr(op, attr):
+                        setattr(op, attr, jax.device_put(
+                            np.asarray(getattr(op, attr))))
+                if hasattr(op, "tw_inv_q_pp"):
+                    op.tw_inv_q_pp = {
+                        s: jax.device_put(np.asarray(b))
+                        for s, b in op.tw_inv_q_pp.items()}
+                rhs = jax.device_put(jnp.asarray(np.asarray(rhs_h)))
+                solve = jax.jit(lambda b: cg(
+                    op.MdagM_pairs, op.Mdag_pairs(b), tol=1e-6,
+                    maxiter=600))
+                res, secs = time_solve(solve, rhs)
+                it = int(_fetch(res.iters))
+                # flops_site is the full PC-operator (M) cost per site;
+                # each CGNR iteration applies Mdag M = 2 of them
+                fl_iter = 2 * flops_site * (vol_s // 2)
+                print(json.dumps({
+                    "suite": "solver", "name": name, "iters": it,
+                    "secs": round(secs, 3),
+                    "gflops": round(it * fl_iter / secs / 1e9, 2),
+                    "converged": bool(_fetch(res.converged)),
+                    "platform": platform, "lattice": [Ls] * 4}),
+                    flush=True)
+            except Exception as e:
+                print(json.dumps({"suite": "solver", "name": name,
+                                  "error": str(e)[:140]}), flush=True)
+
+        def _clover_build():
+            from quda_tpu.models.clover import DiracCloverPC
+            gs = jax.device_put(gs_h, cpu0)
+            ps = jax.device_put(ps_h, cpu0)
+            dpc = DiracCloverPC(gs, geo_s, 0.124, 1.0)
+            op = dpc.pairs(jnp.float32)
+            be, bo = even_odd_split(ps, geo_s)
+            return op, op.prepare_pairs(be, bo)
+
+        def _tm_build():
+            from quda_tpu.models.twisted import DiracTwistedMassPC
+            gs = jax.device_put(gs_h, cpu0)
+            ps = jax.device_put(ps_h, cpu0)
+            dpc = DiracTwistedMassPC(gs, geo_s, 0.124, 0.1)
+            op = dpc.pairs(jnp.float32)
+            be, bo = even_odd_split(ps, geo_s)
+            return op, op.prepare_pairs(be, bo)
+
+        def _mobius_build():
+            from quda_tpu.models.domain_wall import DiracMobiusPC
+            LS5 = 8
+            gs = jax.device_put(gs_h, cpu0)
+            dpc = DiracMobiusPC(gs, geo_s, LS5, 1.8, 0.05, 1.5, 0.5)
+            op = dpc.pairs(jnp.float32)
+            k = jax.random.PRNGKey(9)
+            shape5 = (LS5, Ls, Ls, Ls, Ls // 2, 4, 3)
+            be = (jax.random.normal(k, shape5, jnp.float32)
+                  + 1j * jax.random.normal(jax.random.fold_in(k, 1),
+                                           shape5, jnp.float32)
+                  ).astype(jnp.complex64)
+            bo = (jax.random.normal(jax.random.fold_in(k, 2), shape5,
+                                    jnp.float32)
+                  + 1j * jax.random.normal(jax.random.fold_in(k, 3),
+                                           shape5, jnp.float32)
+                  ).astype(jnp.complex64)
+            return op, op.prepare_pairs(be, bo)
+
+        family_case("cgnr_clover_pc_f32pairs", _clover_build,
+                    2 * 1320 + 2 * 504 + 48)
+        family_case("cgnr_twisted_mass_pc_f32pairs", _tm_build,
+                    2 * 1320 + 192)
+        family_case("cgnr_mobius_pc_f32pairs_ls8", _mobius_build,
+                    8 * (2 * 1320 + 3 * 96 * 8))
+
         if complex_ok:
             dpc = DiracWilsonPC(jnp.asarray(gs_h), geo_s, 0.124)
             with jax.default_device(cpu0):

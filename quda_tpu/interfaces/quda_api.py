@@ -372,7 +372,8 @@ def invert_quda(source, param: InvertParam):
     # through the normal equations, whose coefficients are real)
     pair_op = pairs_ok and param.dslash_type in (
         "domain-wall-4d", "mobius", "mobius-eofa", "clover",
-        "twisted-mass", "twisted-clover")
+        "twisted-mass", "twisted-clover", "ndeg-twisted-mass",
+        "ndeg-twisted-clover")
     pair_sloppy = (sloppy_prec in ("half", "quarter")
                    and ((param.dslash_type == "wilson" and pc)
                         or stag_pairs or pair_op))

@@ -207,6 +207,119 @@ class DiracTwistedCloverPCPairs(_SchurPairOpBase):
         return apply_clover_pairs(self.tw_inv_q_pp[sign], x, out_dtype)
 
 
+class _NdegPairsBase(_SchurPairOpBase):
+    """Flavor-doublet pair-form base: spinors (2, 4, 3, 2, T, Z, Y*Xh)
+    with the flavor axis leading; the hop is the mixin's eo stencil
+    vmapped over flavor, and gamma5 acts on spin axis 1."""
+
+    _spin_axis = 1
+
+    def _d_to(self, psi_pp, target_parity, out_dtype):
+        import jax
+        return jax.vmap(lambda v: super(_NdegPairsBase, self)._d_to(
+            v, target_parity, out_dtype))(psi_pp)
+
+    def _to_pairs(self, x):
+        """Canonical (T,Z,Y,Xh,2,4,3) complex -> flavor-leading packed
+        pairs."""
+        import jax
+        from ..ops import wilson_packed as wpk
+        xf = jnp.moveaxis(x, -3, 0)            # (2,T,Z,Y,Xh,4,3)
+        packed = jax.vmap(wpk.pack_spinor)(xf)
+        return wpk.to_packed_pairs(packed, self.store_dtype)
+
+    def _from_pairs(self, x, dtype):
+        import jax
+        from ..ops import wilson_packed as wpk
+        T, Z, Y, X = self.dims
+        c = wpk.from_packed_pairs(x, dtype)
+        xf = jax.vmap(lambda v: wpk.unpack_spinor(v, (T, Z, Y, X // 2)))(c)
+        return jnp.moveaxis(xf, 0, -3)
+
+
+class DiracNdegTwistedMassPCPairs(_NdegPairsBase):
+    """Complex-free pair-form of DiracNdegTwistedMassPC: the flavor 2x2
+    diagonal (1 + i a g5 tau3 - b tau1) and its closed-form inverse are
+    (re,im) rotations plus a real flavor swap."""
+
+    def __init__(self, dpc: "DiracNdegTwistedMassPC",
+                 store_dtype=jnp.float32, use_pallas: bool = False,
+                 pallas_interpret: bool = False):
+        from ..ops import wilson_packed as wpk
+        self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
+                        store_dtype, use_pallas, pallas_interpret)
+        self.kappa = float(dpc.kappa)
+        self.a = float(dpc.a)
+        self.b = float(dpc.b)
+        self.matpc = dpc.matpc
+
+    def _diag_sign_pairs(self, x, sign, out_dtype):
+        f = x.astype(jnp.float32)
+        up, dn = f[0], f[1]
+        out = jnp.stack(
+            [up + _ig5_rot_pairs(up, sign * self.a) - self.b * dn,
+             dn + _ig5_rot_pairs(dn, -sign * self.a) - self.b * up])
+        return out.astype(out_dtype)
+
+    def _Ainv_q_sign_pairs(self, x, sign, out_dtype):
+        f = x.astype(jnp.float32)
+        up, dn = f[0], f[1]
+        det = 1.0 + self.a ** 2 - self.b ** 2
+        out = jnp.stack(
+            [up + _ig5_rot_pairs(up, -sign * self.a) + self.b * dn,
+             self.b * up + dn + _ig5_rot_pairs(dn, sign * self.a)]) / det
+        return out.astype(out_dtype)
+
+
+class DiracNdegTwistedCloverPCPairs(_NdegPairsBase):
+    """Complex-free pair-form of DiracNdegTwistedCloverPC: the clover
+    term, and the commuting-6x6-block closed-form flavor inverse
+    (A^2 + a^2 - b^2)^{-1} [[A - i s a g5, b], [b, A + i s a g5]], live
+    as resident pair-form chiral blocks."""
+
+    def __init__(self, dpc: "DiracNdegTwistedCloverPC",
+                 store_dtype=jnp.float32, use_pallas: bool = False,
+                 pallas_interpret: bool = False):
+        from ..ops import wilson_packed as wpk
+        from .clover import pack_clover_pairs
+        self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
+                        store_dtype, use_pallas, pallas_interpret)
+        self.kappa = float(dpc.kappa)
+        self.a = float(dpc.a)
+        self.b = float(dpc.b)
+        self.matpc = dpc.matpc
+        self.clover_p_pp = pack_clover_pairs(dpc.clover[dpc.matpc],
+                                             store_dtype)
+        self.clover_q_pp = pack_clover_pairs(dpc.clover[1 - dpc.matpc],
+                                             store_dtype)
+        self.dinv_q_pp = pack_clover_pairs(dpc.dinv_q, store_dtype)
+
+    def _diag_sign_pairs(self, x, sign, out_dtype):
+        from .clover import apply_clover_pairs
+        f = x.astype(jnp.float32)
+        up, dn = f[0], f[1]
+        out = jnp.stack(
+            [apply_clover_pairs(self.clover_p_pp, up, jnp.float32)
+             + _ig5_rot_pairs(up, sign * self.a) - self.b * dn,
+             apply_clover_pairs(self.clover_p_pp, dn, jnp.float32)
+             + _ig5_rot_pairs(dn, -sign * self.a) - self.b * up])
+        return out.astype(out_dtype)
+
+    def _Ainv_q_sign_pairs(self, x, sign, out_dtype):
+        from .clover import apply_clover_pairs
+        f = x.astype(jnp.float32)
+        up, dn = f[0], f[1]
+        nu = (apply_clover_pairs(self.clover_q_pp, up, jnp.float32)
+              + _ig5_rot_pairs(up, -sign * self.a) + self.b * dn)
+        nd = (self.b * up
+              + apply_clover_pairs(self.clover_q_pp, dn, jnp.float32)
+              + _ig5_rot_pairs(dn, sign * self.a))
+        out = jnp.stack(
+            [apply_clover_pairs(self.dinv_q_pp, nu, jnp.float32),
+             apply_clover_pairs(self.dinv_q_pp, nd, jnp.float32)])
+        return out.astype(out_dtype)
+
+
 class DiracNdegTwistedMass(Dirac):
     """Non-degenerate twisted doublet; fields carry a flavor axis:
     (T,Z,Y,X, flavor=2, 4, 3).
@@ -494,6 +607,14 @@ class DiracNdegTwistedCloverPC(DiracPC):
         x_q = self._diag_inv_q(b_q + self.kappa * self.D_to(x_p, 1 - p))
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
 
+    def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
+              pallas_interpret: bool = False
+              ) -> "DiracNdegTwistedCloverPCPairs":
+        """Complex-free packed companion (flavor-doublet pair form)."""
+        return DiracNdegTwistedCloverPCPairs(self, store_dtype,
+                                             use_pallas,
+                                             pallas_interpret)
+
 
 class DiracNdegTwistedMassPC(DiracPC):
     """Even/odd preconditioned non-degenerate twisted mass (asymmetric):
@@ -563,3 +684,10 @@ class DiracNdegTwistedMassPC(DiracPC):
 
     def flops_per_site_M(self) -> int:
         return 2 * (2 * 1320) + 384  # two flavor hops each parity + twist
+
+    def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
+              pallas_interpret: bool = False
+              ) -> "DiracNdegTwistedMassPCPairs":
+        """Complex-free packed companion (flavor-doublet pair form)."""
+        return DiracNdegTwistedMassPCPairs(self, store_dtype, use_pallas,
+                                           pallas_interpret)
