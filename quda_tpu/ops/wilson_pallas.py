@@ -253,6 +253,18 @@ def _tuned_candidates(lat, dtype_str, backend):
             return wpk.unpack_spinor(out, (T, Z, Y, X))
 
         candidates["pallas_packed"] = jax.jit(pallas_packed)
+
+        from .wilson_pallas_packed import dslash_pallas_packed_v3
+
+        def pallas_v3(g, p):
+            # scatter-form kernel: no backward-gauge precompute at all
+            gp = to_pallas_layout(wpk.pack_gauge(g))
+            pp = to_pallas_layout(wpk.pack_spinor(p))
+            out = from_pallas_layout(dslash_pallas_packed_v3(gp, pp, X),
+                                     p.dtype)
+            return wpk.unpack_spinor(out, (T, Z, Y, X))
+
+        candidates["pallas_v3"] = jax.jit(pallas_v3)
     _TUNED_CACHE[key] = candidates
     return candidates
 

@@ -63,6 +63,19 @@ def _add_face(out, corr, axis, lo: bool):
     return lax.dynamic_update_slice_in_dim(out, fixed, idx, axis)
 
 
+def _fix_hi_face(out, gauge_pl, psi_pl, axis, name, n, mu):
+    """Forward-hop fix on the HIGH face (shared by both policies):
+    psi(x+mu) must come from the next shard's first plane — the kernel
+    used the local first plane."""
+    u_fwd_hi = _face(gauge_pl[mu], axis, lo=False)
+    halo_hi = _nbr(_face(psi_pl, axis, lo=True), name,
+                   towards_lower=True, n=n)
+    wrong_hi = _face(psi_pl, axis, lo=True)
+    corr_hi = (_hop_term(halo_hi, u_fwd_hi, TABLES[(mu, +1)], False)
+               - _hop_term(wrong_hi, u_fwd_hi, TABLES[(mu, +1)], False))
+    return _add_face(out, corr_hi, axis, lo=False)
+
+
 def dslash_pallas_sharded(gauge_pl, gauge_bw_pl, psi_pl, X: int, mesh,
                           interpret: bool = False):
     """Wilson hop sum on per-shard local packed pair blocks — call
@@ -93,25 +106,55 @@ def dslash_pallas_sharded(gauge_pl, gauge_bw_pl, psi_pl, X: int, mesh,
     for axis, name, n, mu in ((t_ax, "t", n_t, 3), (z_ax, "z", n_z, 2)):
         if n == 1:
             continue                      # periodic wrap is correct
-        u_fwd_hi = _face(gauge_pl[mu], axis, lo=False)     # U_mu at last plane
-        u_bwd_lo = _face(gauge_bw_pl[mu], axis, lo=True)   # U_mu(x-mu) at 0
-        # forward hop on the HIGH face: psi(x+mu) must come from the
-        # next shard's first plane (kernel used the local first plane)
-        halo_hi = _nbr(_face(psi_pl, axis, lo=True), name,
-                       towards_lower=True, n=n)
-        wrong_hi = _face(psi_pl, axis, lo=True)
-        corr_hi = (_hop_term(halo_hi, u_fwd_hi, TABLES[(mu, +1)], False)
-                   - _hop_term(wrong_hi, u_fwd_hi, TABLES[(mu, +1)],
-                               False))
-        out = _add_face(out, corr_hi, axis, lo=False)
+        out = _fix_hi_face(out, gauge_pl, psi_pl, axis, name, n, mu)
         # backward hop on the LOW face: psi(x-mu) from the previous
         # shard's last plane (the backward link u_bwd_lo is already the
         # correct cross-shard link: backward_gauge ran globally)
+        u_bwd_lo = _face(gauge_bw_pl[mu], axis, lo=True)   # U_mu(x-mu) at 0
         halo_lo = _nbr(_face(psi_pl, axis, lo=False), name,
                        towards_lower=False, n=n)
         wrong_lo = _face(psi_pl, axis, lo=False)
         corr_lo = (_hop_term(halo_lo, u_bwd_lo, TABLES[(mu, -1)], True)
                    - _hop_term(wrong_lo, u_bwd_lo, TABLES[(mu, -1)],
                                True))
+        out = _add_face(out, corr_lo, axis, lo=True)
+    return out
+
+
+def dslash_pallas_sharded_v3(gauge_pl, psi_pl, X: int, mesh,
+                             interpret: bool = False):
+    """v3 of the fused manual policy: the scatter-form interior kernel
+    needs NO backward-gauge copy anywhere — not per shard, not global.
+
+    The v3 kernel's backward hop wraps the locally-computed product
+    m = U_mu^dag psi into the low face.  Since that product is
+    elementwise per face site and ppermute is linear, the fix permutes
+    the PRODUCT once — corr = nbr(m_last) - m_last — one f32 spinor
+    face per partitioned direction, half the exterior compute, and no
+    gauge exchange or resident pre-shifted copy anywhere.
+    """
+    from ..ops.wilson_pallas_packed import dslash_pallas_packed_v3
+
+    n_t, n_z = mesh.shape["t"], mesh.shape["z"]
+    if mesh.shape["y"] != 1 or mesh.shape["x"] != 1:
+        raise ValueError(
+            "dslash_pallas_sharded_v3 shards t/z only (y/x mesh axes "
+            "must be 1; their shifts are in-plane lane rolls)")
+
+    out = dslash_pallas_packed_v3(gauge_pl, psi_pl, X,
+                                  interpret=interpret)
+
+    t_ax, z_ax = -3, -2
+    for axis, name, n, mu in ((t_ax, "t", n_t, 3), (z_ax, "z", n_z, 2)):
+        if n == 1:
+            continue
+        out = _fix_hi_face(out, gauge_pl, psi_pl, axis, name, n, mu)
+        # backward hop, LOW face: the kernel wrapped the LOCAL last
+        # plane's product U^dag psi into row 0; the true contribution is
+        # the PREVIOUS shard's — permute the product itself
+        prod = _hop_term(_face(psi_pl, axis, lo=False),
+                         _face(gauge_pl[mu], axis, lo=False),
+                         TABLES[(mu, -1)], True)
+        corr_lo = _nbr(prod, name, towards_lower=False, n=n) - prod
         out = _add_face(out, corr_lo, axis, lo=True)
     return out

@@ -53,3 +53,40 @@ def test_sharded_pallas_matches_single_device(grid):
 
     err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
     assert err < 1e-6
+
+
+@pytest.mark.parametrize("grid", [(4, 2, 1, 1), (2, 4, 1, 1),
+                                  (8, 1, 1, 1)])
+def test_sharded_pallas_v3_matches_single_device(grid):
+    """v3 fused policy: no backward-gauge copy at all — face fixes
+    exchange the neighbour's psi AND U planes; must bit-match the
+    single-device stencil on the virtual mesh."""
+    from quda_tpu.parallel.pallas_dslash import dslash_pallas_sharded_v3
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    geom = LatticeGeometry((4, 4, 8, 8))
+    T, Z, Y, X = geom.lattice_shape
+    gauge = GaugeField.random(jax.random.PRNGKey(13), geom).data.astype(
+        jnp.complex64)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(14), geom
+                                    ).data.astype(jnp.complex64)
+    gp = wpp.to_pallas_layout(wpk.pack_gauge(gauge))
+    pp = wpp.to_pallas_layout(wpk.pack_spinor(psi))
+    ref = wpk.dslash_packed_pairs(gp, pp, X, Y)
+
+    mesh = make_lattice_mesh(grid=grid, n_src=1)
+    psi_spec = P(None, None, None, "t", "z", None)
+    g_spec = P(None, None, None, None, "t", "z", None)
+
+    fn = jax.shard_map(
+        lambda g, p: dslash_pallas_sharded_v3(g, p, X, mesh,
+                                              interpret=True),
+        mesh=mesh, in_specs=(g_spec, psi_spec),
+        out_specs=psi_spec, check_vma=False)
+
+    gp_s = jax.device_put(gp, NamedSharding(mesh, g_spec))
+    pp_s = jax.device_put(pp, NamedSharding(mesh, psi_spec))
+    out = jax.jit(fn)(gp_s, pp_s)
+
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-6
