@@ -329,6 +329,14 @@ class _PairOpSolve(_StaggeredPairsSolve):
     def MdagM(self, x_pp):
         return self.op.MdagM_pairs(x_pp)
 
+    def __getattr__(self, name):
+        # 5d-PC split/join hooks pass through when the wrapped pair
+        # operator provides them (hasattr stays False otherwise, so the
+        # generic DWF vmap split applies to the 4d-PC families)
+        if name in ("split5", "join5"):
+            return getattr(self.op, name)
+        raise AttributeError(name)
+
 
 def invert_quda(source, param: InvertParam):
     """invertQuda: solve M x = b per param; returns x, mutates param
@@ -371,8 +379,8 @@ def invert_quda(source, param: InvertParam):
     # complex-free adapter for the non-Hermitian PC families (cg routes
     # through the normal equations, whose coefficients are real)
     pair_op = pairs_ok and param.dslash_type in (
-        "domain-wall-4d", "mobius", "mobius-eofa", "clover",
-        "twisted-mass", "twisted-clover", "ndeg-twisted-mass",
+        "domain-wall", "domain-wall-4d", "mobius", "mobius-eofa",
+        "clover", "twisted-mass", "twisted-clover", "ndeg-twisted-mass",
         "ndeg-twisted-clover")
     pair_sloppy = (sloppy_prec in ("half", "quarter")
                    and ((param.dslash_type == "wilson" and pc)

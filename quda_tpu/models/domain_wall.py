@@ -159,7 +159,30 @@ class DiracMobiusPC(DiracPC):
                                   pallas_interpret)
 
 
-class DiracMobiusPCPairs(_PackedHopMixin):
+class _LsPairIOMixin:
+    """Layout converters and gamma5 for Ls-leading pair fields
+    (Ls, 4, 3, 2, T, Z, Y*Xh) — shared by the Möbius and 5d-PC pair
+    operators (overrides _PackedHopMixin's single-slice converters)."""
+
+    def _to_pairs(self, x5):
+        from ..ops import wilson_packed as wpk
+        packed = jax.vmap(wpk.pack_spinor)(x5)
+        return wpk.to_packed_pairs(packed, self.store_dtype)
+
+    def _from_pairs(self, x_pp, dtype=jnp.complex64):
+        from ..ops import wilson_packed as wpk
+        T, Z, Y, X = self.dims
+        c = wpk.from_packed_pairs(x_pp, dtype)
+        return jax.vmap(
+            lambda v: wpk.unpack_spinor(v, (T, Z, Y, X // 2)))(c)
+
+    def _g5(self, x):
+        sign = jnp.asarray([1.0, 1.0, -1.0, -1.0], jnp.float32)
+        return (x.astype(jnp.float32)
+                * sign.reshape(1, 4, 1, 1, 1, 1, 1)).astype(x.dtype)
+
+
+class DiracMobiusPCPairs(_LsPairIOMixin, _PackedHopMixin):
     """Complex-free packed pair-form of DiracMobiusPC (incl. EOFA).
 
     The domain-wall/Möbius analog of DiracWilsonPCPackedSloppy /
@@ -217,11 +240,6 @@ class DiracMobiusPCPairs(_PackedHopMixin):
         out = jnp.concatenate([up, dn], axis=1)
         return out.astype(out_dtype or self.store_dtype)
 
-    def _g5(self, x):
-        sign = jnp.asarray([1.0, 1.0, -1.0, -1.0], jnp.float32)
-        return (x.astype(jnp.float32)
-                * sign.reshape(1, 4, 1, 1, 1, 1, 1)).astype(x.dtype)
-
     def _hop_to_pairs(self, x, target_parity, out_dtype=None):
         """The 4d hop on every s-slice: the mixin's version-aware eo
         stencil vmapped over the leading Ls axis."""
@@ -258,19 +276,6 @@ class DiracMobiusPCPairs(_PackedHopMixin):
 
     def MdagM_pairs(self, x):
         return self.Mdag_pairs(self.M_pairs(x))
-
-    # -- layout converters (interface boundary) -------------------------
-    def _to_pairs(self, x5):
-        from ..ops import wilson_packed as wpk
-        packed = jax.vmap(wpk.pack_spinor)(x5)
-        return wpk.to_packed_pairs(packed, self.store_dtype)
-
-    def _from_pairs(self, x_pp, dtype=jnp.complex64):
-        from ..ops import wilson_packed as wpk
-        T, Z, Y, X = self.dims
-        c = wpk.from_packed_pairs(x_pp, dtype)
-        return jax.vmap(
-            lambda v: wpk.unpack_spinor(v, (T, Z, Y, X // 2)))(c)
 
     # -- complex wrappers (oracle tests, CPU paths) ---------------------
     def M(self, x):
@@ -535,3 +540,140 @@ class DiracDomainWall5DPC(DiracPC):
         scale = 1.0 / (5.0 - self.m5)
         x_q = scale * b_q + self.kappa5 * self.D_to(x_p, 1 - p)
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+    def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
+              pallas_interpret: bool = False
+              ) -> "DiracDomainWall5DPCPairs":
+        """Complex-free packed companion (the TPU solve path)."""
+        return DiracDomainWall5DPCPairs(self, store_dtype, use_pallas,
+                                        pallas_interpret)
+
+
+class DiracDomainWall5DPCPairs(_LsPairIOMixin, _PackedHopMixin):
+    """Complex-free packed pair-form of DiracDomainWall5DPC — with this,
+    every PC operator family (4d-PC and 5d-PC alike) solves on TPU
+    runtimes without complex64 execution.
+
+    Same slice-aligned 5d-checkerboard layout as the complex class,
+    carried as (Ls, 4, 3, 2, T, Z, Y*Xh) pair planes: slice s of a
+    5d-parity-p field holds the 4d-parity (p+s)%2 half lattice, so the
+    s-hop stays elementwise (rolls + real wrap masks + chirality spin
+    masks) and the 4d hop alternates target parity per slice.
+    """
+
+    hermitian = False
+
+    def __init__(self, dpc: DiracDomainWall5DPC, store_dtype=jnp.float32,
+                 use_pallas: bool = False, pallas_interpret: bool = False):
+        from ..ops import wilson_packed as wpk
+        self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
+                        store_dtype, use_pallas, pallas_interpret)
+        self.ls = dpc.ls
+        self.mf = float(dpc.mf)
+        self.m5 = float(dpc.m5)
+        self.kappa5 = float(dpc.kappa5)
+        self.matpc = dpc.matpc
+
+    def _shop_pairs(self, x, swap_pm: bool):
+        """2 (P_- S^- + P_+ S^+) on pair planes: s-rolls with the -mf
+        wrap mask, chirality selection by spin masking (axis 1)."""
+        ls, mf = self.ls, self.mf
+        f = x.astype(jnp.float32)
+        up = jnp.roll(f, -1, axis=0)
+        dn = jnp.roll(f, +1, axis=0)
+        sh = (ls, 1, 1, 1, 1, 1, 1)
+        up = up * jnp.asarray([1.0] * (ls - 1) + [-mf],
+                              jnp.float32).reshape(sh)
+        dn = dn * jnp.asarray([-mf] + [1.0] * (ls - 1),
+                              jnp.float32).reshape(sh)
+        # P_-: keep spins 2,3; P_+: keep spins 0,1 (DeGrand-Rossi)
+        lo = jnp.asarray([0.0, 0.0, 1.0, 1.0],
+                         jnp.float32).reshape(1, 4, 1, 1, 1, 1, 1)
+        hi = 1.0 - lo
+        if swap_pm:
+            return 2.0 * (hi * up + lo * dn)
+        return 2.0 * (lo * up + hi * dn)
+
+    def _hop4_pairs(self, x, target_p5: int, out_dtype):
+        # (target_p5 + s) % 2 takes two values: group the s-slices by
+        # parity and vmap each group in ONE stencil call (2 launches per
+        # hop instead of Ls; the pallas grid grows to (Ls/2, T, Z/bz))
+        out = jnp.zeros(x.shape, out_dtype)
+        for r in (0, 1):
+            tp = (target_p5 + r) % 2
+            grp = jax.vmap(
+                lambda v, tp=tp: self._d_to(v, tp, out_dtype))(x[r::2])
+            out = out.at[r::2].set(grp)
+        return out
+
+    def D_to_pairs(self, x, target_p5: int, out_dtype=None):
+        odt = out_dtype or self.store_dtype
+        out = (self._hop4_pairs(x, target_p5, jnp.float32)
+               + self._shop_pairs(x, False))
+        return out.astype(odt)
+
+    def _Ddag_to_pairs(self, x, target_p5: int, out_dtype=None):
+        odt = out_dtype or self.store_dtype
+        h4 = self._g5(self._hop4_pairs(self._g5(x), target_p5,
+                                       jnp.float32))
+        out = h4.astype(jnp.float32) + self._shop_pairs(x, True)
+        return out.astype(odt)
+
+    def M_pairs(self, x):
+        p = self.matpc
+        dd = self.D_to_pairs(self.D_to_pairs(x, 1 - p), p,
+                             out_dtype=jnp.float32)
+        out = x.astype(jnp.float32) - (self.kappa5 ** 2) * dd
+        return out.astype(self.store_dtype)
+
+    def Mdag_pairs(self, x):
+        p = self.matpc
+        dd = self._Ddag_to_pairs(self._Ddag_to_pairs(x, 1 - p), p,
+                                 out_dtype=jnp.float32)
+        out = x.astype(jnp.float32) - (self.kappa5 ** 2) * dd
+        return out.astype(self.store_dtype)
+
+    def MdagM_pairs(self, x):
+        return self.Mdag_pairs(self.M_pairs(x))
+
+    def M(self, x):
+        return self._from_pairs(self.M_pairs(self._to_pairs(x)), x.dtype)
+
+    def Mdag(self, x):
+        return self._from_pairs(self.Mdag_pairs(self._to_pairs(x)),
+                                x.dtype)
+
+    def MdagM(self, x):
+        return self._from_pairs(self.MdagM_pairs(self._to_pairs(x)),
+                                x.dtype)
+
+    def prepare_pairs(self, b_even5, b_odd5):
+        """Slice-aligned complex 5d-parity sources -> pair-form rhs
+        (mirrors DiracDomainWall5DPC.prepare)."""
+        p = self.matpc
+        b_p, b_q = ((b_even5, b_odd5) if p == EVEN
+                    else (b_odd5, b_even5))
+        scale = 1.0 / (5.0 - self.m5)
+        t = self.D_to_pairs(self._to_pairs(b_q), p,
+                            out_dtype=jnp.float32)
+        rhs = scale * (self._to_pairs(b_p).astype(jnp.float32)
+                       + self.kappa5 * t)
+        return rhs.astype(self.store_dtype)
+
+    def reconstruct_pairs(self, x_pp, b_even5, b_odd5):
+        p = self.matpc
+        b_q = b_odd5 if p == EVEN else b_even5
+        scale = 1.0 / (5.0 - self.m5)
+        t = self.D_to_pairs(x_pp, 1 - p, out_dtype=jnp.float32)
+        xq_pp = (scale * self._to_pairs(b_q).astype(jnp.float32)
+                 + self.kappa5 * t)
+        x_p = self._from_pairs(x_pp, b_q.dtype)
+        x_q = self._from_pairs(xq_pp, b_q.dtype)
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+    # the generic invert flow's 5d split/join hooks (see _split/_join)
+    def split5(self, psi5_full):
+        return DiracDomainWall5DPC.split5(self, psi5_full)
+
+    def join5(self, x_even5, x_odd5):
+        return DiracDomainWall5DPC.join5(self, x_even5, x_odd5)

@@ -398,3 +398,61 @@ def test_mobius_pairs_api_adapter_selected(monkeypatch):
     api.invert_quda(b, p)
     api.end_quda()
     assert captured.get("hit"), "pair adapter was not selected"
+
+
+def test_dw5dpc_pairs_matches_complex(cfg):
+    """5d-PC pair operator == the complex DiracDomainWall5DPC (M, Mdag,
+    prepare, reconstruct) — the last PC family to go complex-free."""
+    from quda_tpu.models.domain_wall import DiracDomainWall5DPC
+    gauge, psi = cfg
+    dpc = DiracDomainWall5DPC(gauge.astype(jnp.complex64), GEOM, LS,
+                              M5, MF)
+    op = dpc.pairs(jnp.float32)
+    be, bo = dpc.split5(psi.astype(jnp.complex64))
+    for fn in ("M", "Mdag"):
+        ref = getattr(dpc, fn)(be)
+        got = getattr(op, fn)(be)
+        err = float(jnp.sqrt(blas.norm2(ref - got) / blas.norm2(ref)))
+        assert err < 1e-5, (fn, err)
+    rr = dpc.prepare(be, bo)
+    gg = op._from_pairs(op.prepare_pairs(be, bo), jnp.complex64)
+    assert float(jnp.sqrt(blas.norm2(rr - gg) / blas.norm2(rr))) < 1e-5
+    xe_r, xo_r = dpc.reconstruct(be, be, bo)
+    xe_g, xo_g = op.reconstruct_pairs(op._to_pairs(be), be, bo)
+    err = float(jnp.sqrt(
+        (blas.norm2(xe_r - xe_g) + blas.norm2(xo_r - xo_g))
+        / (blas.norm2(xe_r) + blas.norm2(xo_r))))
+    assert err < 1e-5
+
+
+def test_dw5dpc_pairs_api_adapter_selected(monkeypatch):
+    """invert_quda routes plain 'domain-wall' (5d-PC) single-precision
+    CG through the pair adapter, with the slice-aligned split5 hook."""
+    from quda_tpu.interfaces import quda_api as api
+    from quda_tpu.interfaces.params import GaugeParam, InvertParam
+    captured = {}
+    orig = api._PairOpSolve.__init__
+
+    def spy(self, dpc, use_pallas):
+        captured["hit"] = True
+        orig(self, dpc, use_pallas)
+
+    monkeypatch.setattr(api._PairOpSolve, "__init__", spy)
+    monkeypatch.setenv("QUDA_TPU_PACKED", "1")
+    geom = LatticeGeometry((4, 4, 4, 4))
+    key = jax.random.PRNGKey(88)
+    U = GaugeField.random(key, geom).data.astype(jnp.complex64)
+    ls = 4
+    b = np.asarray(jnp.stack([
+        ColorSpinorField.gaussian(jax.random.fold_in(key, s), geom).data
+        for s in range(ls)])).astype(np.complex64)
+    api.init_quda()
+    api.load_gauge_quda(np.asarray(U), GaugeParam(X=(4, 4, 4, 4)))
+    p = InvertParam(dslash_type="domain-wall", kappa=0.0, mass=MF,
+                    m5=-M5, Ls=ls, inv_type="cg",
+                    solve_type="direct-pc", cuda_prec="single",
+                    cuda_prec_sloppy="single", tol=1e-6, maxiter=4000)
+    api.invert_quda(b, p)
+    api.end_quda()
+    assert captured.get("hit"), "pair adapter was not selected"
+    assert p.true_res < 1e-5
