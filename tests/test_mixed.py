@@ -226,3 +226,41 @@ def test_invert_quda_half_sloppy_branches(api_ctx, inv, solve):
     r2 = blas.norm2(b - d.M(jnp.asarray(x)))
     assert float(jnp.sqrt(r2 / blas.norm2(b))) < 10 * tol
     assert p.true_res < 10 * tol
+
+
+@pytest.mark.parametrize("dslash", ["clover", "twisted-mass", "mobius"])
+def test_pair_families_bf16_sloppy_api(api_ctx, dslash, monkeypatch):
+    """cuda_prec_sloppy='half' on the new pair families: the mixed CG
+    runs the bf16 pair-storage sloppy operator inside cg_reliable and
+    still converges to the precise tolerance."""
+    import numpy as np
+    from quda_tpu.fields.spinor import ColorSpinorField
+    from quda_tpu.interfaces import quda_api as api
+    from quda_tpu.interfaces.params import InvertParam
+
+    monkeypatch.setenv("QUDA_TPU_PACKED", "1")
+    geom = GEOM
+    key = jax.random.PRNGKey(91)
+    if dslash == "mobius":
+        ls = 4
+        b = np.asarray(jnp.stack([
+            ColorSpinorField.gaussian(jax.random.fold_in(key, s),
+                                      geom).data
+            for s in range(ls)])).astype(np.complex64)
+        p = InvertParam(dslash_type="mobius", kappa=0.0, mass=0.04,
+                        m5=-1.4, Ls=ls, b5=1.5, c5=0.5, inv_type="cg",
+                        solve_type="direct-pc", cuda_prec="single",
+                        cuda_prec_sloppy="half", tol=1e-6, maxiter=4000)
+    else:
+        b = np.asarray(ColorSpinorField.gaussian(key, geom).data
+                       ).astype(np.complex64)
+        kw = dict(kappa=0.12, inv_type="cg", solve_type="direct-pc",
+                  cuda_prec="single", cuda_prec_sloppy="half",
+                  tol=1e-6, maxiter=4000)
+        if dslash == "clover":
+            kw["csw"] = 1.0
+        else:
+            kw["mu"] = 0.2
+        p = InvertParam(dslash_type=dslash, **kw)
+    api.invert_quda(b, p)
+    assert p.true_res < 1e-5, p.true_res
