@@ -233,8 +233,6 @@ def test_pair_families_bf16_sloppy_api(api_ctx, dslash, monkeypatch):
     """cuda_prec_sloppy='half' on the new pair families: the mixed CG
     runs the bf16 pair-storage sloppy operator inside cg_reliable and
     still converges to the precise tolerance."""
-    import numpy as np
-    from quda_tpu.fields.spinor import ColorSpinorField
     from quda_tpu.interfaces import quda_api as api
     from quda_tpu.interfaces.params import InvertParam
 
