@@ -268,6 +268,83 @@ def qudaUnitarizeSU3():
     api._set_resident_gauge(project_su3(api._ctx["gauge"]))
 
 
+def qudaUpdateUPhased(mom=None, dt: float = 0.0,
+                      phase_in: bool = False):
+    """qudaUpdateUPhased (quda_milc_interface.h:875): evolve
+    U <- exp(dt pi) U.  In the reference, phase_in says whether the
+    HOST site-struct links arrive with the MILC staggered phases, which
+    QUDA strips before updating and restores on save-out.  Here the
+    resident gauge is always the canonical unphased field (phases are
+    folded per-operator, see qudaComputeKSLink/qudaRephase), so the
+    flag is accepted for source compatibility and the update acts
+    directly — the same convention as qudaGaugeForcePhased /
+    qudaGaugeMeasurementsPhased.  Argument order follows this module's
+    qudaUpdateU(mom, dt) (the reference's precision/site-struct
+    arguments do not exist here)."""
+    del phase_in
+    qudaUpdateU(mom, dt)
+
+
+def qudaUpdateUPhasedPipeline(mom=None, dt: float = 0.0,
+                              phase_in: bool = False,
+                              want_gaugepipe: bool = False):
+    """qudaUpdateUPhasedPipeline (quda_milc_interface.h:887):
+    want_gaugepipe overlaps the gauge update with MILC's pipelined
+    force accumulation on GPUs; under jit the whole update is one fused
+    XLA program, so the flag is accepted and the phased update runs."""
+    del want_gaugepipe
+    qudaUpdateUPhased(mom, dt, phase_in)
+
+
+def qudaGaugeFixingOVR(gauge_dirs: int = 4, max_iter: int = 1000,
+                       tolerance: float = 1e-6, relax_boost: float = 1.5,
+                       reunit_interval: int = 10):
+    """qudaGaugeFixingOVR (quda_milc_interface.h:1157): overrelaxation
+    Landau (gauge_dirs=4) / Coulomb (3) fixing of the resident gauge.
+    MILC's relax_boost is the overrelaxation omega; reunit_interval maps
+    to the convergence-check interval (reunitarisation is exact here)."""
+    return api.compute_gauge_fixing_ovr_quda(
+        gauge_dirs, max_iter=max_iter, tol=tolerance,
+        omega=relax_boost, check_interval=reunit_interval)
+
+
+def qudaGaugeFixingFFT(gauge_dirs: int = 4, max_iter: int = 1000,
+                       tolerance: float = 1e-6, alpha: float = 0.08):
+    """qudaGaugeFixingFFT (quda_milc_interface.h:1180):
+    Fourier-accelerated fixing of the resident gauge."""
+    return api.compute_gauge_fixing_fft_quda(
+        gauge_dirs, max_iter=max_iter, tol=tolerance, alpha=alpha)
+
+
+def qudaDestroyGaugeField(gauge=None):
+    """qudaDestroyGaugeField (quda_milc_interface.h:854): release a
+    device gauge handle.  JAX arrays are reference-counted by the
+    runtime; dropping the resident reference is the whole job."""
+    del gauge
+    api.free_gauge_quda()
+
+
+def qudaSetMPICommHandle(comm_handle=None):
+    """qudaSetMPICommHandle (quda_milc_interface.h:150): adopt the
+    host application's MPI communicator.  Process topology is owned by
+    JAX distributed initialisation / PJRT on TPU; accepted for source
+    compatibility."""
+    del comm_handle
+
+
+def qudaFreePinned(ptr=None):
+    """qudaFreePinned (quda_milc_interface.h:182): pinned host staging
+    buffers do not exist on this runtime (PJRT owns transfers); no-op
+    for source compatibility."""
+    del ptr
+
+
+def qudaFreeManaged(ptr=None):
+    """qudaFreeManaged (quda_milc_interface.h:195): managed memory does
+    not exist on this runtime; no-op for source compatibility."""
+    del ptr
+
+
 # ---------------------------------------------------------------------------
 # Solvers: DD / MG / multi-source / eigCG / clover family
 # ---------------------------------------------------------------------------

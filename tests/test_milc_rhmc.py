@@ -176,3 +176,49 @@ def test_gauge_field_file_round_trip(ctx, tmp_path):
     api.load_gauge_field_quda(path, api.GaugeParam(cuda_prec="double"))
     assert np.allclose(np.asarray(milc.qudaPlaquettePhased()),
                        np.asarray(p0))
+
+
+def test_phased_update_and_fixing_and_handles(ctx):
+    """The last quda_milc_interface.h entries: phased gauge updates
+    (peel phases -> exp update -> restore, matching the plain update on
+    unphased links), OVR/FFT gauge fixing driving theta down, and the
+    memory/comm handle no-ops."""
+    from quda_tpu.gauge.action import random_momentum
+    from quda_tpu.gauge.observables import plaquette
+
+    g0 = api._ctx["gauge"]
+    mom = random_momentum(jax.random.PRNGKey(77), g0.shape[:-2])
+    dt = 0.01
+
+    # phased update == plain update: the resident gauge is always the
+    # canonical unphased field (the phase flag is a host-layout concern
+    # the resident model subsumes, like qudaGaugeForcePhased)
+    milc.qudaUpdateU(mom, dt)
+    g_plain = api._ctx["gauge"]
+    api._set_resident_gauge(g0)
+    milc.qudaUpdateUPhasedPipeline(mom, dt, phase_in=True,
+                                   want_gaugepipe=True)
+    g_phased = api._ctx["gauge"]
+    assert float(jnp.max(jnp.abs(g_plain - g_phased))) < 1e-12
+
+    # gauge fixing: theta decreases and the plaquette is preserved
+    from quda_tpu.gauge.fix import gaugefix_quality
+    p0 = float(plaquette(api._ctx["gauge"])[0])
+    _, theta0 = gaugefix_quality(api._ctx["gauge"])
+    iters, theta = milc.qudaGaugeFixingOVR(max_iter=40, tolerance=1e-30)
+    assert float(theta) < 0.5 * float(theta0)
+    p1 = float(plaquette(api._ctx["gauge"])[0])
+    assert abs(p0 - p1) < 1e-10          # fixing is a gauge transform
+    _, theta1 = gaugefix_quality(api._ctx["gauge"])
+    iters_f, theta_f = milc.qudaGaugeFixingFFT(max_iter=20,
+                                               tolerance=1e-30)
+    assert float(theta_f) < float(theta1)
+
+    # handle management: accepted no-ops on this runtime
+    milc.qudaSetMPICommHandle(object())
+    milc.qudaFreePinned(None)
+    milc.qudaFreeManaged(None)
+    milc.qudaDestroyGaugeField()
+    assert api._ctx["gauge"] is None
+    # restore the resident gauge for any later module tests
+    api._set_resident_gauge(g0)
