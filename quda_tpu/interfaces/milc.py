@@ -316,12 +316,42 @@ def qudaGaugeFixingFFT(gauge_dirs: int = 4, max_iter: int = 1000,
         gauge_dirs, max_iter=max_iter, tol=tolerance, alpha=alpha)
 
 
-def qudaDestroyGaugeField(gauge=None):
-    """qudaDestroyGaugeField (quda_milc_interface.h:854): release a
-    device gauge handle.  JAX arrays are reference-counted by the
-    runtime; dropping the resident reference is the whole job."""
+def qudaCreateGaugeField(gauge=None, geometry: int = 4,
+                         precision: int = 2):
+    """qudaCreateGaugeField (quda_milc_interface.h:1053): create a
+    standalone DEVICE matrix-field handle (distinct from the resident
+    gauge) from host data, or zeroed when gauge is None.  geometry:
+    1 scalar, 4 vector, 6 tensor matrix fields per site."""
+    if api._ctx["geom"] is None:
+        qlog.errorq("qudaCreateGaugeField requires qudaLoadGauge/"
+                    "qudaSetLayout first (lattice shape unknown)")
+    dtype = jnp.complex128 if precision == 2 else jnp.complex64
+    shape = (geometry,) + api._ctx["geom"].lattice_shape + (3, 3)
+    if gauge is None:
+        return jnp.zeros(shape, dtype)
+    return jnp.asarray(gauge, dtype).reshape(shape)
+
+
+def qudaDestroyGaugeField(gauge):
+    """qudaDestroyGaugeField (quda_milc_interface.h:1070): destroy a
+    STANDALONE device handle from qudaCreateGaugeField.  The resident
+    gauge is untouched (use qudaFreeGaugeField for that); JAX arrays
+    are runtime reference-counted, so dropping the reference is the
+    whole job."""
     del gauge
-    api.free_gauge_quda()
+
+
+def qudaAllocatePinned(nbytes: int):
+    """qudaAllocatePinned (quda_milc_interface.h:176): host staging
+    buffer.  No pinned memory exists on this runtime — a plain host
+    buffer serves the same role (PJRT stages transfers itself)."""
+    return np.zeros(int(nbytes), np.uint8)
+
+
+def qudaAllocateManaged(nbytes: int):
+    """qudaAllocateManaged (quda_milc_interface.h:189): as
+    qudaAllocatePinned — no managed memory on this runtime."""
+    return np.zeros(int(nbytes), np.uint8)
 
 
 def qudaSetMPICommHandle(comm_handle=None):

@@ -665,6 +665,24 @@ def invert_multishift_quda(source, param: InvertParam):
         return jnp.stack([ad.op._from_pairs(res.x[i], b.dtype)
                           for i in range(len(param.offset))])
 
+    if (param.dslash_type == "wilson"
+            and (param.cuda_prec == "single" or on_tpu)
+            and _packed_enabled(on_tpu)):
+        # complex-free Wilson multishift: shared-Krylov CGNR on the
+        # packed pair representation end to end (coefficients of the
+        # shifted normal-equation solves are real — exact on pairs)
+        t0 = time.perf_counter()
+        sl = d.packed().pairs(jnp.float32,
+                              use_pallas=_pallas_enabled(on_tpu))
+        rhs_pp = sl.prepare_pairs(be, bo)
+        res = multishift_cg(sl.MdagM_pairs, sl.Mdag_pairs(rhs_pp),
+                            tuple(param.offset), tol=param.tol,
+                            maxiter=param.maxiter)
+        param.iter_count = int(res.iters)
+        param.secs = time.perf_counter() - t0
+        return jnp.stack([sl.solution_from_pairs(res.x[i], b.dtype)
+                          for i in range(len(param.offset))])
+
     rhs = d.prepare(be, bo)
     if getattr(d, "hermitian", False):
         mv = d.M

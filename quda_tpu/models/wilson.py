@@ -417,6 +417,25 @@ class DiracWilsonPCPackedSloppy(_PackedHopMixin, _PairSloppyBase):
         from ..ops import wilson_packed as wpk
         return wpk.from_packed_pairs(x, dtype)
 
+    # -- canonical-boundary helpers (complex-free solve orchestration) --
+    def prepare_pairs(self, b_even, b_odd):
+        """Canonical complex parity sources -> pair-form PC rhs:
+        b_p + kappa D b_q, the DiracWilsonPC.prepare composition on the
+        pair representation (the one home for that formula off the
+        complex path).  Uses the mixin's CANONICAL converter explicitly
+        — this class's own _to_pairs takes packed-complex arrays."""
+        from ..fields.geometry import EVEN
+        p = self.matpc
+        b_p, b_q = (b_even, b_odd) if p == EVEN else (b_odd, b_even)
+        to_pp = lambda x: _PackedHopMixin._to_pairs(self, x)
+        rhs = (to_pp(b_p).astype(jnp.float32)
+               + self.kappa * self._d_to(to_pp(b_q), p, jnp.float32))
+        return rhs
+
+    def solution_from_pairs(self, x_pp, dtype=jnp.complex64):
+        """Pair-form PC solution -> canonical complex parity field."""
+        return _PackedHopMixin._from_pairs(self, x_pp, dtype)
+
 
 class DiracWilsonPCSloppy(_PairSloppyBase):
     """Low-precision PC Wilson operator on CANONICAL pair storage

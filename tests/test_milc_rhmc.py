@@ -214,11 +214,17 @@ def test_phased_update_and_fixing_and_handles(ctx):
                                                tolerance=1e-30)
     assert float(theta_f) < float(theta1)
 
-    # handle management: accepted no-ops on this runtime
+    # handle management: standalone device handles leave the resident
+    # gauge untouched (the reference's qudaCreateGaugeField contract)
+    h = milc.qudaCreateGaugeField(None, geometry=4, precision=1)
+    assert h.shape == api._ctx["gauge"].shape
+    milc.qudaDestroyGaugeField(h)
+    assert api._ctx["gauge"] is not None
+    buf = milc.qudaAllocatePinned(128)
+    milc.qudaFreePinned(buf)
+    milc.qudaFreeManaged(milc.qudaAllocateManaged(64))
     milc.qudaSetMPICommHandle(object())
-    milc.qudaFreePinned(None)
-    milc.qudaFreeManaged(None)
-    milc.qudaDestroyGaugeField()
+    milc.qudaFreeGaugeField()
     assert api._ctx["gauge"] is None
     # restore the resident gauge for any later module tests
     api._set_resident_gauge(g0)
