@@ -126,12 +126,15 @@ for _n, _k, _d, _doc in (
         ("QUDA_TPU_BENCH_N1", "int", 8, "short timing-chain length"),
         ("QUDA_TPU_BENCH_N2", "int", 200, "long timing-chain length"),
         ("QUDA_TPU_BENCH_REPS", "int", 5, "timing repetitions"),
-        ("QUDA_TPU_BENCH_PROBE_S", "float", 300.0,
+        ("QUDA_TPU_BENCH_PROBE_S", "float", 75.0,
          "TPU probe subprocess timeout (seconds)"),
-        ("QUDA_TPU_BENCH_PROBE_RETRIES", "int", 5,
+        ("QUDA_TPU_BENCH_PROBE_RETRIES", "int", 2,
          "TPU probe attempts before CPU fallback"),
-        ("QUDA_TPU_BENCH_PROBE_WAIT_S", "float", 90.0,
+        ("QUDA_TPU_BENCH_PROBE_WAIT_S", "float", 30.0,
          "wait between TPU probe attempts (seconds)"),
+        ("QUDA_TPU_BENCH_DEADLINE_S", "float", 1200.0,
+         "wall-clock budget: on expiry bench.py prints the best record "
+         "accumulated so far and exits 0 (0 disables)"),
         ("QUDA_TPU_BENCH_SOLVER_L", "int", 16,
          "solver-suite lattice extent")):
     _register(_n, _k, _d, _doc, reference="tests/ benchmark CLI flags")
