@@ -534,12 +534,17 @@ def invert_quda(source, param: InvertParam):
         res = fn(mv, sys_rhs, tol=param.tol,
                  max_cycles=max(1, param.maxiter // 8))
     elif inv == "gcr-mg":
-        res = _solve_mg(d_full, b, param)
+        res, pair_true_res = _solve_mg(d_full, b, param)
         x_full = res.x
         param.iter_count = int(res.iters)
         param.secs = time.perf_counter() - t0
-        r = b - d_full.M(x_full)
-        param.true_res = float(jnp.sqrt(blas.norm2(r) / blas.norm2(b)))
+        if pair_true_res is not None:
+            # the pair route already measured it complex-free; re-deriving
+            # it here with d_full.M would put a complex op on the device
+            param.true_res = pair_true_res
+        else:
+            r = b - d_full.M(x_full)
+            param.true_res = float(jnp.sqrt(blas.norm2(r) / blas.norm2(b)))
         return x_full
     else:
         qlog.errorq(f"inv_type {inv} not wired")
@@ -581,20 +586,39 @@ def _build_sloppy(p: InvertParam, pc: bool, sloppy_prec: str = None):
     return d
 
 
+def _mg_level_params(mp: "MultigridParamAPI"):
+    """MultigridParamAPI -> per-level MGLevelParam list (one mapping for
+    both the resident-setup and the solve path, so user smoothing knobs
+    are never silently dropped)."""
+    from ..mg.mg import MGLevelParam
+    return [MGLevelParam(block=tuple(mp.geo_block_size[i]),
+                         n_vec=mp.n_vec[i],
+                         setup_iters=mp.setup_iters[i]
+                         if i < len(mp.setup_iters) else 150,
+                         pre_smooth=mp.nu_pre[i] if i < len(mp.nu_pre)
+                         else 0,
+                         post_smooth=mp.nu_post[i] if i < len(mp.nu_post)
+                         else 4,
+                         smoother_omega=mp.smoother_omega,
+                         coarse_solver_iters=mp.coarse_solver_iters)
+            for i in range(mp.n_level - 1)]
+
+
+def _mg_pairs_enabled(d, param: InvertParam, on_tpu: bool) -> bool:
+    """Pair-hierarchy gate: Wilson only, and — like every other pair gate
+    in this file — never silently degrade an f64 solve to f32 pairs."""
+    return (_packed_enabled(on_tpu)
+            and type(d).__name__ == "DiracWilson"
+            and (param.cuda_prec == "single" or on_tpu))
+
+
 def _solve_mg(d_full, b, param: InvertParam, mg_param=None):
-    from ..mg.mg import MG, MGLevelParam, mg_solve
+    """Returns (SolverResult, true_res or None): the pair route computes
+    the true residual complex-free itself (the caller's complex check
+    cannot execute on runtimes without complex support)."""
+    from ..mg.mg import MG, mg_solve
     mp = mg_param or MultigridParamAPI()
-    params = [MGLevelParam(block=tuple(mp.geo_block_size[i]),
-                           n_vec=mp.n_vec[i],
-                           setup_iters=mp.setup_iters[i]
-                           if i < len(mp.setup_iters) else 150,
-                           pre_smooth=mp.nu_pre[i] if i < len(mp.nu_pre)
-                           else 0,
-                           post_smooth=mp.nu_post[i] if i < len(mp.nu_post)
-                           else 4,
-                           smoother_omega=mp.smoother_omega,
-                           coarse_solver_iters=mp.coarse_solver_iters)
-              for i in range(mp.n_level - 1)]
+    params = _mg_level_params(mp)
     mg = _ctx["mg"]
     if mg is not None and _ctx["mg_epoch"] != _ctx["gauge_epoch"]:
         # resident hierarchy was built for a different gauge — rebuild
@@ -603,23 +627,59 @@ def _solve_mg(d_full, b, param: InvertParam, mg_param=None):
         qlog.printq("gauge changed since MG setup; rebuilding hierarchy",
                     qlog.VERBOSE)
         mg = None
+    on_tpu = jax.default_backend() == "tpu"
+    from ..mg.pair import PairMG
+    if _mg_pairs_enabled(d_full, param, on_tpu):
+        # complex-free hierarchy (mg/pair.py): the only MG that can
+        # execute on TPU runtimes without complex64 support.  Boundary
+        # conversions run host-side in numpy so no complex op ever
+        # reaches the device.
+        import numpy as np
+        from ..mg.pair import mg_solve_pairs
+        if mg is not None and not isinstance(mg, PairMG):
+            qlog.printq("resident MG is complex; rebuilding as pair "
+                        "hierarchy for the packed path", qlog.VERBOSE)
+            mg = None
+        b_np = np.asarray(b)
+        b_pairs = jnp.asarray(
+            np.stack([b_np.real, b_np.imag], -1).astype(np.float32))
+        res, mg = mg_solve_pairs(d_full, _ctx["geom"], b_pairs, params,
+                                 tol=param.tol, nkrylov=param.gcrNkrylov,
+                                 mg=mg)
+        _ctx["mg"] = mg
+        _ctx["mg_epoch"] = _ctx["gauge_epoch"]
+        # true residual in pair arithmetic (no complex op on device)
+        r_pairs = b_pairs - mg.adapter.M_std(res.x)
+        true_res = float(jnp.sqrt(blas.norm2(r_pairs)
+                                  / blas.norm2(b_pairs)))
+        x_np = np.asarray(res.x)
+        return res._replace(x=jnp.asarray(
+            (x_np[..., 0] + 1j * x_np[..., 1]).astype(b_np.dtype))), \
+            true_res
+    if isinstance(mg, PairMG):
+        mg = None
     res, mg = mg_solve(d_full, _ctx["geom"], b, params, tol=param.tol,
                        nkrylov=param.gcrNkrylov, mg=mg)
     _ctx["mg"] = mg
     _ctx["mg_epoch"] = _ctx["gauge_epoch"]
-    return res
+    return res, None
 
 
 def new_multigrid_quda(mg_param: MultigridParamAPI, invert_param: InvertParam):
     """newMultigridQuda: run setup, keep hierarchy resident."""
     _require_init()
     mg_param.validate()
-    from ..mg.mg import MG, MGLevelParam
+    from ..mg.mg import MG
     d = _build_dirac(invert_param, False)
-    params = [MGLevelParam(block=tuple(mg_param.geo_block_size[i]),
-                           n_vec=mg_param.n_vec[i])
-              for i in range(mg_param.n_level - 1)]
-    _ctx["mg"] = MG(d, _ctx["geom"], params)
+    params = _mg_level_params(mg_param)
+    on_tpu = jax.default_backend() == "tpu"
+    if _mg_pairs_enabled(d, invert_param, on_tpu):
+        # resident hierarchy in the complex-free representation so the
+        # subsequent packed invert_quda reuses it (mg/pair.py)
+        from ..mg.pair import PairMG
+        _ctx["mg"] = PairMG(d, _ctx["geom"], params)
+    else:
+        _ctx["mg"] = MG(d, _ctx["geom"], params)
     _ctx["mg_epoch"] = _ctx["gauge_epoch"]
     return _ctx["mg"]
 

@@ -186,7 +186,16 @@ def _make_fine_adapter(dirac, kd: bool = False):
 
 
 class MG:
-    """Multigrid preconditioner hierarchy."""
+    """Multigrid preconditioner hierarchy.
+
+    Layout hooks (`_example_field`, `_random_like`, `_transfer_from_nulls`,
+    `_build_coarse`) isolate the field representation: the base class works
+    on complex chiral fields (lat, 2, K); mg/pair.PairMG overrides them to
+    run the identical hierarchy on real re/im pair arrays (lat, 2, K, 2)
+    for TPU runtimes without complex execution."""
+
+    _transfer_from_nulls = staticmethod(Transfer.from_null_vectors)
+    _build_coarse = staticmethod(build_coarse)
 
     def __init__(self, fine_dirac, geom, params: Sequence[MGLevelParam],
                  key=None, verbosity: int = 0, kd: bool = False):
@@ -197,8 +206,26 @@ class MG:
         self.levels: List[dict] = []
         # accept a ready adapter (has k_fine) or a Dirac operator
         self.adapter = (fine_dirac if hasattr(fine_dirac, "k_fine")
-                        else _make_fine_adapter(fine_dirac, kd=kd))
+                        else self._adapt(fine_dirac, kd=kd))
         self._setup(self.adapter, key, verbosity)
+
+    @staticmethod
+    def _adapt(fine_dirac, kd: bool = False):
+        return _make_fine_adapter(fine_dirac, kd=kd)
+
+    # -- layout hooks --------------------------------------------------
+    def _example_field(self, lat_shape, k, dtype):
+        """Zero chiral field of this hierarchy's layout."""
+        return jnp.zeros(lat_shape + (2, k), dtype)
+
+    def _random_like(self, example, key):
+        """Gaussian field matching `example` (complex here; real in pair
+        subclasses)."""
+        rdt = jnp.zeros((), example.dtype).real.dtype
+        re = jax.random.normal(key, example.shape, rdt)
+        im = jax.random.normal(jax.random.fold_in(key, 1), example.shape,
+                               rdt)
+        return (re + 1j * im).astype(example.dtype)
 
     # -- setup ---------------------------------------------------------
     def _generate_null_vectors(self, op_M, op_MdagM, example, n_vec, iters,
@@ -206,16 +233,9 @@ class MG:
         """Inverse iteration: v = (MdagM)^{-1}-ish random, normalised.
         All n_vec solves run as ONE vmapped fixed-iteration CG (a single
         compiled computation — the setup-dominant cost of MG::reset)."""
-        rdt = jnp.zeros((), example.dtype).real.dtype
-
-        def make_b(i):
-            k = jax.random.fold_in(key, i)
-            re = jax.random.normal(k, example.shape, rdt)
-            im = jax.random.normal(jax.random.fold_in(k, 1), example.shape,
-                                   rdt)
-            return (re + 1j * im).astype(example.dtype)
-
-        bs = jnp.stack([make_b(i) for i in range(n_vec)])
+        bs = jnp.stack([
+            self._random_like(example, jax.random.fold_in(key, i))
+            for i in range(n_vec)])
 
         # chunked vmap: all solves in one compiled computation per chunk,
         # but peak memory capped at ~chunk Krylov states (a full-width
@@ -242,14 +262,14 @@ class MG:
         for li, p in enumerate(self.params):
             dtype = (level_op.dtype if hasattr(level_op, "dtype")
                      else level_op.x_diag.dtype)
-            example = jnp.zeros(lat_shape + (2, k_fine), dtype)
+            example = self._example_field(lat_shape, k_fine, dtype)
             MdagM = level_op.MdagM
             parts = level_op               # all adapters expose diag/hop
             nulls = self._generate_null_vectors(
                 level_op.M, MdagM, example, p.n_vec, p.setup_iters,
                 jax.random.fold_in(key, li))
-            transfer = Transfer.from_null_vectors(nulls, p.block)
-            coarse = build_coarse(parts, transfer)
+            transfer = self._transfer_from_nulls(nulls, p.block)
+            coarse = self._build_coarse(parts, transfer)
             self.levels.append(dict(op=level_op, transfer=transfer,
                                     coarse=coarse, param=p))
             if verbosity:
@@ -324,11 +344,8 @@ class MG:
             k = jax.random.fold_in(key, li)
             dtype = (op.dtype if hasattr(op, "dtype")
                      else op.x_diag.dtype)
-            rdt = jnp.zeros((), dtype).real.dtype
-            shape = latc + (2, tr.n_vec)
-            vc = (jax.random.normal(k, shape, rdt)
-                  + 1j * jax.random.normal(jax.random.fold_in(k, 1),
-                                           shape, rdt)).astype(dtype)
+            vc = self._random_like(
+                self._example_field(latc, tr.n_vec, dtype), k)
             # R P = I on the coarse space
             rp = tr.restrict(tr.prolong(vc))
             e_rp = float(jnp.sqrt(blas.norm2(rp - vc) / blas.norm2(vc)))

@@ -1,0 +1,442 @@
+"""Complex-free multigrid: the MG hierarchy on re/im pair arrays.
+
+Reference behavior: lib/multigrid.cpp (the hierarchy this realifies),
+lib/transfer.cpp, lib/coarse_op.in.cu.  QUDA runs MG in complex
+arithmetic; the axon TPU runtime cannot execute complex64 at all
+(PERF.md), so this module re-poses the identical hierarchy over the
+REALIFICATION of every object:
+
+* chiral fields   (lat, 2, K)     complex -> (lat, 2, K, 2)     real
+* transfer V      (latc, 2, D, N) complex -> (latc, 2, D, N, 2) real
+* coarse links    (latc, Nc, Nc)  complex -> (latc, Nc, Nc, 2)  real
+
+Complex products become explicit 4-einsum pair products (the MXU-native
+complex multiply, same recipe as ops/pair.py).  The one genuinely
+complex-structured step — block orthonormalisation of the null vectors —
+uses Cholesky-QR on the INTERLEAVED real embedding: mapping each complex
+entry g to the 2x2 real block [[re,-im],[im,re]] is a ring homomorphism
+C -> R^{2x2} that sends Hermitian-positive-definite to symmetric-positive-
+definite and lower-triangular (real positive diagonal) to lower-
+triangular, so by Cholesky uniqueness the REAL Cholesky of the embedded
+Gram matrix IS the embedding of the complex Cholesky.  Two passes
+(CholQR2) restore f32 orthonormality to working precision.
+
+Krylov pieces (null-vector CG, MR/GCR smoothers, the outer GCR) run the
+existing dtype-generic solvers directly on the pair arrays: a real-
+coefficient Krylov method on the realified operator (the eig/pair_eig.py
+trick).  The V-cycle, probing construction, and verify() are inherited
+from mg/mg.py via its layout hooks — the hierarchy logic is written once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..fields.geometry import axis_of_mu
+from ..ops import blas
+from ..ops import gamma as g
+from ..ops.pair import (color_mul_pairs, dagger_pairs, spin_mul_pairs,
+                        to_pairs)
+from ..ops.shift import shift
+from .coarse import DIRS
+from .mg import MG, MGLevelParam
+
+F32 = jnp.float32
+
+
+# -- chiral pair layout -----------------------------------------------------
+
+def to_chiral_pairs(psi: jnp.ndarray) -> jnp.ndarray:
+    """(lat..., 4, 3, 2) -> (lat..., 2, 6, 2)."""
+    lat = psi.shape[:-3]
+    return psi.reshape(lat + (2, 6, 2))
+
+
+def from_chiral_pairs(psi: jnp.ndarray) -> jnp.ndarray:
+    lat = psi.shape[:-3]
+    return psi.reshape(lat + (4, 3, 2))
+
+
+# -- pair linear algebra ----------------------------------------------------
+
+def _pair_ein(spec: str, a: jnp.ndarray, b: jnp.ndarray,
+              conj_a: bool = False) -> jnp.ndarray:
+    """Complex einsum on (..., 2) pair arrays: one spec, four real
+    einsums, f32 accumulation."""
+    ar, ai = a[..., 0], a[..., 1]
+    if conj_a:
+        ai = -ai
+    br, bi = b[..., 0], b[..., 1]
+    import functools
+    ein = functools.partial(jnp.einsum, spec, preferred_element_type=F32)
+    re = ein(ar, br) - ein(ai, bi)
+    im = ein(ar, bi) + ein(ai, br)
+    return jnp.stack([re, im], axis=-1)
+
+
+def _interleave(m_pairs: jnp.ndarray) -> jnp.ndarray:
+    """(..., N, M, 2) pair matrix -> (..., 2N, 2M) real embedding with
+    entry blocks [[re,-im],[im,re]]."""
+    mr, mi = m_pairs[..., 0], m_pairs[..., 1]
+    blocks = jnp.stack([jnp.stack([mr, -mi], axis=-1),
+                        jnp.stack([mi, mr], axis=-1)], axis=-2)
+    # (..., N, M, a, b) -> (..., N, a, M, b) -> (..., 2N, 2M)
+    blocks = jnp.moveaxis(blocks, -2, -3)
+    s = blocks.shape
+    return blocks.reshape(s[:-4] + (2 * s[-4], 2 * s[-2]))
+
+
+def _deinterleave(m: jnp.ndarray) -> jnp.ndarray:
+    """(..., 2N, 2M) embedding -> (..., N, M, 2) pairs (reads the first
+    column of each 2x2 block)."""
+    return jnp.stack([m[..., 0::2, 0::2], m[..., 1::2, 0::2]], axis=-1)
+
+
+def _cholqr_pass(cols: jnp.ndarray) -> jnp.ndarray:
+    """One Cholesky-QR pass on (..., D, N, 2) pair columns."""
+    n = cols.shape[-2]
+    gram = _pair_ein("...dn,...dm->...nm", cols, cols, conj_a=True)
+    emb = _interleave(gram)
+    chol = jnp.linalg.cholesky(emb)
+    eye = jnp.broadcast_to(jnp.eye(2 * n, dtype=chol.dtype), chol.shape)
+    linv = jax.scipy.linalg.solve_triangular(chol, eye, lower=True)
+    w = dagger_pairs(_deinterleave(linv))          # (..., N, N, 2): L^-dag
+    return _pair_ein("...dn,...nm->...dm", cols, w)
+
+
+def cholqr2(cols: jnp.ndarray) -> jnp.ndarray:
+    """Orthonormalise complex columns given as (..., D, N, 2) pairs.
+    Two Cholesky-QR passes (CholQR2) for f32-grade orthonormality."""
+    return _cholqr_pass(_cholqr_pass(cols))
+
+
+# -- transfer ---------------------------------------------------------------
+
+def _block_fields_pairs(fields: jnp.ndarray, block):
+    """(B, T,Z,Y,X, 2, K, 2) -> (B, Tc,Zc,Yc,Xc, 2, D, 2)."""
+    Bn, T, Z, Y, X, two, K, _ = fields.shape
+    bt, bz, by, bx = block
+    r = fields.reshape(Bn, T // bt, bt, Z // bz, bz, Y // by, by,
+                       X // bx, bx, two, K, 2)
+    r = r.transpose(0, 1, 3, 5, 7, 9, 2, 4, 6, 8, 10, 11)
+    return r.reshape(Bn, T // bt, Z // bz, Y // by, X // bx, two,
+                     bt * bz * by * bx * K, 2)
+
+
+def _unblock_fields_pairs(blocked: jnp.ndarray, block, fine_shape, K):
+    Bn = blocked.shape[0]
+    T, Z, Y, X = fine_shape
+    bt, bz, by, bx = block
+    r = blocked.reshape(Bn, T // bt, Z // bz, Y // by, X // bx, 2,
+                        bt, bz, by, bx, K, 2)
+    r = r.transpose(0, 1, 6, 2, 7, 3, 8, 4, 9, 5, 10, 11)
+    return r.reshape(Bn, T, Z, Y, X, 2, K, 2)
+
+
+@dataclasses.dataclass
+class PairTransfer:
+    """Block transfer on pair arrays (realified mg/transfer.Transfer).
+
+    v: (Tc,Zc,Yc,Xc, 2, D, N, 2) orthonormal complex aggregates as pairs.
+    """
+
+    v: jnp.ndarray
+    block: Tuple[int, int, int, int]
+    fine_shape: Tuple[int, int, int, int]
+    k_fine: int
+    n_vec: int
+
+    @classmethod
+    def from_null_vectors(cls, null_vecs: jnp.ndarray,
+                          block) -> "PairTransfer":
+        """null_vecs: (N, T,Z,Y,X, 2, K, 2) pair chiral fields."""
+        n, T, Z, Y, X, two, K, _ = null_vecs.shape
+        bt, bz, by, bx = block
+        assert T % bt == 0 and Z % bz == 0 and Y % by == 0 and X % bx == 0, \
+            (null_vecs.shape, block)
+        blocked = _block_fields_pairs(null_vecs, block)
+        cols = jnp.moveaxis(blocked, 0, -2)         # (latc, 2, D, N, 2)
+        return cls(cholqr2(cols), tuple(block), (T, Z, Y, X), K, n)
+
+    @classmethod
+    def from_complex(cls, transfer) -> "PairTransfer":
+        """Realify an existing complex Transfer (e.g. CPU-built setup
+        migrating to a complex-free runtime)."""
+        return cls(to_pairs(transfer.v, F32), tuple(transfer.block),
+                   tuple(transfer.fine_shape), transfer.k_fine,
+                   transfer.n_vec)
+
+    @property
+    def coarse_shape(self):
+        T, Z, Y, X = self.fine_shape
+        bt, bz, by, bx = self.block
+        return (T // bt, Z // bz, Y // by, X // bx)
+
+    def restrict(self, fine: jnp.ndarray) -> jnp.ndarray:
+        """(T,Z,Y,X,2,K,2) -> (Tc,Zc,Yc,Xc,2,N,2): R = V^dag aggregate."""
+        blocked = _block_fields_pairs(fine[None], self.block)[0]
+        return _pair_ein("...dn,...d->...n", self.v, blocked, conj_a=True)
+
+    def prolong(self, coarse: jnp.ndarray) -> jnp.ndarray:
+        """(Tc,Zc,Yc,Xc,2,N,2) -> (T,Z,Y,X,2,K,2)."""
+        blocked = _pair_ein("...dn,...n->...d", self.v, coarse)
+        return _unblock_fields_pairs(blocked[None], self.block,
+                                     self.fine_shape, self.k_fine)[0]
+
+
+# -- coarse operator --------------------------------------------------------
+
+@dataclasses.dataclass
+class PairCoarseOperator:
+    """Nearest-neighbour coarse stencil on (latc, 2, N, 2) pair fields
+    (realified mg/coarse.CoarseOperator)."""
+
+    x_diag: jnp.ndarray                      # (latc, Nc, Nc, 2)
+    y: Dict[Tuple[int, int], jnp.ndarray]    # (mu,sign) -> (latc, Nc, Nc, 2)
+    n_vec: int
+    g5_hermitian: bool = True
+
+    @property
+    def nc(self):
+        return 2 * self.n_vec
+
+    def _flat(self, v):
+        return v.reshape(v.shape[:4] + (self.nc, 2))
+
+    def _unflat(self, v):
+        return v.reshape(v.shape[:4] + (2, self.n_vec, 2))
+
+    def diag(self, v):
+        f = self._flat(v)
+        return self._unflat(_pair_ein("...ab,...b->...a", self.x_diag, f))
+
+    def hop(self, v, mu, sign):
+        f = self._flat(v)
+        nbr = jnp.roll(f, -sign, axis=axis_of_mu(mu))
+        return self._unflat(
+            _pair_ein("...ab,...b->...a", self.y[(mu, sign)], nbr))
+
+    def M(self, v):
+        out = self.diag(v)
+        for mu, sign in DIRS:
+            out = out + self.hop(v, mu, sign)
+        return out
+
+    def gamma5(self, v):
+        sign = jnp.array([1.0, -1.0], v.dtype)
+        return v * sign[:, None, None]
+
+    def Mdag(self, v):
+        if not self.g5_hermitian:
+            raise NotImplementedError
+        return self.gamma5(self.M(self.gamma5(v)))
+
+    def MdagM(self, v):
+        return self.Mdag(self.M(v))
+
+    @classmethod
+    def from_complex(cls, coarse) -> "PairCoarseOperator":
+        return cls(to_pairs(coarse.x_diag, F32),
+                   {d: to_pairs(coarse.y[d], F32) for d in DIRS},
+                   coarse.n_vec, coarse.g5_hermitian)
+
+
+def build_coarse_pairs(fine_parts, transfer: PairTransfer,
+                       g5_hermitian: bool = True) -> PairCoarseOperator:
+    """Probing construction of the coarse stencil on pair arrays —
+    structure identical to mg/coarse.build_coarse (see its docstring for
+    the parity-masking argument); probing with REAL unit coarse vectors
+    reads off each complex column directly as its (re, im) pair."""
+    import numpy as np
+
+    latc = transfer.coarse_shape
+    n = transfer.n_vec
+    nc = 2 * n
+
+    for mu in range(4):
+        ext = latc[axis_of_mu(mu)]
+        if ext != 1 and ext % 2 != 0:
+            raise ValueError(
+                f"coarse extent {ext} along mu={mu} must be even or 1")
+
+    @jax.jit
+    def probe_diag(vc):
+        return transfer.restrict(fine_parts.diag(transfer.prolong(vc)))
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(1, 2))
+    def probe_hop(vc, mu, sign):
+        return transfer.restrict(
+            fine_parts.hop(transfer.prolong(vc), mu, sign))
+
+    def coord_parity(mu):
+        ax = axis_of_mu(mu)
+        shape = [1, 1, 1, 1]
+        shape[ax] = latc[ax]
+        c = np.arange(latc[ax]).reshape(shape) % 2
+        return np.broadcast_to(c, latc)
+
+    def as_col(out):                       # (latc, 2, n, 2) -> (latc, nc, 2)
+        return out.reshape(latc + (nc, 2))
+
+    diag_cols = []
+    hop_cols = {d: [] for d in DIRS}
+    for chir in range(2):
+        for b in range(n):
+            e = jnp.zeros(latc + (2, n, 2), F32).at[..., chir, b, 0].set(1.0)
+            dcol = as_col(probe_diag(e))
+            for mu, sign in DIRS:
+                ext = latc[axis_of_mu(mu)]
+                if ext == 1:
+                    hop_cols[(mu, sign)].append(as_col(probe_hop(e, mu, sign)))
+                    continue
+                par = jnp.asarray(coord_parity(mu))[..., None, None, None]
+                ycol = jnp.zeros(latc + (nc, 2), F32)
+                for p in (0, 1):
+                    mask = (par == p).astype(F32)
+                    out = as_col(probe_hop(e * mask, mu, sign))
+                    lit = (jnp.asarray(coord_parity(mu)) == p)[..., None, None]
+                    ycol = jnp.where(lit, ycol, out)
+                    dcol = dcol + jnp.where(lit, out, 0.0)
+                hop_cols[(mu, sign)].append(ycol)
+            diag_cols.append(dcol)
+
+    x_diag = jnp.stack(diag_cols, axis=-2)         # (latc, Nc, Nc, 2)
+    y = {d: jnp.stack(hop_cols[d], axis=-2) for d in DIRS}
+    return PairCoarseOperator(x_diag, y, n, g5_hermitian)
+
+
+# -- fine-level pair adapters ----------------------------------------------
+
+def wilson_hop_pairs(gauge_pairs, psi, mu, sign, kappa):
+    """-kappa * single-direction Wilson hop on (lat,4,3,2) pair arrays
+    (pair mirror of models/wilson.DiracWilson.hop)."""
+    if sign > 0:
+        u = gauge_pairs[mu]
+        proj = g.PROJ_MINUS[mu]
+        h = color_mul_pairs(u, shift(psi, mu, +1))
+    else:
+        u = shift(dagger_pairs(gauge_pairs[mu]), mu, -1)
+        proj = g.PROJ_PLUS[mu]
+        h = color_mul_pairs(u, shift(psi, mu, -1))
+    return -kappa * spin_mul_pairs(proj, h)
+
+
+class PairWilsonLevelOp:
+    """Fine-level adapter for Wilson on pair arrays: the realified
+    mg/mg._LevelOp (K = 6 chiral components, gamma5 = chirality sign).
+
+    Standard layout here means canonical pair spinors (T,Z,Y,X,4,3,2);
+    the gauge (with t-boundary phases folded in by the wrapped Dirac
+    operator) is converted to f32 pairs once at construction.
+    """
+
+    k_fine = 6
+    dtype = F32
+
+    def __init__(self, dirac):
+        from ..ops.pair import dslash_full_pairs
+        self.dirac = dirac
+        self.kappa = dirac.kappa
+        self.gauge_pairs = to_pairs(dirac.gauge, F32)
+        self._dslash = dslash_full_pairs
+
+    def to_chiral(self, v):
+        return to_chiral_pairs(v)
+
+    def from_chiral(self, v):
+        return from_chiral_pairs(v)
+
+    # -- standard (canonical pair) layout ------------------------------
+    def M_std(self, v):
+        return v - self.kappa * self._dslash(self.gauge_pairs, v,
+                                             out_dtype=F32)
+
+    def Mdag_std(self, v):
+        g5 = jnp.array([1.0, 1.0, -1.0, -1.0], v.dtype)
+        sgn = g5[:, None, None]
+        return sgn * self.M_std(sgn * v)
+
+    # -- chiral layout (the MG hierarchy's view) -----------------------
+    def M(self, v):
+        return to_chiral_pairs(self.M_std(from_chiral_pairs(v)))
+
+    def MdagM(self, v):
+        s = from_chiral_pairs(v)
+        return to_chiral_pairs(self.Mdag_std(self.M_std(s)))
+
+    def diag(self, v):
+        return v
+
+    def hop(self, v, mu, sign):
+        s = from_chiral_pairs(v)
+        return to_chiral_pairs(
+            wilson_hop_pairs(self.gauge_pairs, s, mu, sign, self.kappa))
+
+
+# -- the hierarchy ----------------------------------------------------------
+
+class PairMG(MG):
+    """Complex-free multigrid hierarchy: same driver as MG (V-cycle,
+    probing, verify are inherited), pair-array representation throughout.
+    Setup runs real CG on the realified fine operator, CholQR2 block
+    orthonormalisation, and real probing — no complex dtype anywhere."""
+
+    _transfer_from_nulls = staticmethod(PairTransfer.from_null_vectors)
+    _build_coarse = staticmethod(build_coarse_pairs)
+
+    def _example_field(self, lat_shape, k, dtype):
+        rdt = jnp.zeros((), dtype).real.dtype
+        return jnp.zeros(lat_shape + (2, k, 2), rdt)
+
+    def _random_like(self, example, key):
+        return jax.random.normal(key, example.shape, example.dtype)
+
+    @staticmethod
+    def _adapt(fine_dirac, kd: bool = False):
+        if getattr(fine_dirac, "nspin", 4) != 4:
+            raise NotImplementedError(
+                "pair MG fine adapters: Wilson-like only so far")
+        return PairWilsonLevelOp(fine_dirac)
+
+    @classmethod
+    def from_complex(cls, mg: MG, fine_dirac=None) -> "PairMG":
+        """Realify an existing complex hierarchy (CPU-built setup ->
+        complex-free apply path) without re-running setup."""
+        self = object.__new__(cls)
+        self.geom = mg.geom
+        self.params = list(mg.params)
+        self.adapter = cls._adapt(fine_dirac if fine_dirac is not None
+                                  else mg.adapter.dirac)
+        self.levels = []
+        op = self.adapter
+        for lv in mg.levels:
+            transfer = PairTransfer.from_complex(lv["transfer"])
+            coarse = PairCoarseOperator.from_complex(lv["coarse"])
+            self.levels.append(dict(op=op, transfer=transfer,
+                                    coarse=coarse, param=lv["param"]))
+            op = coarse
+        return self
+
+
+def mg_solve_pairs(fine_dirac, geom, b_pairs, params: Sequence[MGLevelParam],
+                   tol: float = 1e-6, nkrylov: int = 16,
+                   max_restarts: int = 100, key=None,
+                   mg: Optional[PairMG] = None):
+    """Outer GCR on canonical pair spinors preconditioned by the pair MG
+    V-cycle — the complex-free analog of mg/mg.mg_solve.
+
+    b_pairs: (T,Z,Y,X,4,3,2) real.  Returns (SolverResult with pair x, mg).
+    """
+    from ..solvers.gcr import gcr
+    if mg is None:
+        mg = PairMG(fine_dirac, geom, params, key)
+    a = mg.adapter
+    res = gcr(a.M_std, b_pairs, precond=mg.precondition, tol=tol,
+              nkrylov=nkrylov, max_restarts=max_restarts)
+    return res, mg

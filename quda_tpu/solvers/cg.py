@@ -110,11 +110,16 @@ def cg_fixed_iters(matvec: Callable, b: jnp.ndarray, x0, n_iters: int):
     def body(carry, _):
         x, r, p, r2 = carry
         Ap = matvec(p)
-        alpha = r2 / blas.redot(p, Ap)
+        # underflow guards: a fixed-iteration scan keeps stepping after
+        # the residual hits machine zero (common in f32 MG setup solves,
+        # where 100+ iterations converge exactly); unguarded 0/0 here
+        # poisons every null vector with NaN
+        tiny = jnp.asarray(jnp.finfo(r2.dtype).tiny, r2.dtype)
+        alpha = r2 / (blas.redot(p, Ap) + tiny)
         x = x + alpha.astype(x.dtype) * p
         r = r - alpha.astype(x.dtype) * Ap
         r2_new = blas.norm2(r)
-        beta = r2_new / r2
+        beta = r2_new / (r2 + tiny)
         p = r + beta.astype(x.dtype) * p
         return (x, r, p, r2_new), r2_new
 
