@@ -523,6 +523,81 @@ def main(argv):
                 "converged": bool(_fetch(res2.converged)),
                 "platform": platform, "lattice": [Ls] * 4}), flush=True)
 
+    if "gauge" in suites:
+        # complex-free gauge/HMC sector (pair representation — the only
+        # form the axon TPU executes; gauge/pair tests pin it against the
+        # complex implementation).  Times the HISQ fattening chain and a
+        # full RHMC kick-drift step (fermion rational force through the
+        # fattening AD chain + path-table gauge force + exp update).
+        from quda_tpu.gauge import action as gact
+        from quda_tpu.gauge import hisq as ghisq
+        from quda_tpu.gauge import observables as gobs
+        from quda_tpu.gauge import paths as gp
+        from quda_tpu.gauge.fermion_force import rational_force
+        from quda_tpu.ops import staggered as g_sops
+        from quda_tpu.ops.boundary import apply_staggered_phases
+
+        Lg = 8 if platform == "cpu" else 16
+        geo_g = LatticeGeometry((Lg,) * 4)
+        graw = (rng.standard_normal((4, Lg, Lg, Lg, Lg, 3, 3))
+                + 1j * rng.standard_normal((4, Lg, Lg, Lg, Lg, 3, 3)))
+        q, r = np.linalg.qr(graw)
+        diag = np.diagonal(r, axis1=-2, axis2=-1)
+        ug = q * (diag / np.abs(diag))[..., None, :]
+        u_pairs = jax.device_put(jnp.asarray(
+            np.stack([ug.real, ug.imag], -1), jnp.float32))
+        x_pf = jax.device_put(jnp.asarray(rng.standard_normal(
+            (Lg, Lg, Lg, Lg, 1, 3, 2)), jnp.float32))
+        u_pairs.block_until_ready(), x_pf.block_until_ready()
+
+        def time_once(fn, *args):
+            out = fn(*args)                       # compile + warm
+            jax.tree_util.tree_map(lambda o: o.block_until_ready(), out)
+            t0 = time.perf_counter()
+            out = fn(*args)
+            leaves = jax.tree_util.tree_leaves(out)
+            _ = _fetch(jnp.sum(leaves[0].astype(jnp.float32) ** 2))
+            return time.perf_counter() - t0
+
+        fat_fn = jax.jit(lambda u: ghisq.hisq_fattening(u))
+        secs_f = time_once(fat_fn, u_pairs)
+        print(json.dumps({
+            "suite": "gauge", "name": "hisq_fattening_pairs",
+            "secs": round(secs_f, 4),
+            "msites_per_s": round(geo_g.volume / secs_f / 1e6, 4),
+            "platform": platform, "lattice": [Lg] * 4}), flush=True)
+
+        mass, dtg = 0.1, 0.01
+        buf = gp.plaquette_paths()
+
+        def make_m(u):
+            links = ghisq.hisq_fattening(u)
+            fat = apply_staggered_phases(links.fat, geo_g)
+            lng = apply_staggered_phases(links.long, geo_g, nhop=3)
+
+            def mdagm(x):
+                d = g_sops.dslash_full(fat, x, lng)
+                return ((4.0 * mass ** 2) * x
+                        - g_sops.dslash_full(fat, d, lng))
+            return mdagm
+
+        def rhmc_step(u, p):
+            ff = rational_force(make_m, u, (x_pf,), (0.8,))
+            fg = gp.gauge_path_force(u, buf, [-5.5 / 3.0 / 4.0] * 6)
+            p = p - dtg * (ff + fg)
+            u = gact.update_gauge(u, p, dtg)
+            return u, p, gobs.plaquette(u)[0]
+
+        p0 = gact.random_momentum(jax.random.PRNGKey(3),
+                                  u_pairs.shape[:-3], jnp.float32)
+        step_fn = jax.jit(rhmc_step)
+        secs_s = time_once(step_fn, u_pairs, p0)
+        print(json.dumps({
+            "suite": "gauge", "name": "rhmc_kick_drift_pairs",
+            "secs": round(secs_s, 4),
+            "msites_per_s": round(geo_g.volume / secs_s / 1e6, 4),
+            "platform": platform, "lattice": [Lg] * 4}), flush=True)
+
 
 if __name__ == "__main__":
     main(sys.argv[1:])

@@ -27,8 +27,9 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.fmunu import PLANES
-from ..ops.su3 import (dagger, expm_su3, mat_mul, project_su3,
-                       random_hermitian_traceless, trace)
+from ..ops.su3 import (dagger, expm_su3, is_pairs, mat_i, mat_mul,
+                       project_su3, random_hermitian_traceless, re_trace,
+                       trace)
 from .observables import plaquette_field
 
 
@@ -38,7 +39,7 @@ def wilson_action(gauge: jnp.ndarray, beta: float) -> jnp.ndarray:
     """S = beta sum_{x, mu<nu} (1 - Re tr P_{mu nu} / 3)."""
     s = 0.0
     for mu, nu in PLANES:
-        p = trace(plaquette_field(gauge, mu, nu)).real / 3.0
+        p = re_trace(plaquette_field(gauge, mu, nu)) / 3.0
         s = s + jnp.sum(1.0 - p)
     return beta * s
 
@@ -59,10 +60,10 @@ def improved_action(gauge: jnp.ndarray, beta: float, c1: float):
     c0 = 1.0 - 8.0 * c1
     s = 0.0
     for mu, nu in PLANES:
-        p = trace(plaquette_field(gauge, mu, nu)).real / 3.0
+        p = re_trace(plaquette_field(gauge, mu, nu)) / 3.0
         s = s + c0 * jnp.sum(1.0 - p)
-        r1 = trace(rectangle_field(gauge, mu, nu)).real / 3.0
-        r2 = trace(rectangle_field(gauge, nu, mu)).real / 3.0
+        r1 = re_trace(rectangle_field(gauge, mu, nu)) / 3.0
+        r2 = re_trace(rectangle_field(gauge, nu, mu)) / 3.0
         s = s + c1 * (jnp.sum(1.0 - r1) + jnp.sum(1.0 - r2))
     return beta * s
 
@@ -72,6 +73,11 @@ def improved_action(gauge: jnp.ndarray, beta: float, c1: float):
 def traceless_hermitian(m: jnp.ndarray) -> jnp.ndarray:
     h = 0.5 * (m + dagger(m))
     tr = trace(h) / 3.0
+    if is_pairs(m):
+        # complex scalar times identity: place the pair scalar on the
+        # diagonal (an elementwise product with eye_like would not be a
+        # complex multiply)
+        return h - tr[..., None, None, :] * jnp.eye(3, dtype=m.dtype)[..., None]
     return h - tr[..., None, None] * jnp.eye(3, dtype=m.dtype)
 
 
@@ -84,9 +90,13 @@ def gauge_force(action_fn: Callable, gauge: jnp.ndarray) -> jnp.ndarray:
     traceless force F = TA( i (M - M^dag) ) / 2 with M = U g^dag.
     """
     g = jax.grad(lambda u: action_fn(u).real)(gauge)
-    g = jnp.conjugate(g)  # JAX returns conj(dS/dRe + i dS/dIm) for real S
+    # complex: JAX returns conj(dS/dRe + i dS/dIm) for real S, so conj
+    # recovers gc with dS = Re<gc, dU>.  Pair: the grad array READ AS
+    # COMPLEX already satisfies dS = Re<gc, dU> (and conjugate on a real
+    # array is the identity), so one line serves both representations.
+    g = jnp.conjugate(g)
     m = mat_mul(gauge, dagger(g))
-    k = 0.5j * (m - dagger(m))
+    k = 0.5 * mat_i(m - dagger(m))
     # with H = tr(P^2) + S and dU/dt = i P U, energy conservation fixes
     # F = TA(K)/2  (dS/dt = tr(P K), dT/dt = -2 tr(P F))
     return 0.5 * traceless_hermitian(k)
@@ -95,13 +105,14 @@ def gauge_force(action_fn: Callable, gauge: jnp.ndarray) -> jnp.ndarray:
 # -- momenta / update ------------------------------------------------------
 
 def random_momentum(key, gauge_shape, dtype=jnp.complex128):
-    """Gaussian su(3) momenta, <p_a^2> = 1 (gaussGaugeQuda mom mode)."""
+    """Gaussian su(3) momenta, <p_a^2> = 1 (gaussGaugeQuda mom mode).
+    A floating dtype samples straight into the pair representation."""
     return random_hermitian_traceless(key, gauge_shape, dtype=dtype)
 
 
 def mom_action(p: jnp.ndarray) -> jnp.ndarray:
     """T = tr(P^2) summed (= 1/2 sum_a p_a^2; momActionQuda analog)."""
-    return jnp.sum(trace(mat_mul(p, p)).real)
+    return jnp.sum(re_trace(mat_mul(p, p)))
 
 
 def update_gauge(gauge: jnp.ndarray, p: jnp.ndarray,
@@ -121,7 +132,8 @@ def _force_monitor(f: jnp.ndarray, label: str):
         return
     if isinstance(f, jax.core.Tracer):
         return
-    site2 = jnp.sum(jnp.abs(f) ** 2, axis=(-2, -1))
+    axes = (-3, -2, -1) if is_pairs(f) else (-2, -1)
+    site2 = jnp.sum(jnp.abs(f) ** 2, axis=axes)
     qlog.printq(f"force {label}: max {float(jnp.max(site2)) ** 0.5:.6e} "
                 f"rms {float(jnp.mean(site2)) ** 0.5:.6e}",
                 qlog.SUMMARIZE)
@@ -166,7 +178,8 @@ def hmc_trajectory(key, action_fn, gauge, n_steps: int = 10,
     """One HMC trajectory with Metropolis accept/reject."""
     from .observables import plaquette
     k_mom, k_acc = jax.random.split(key)
-    p0 = random_momentum(k_mom, gauge.shape[:-2], gauge.dtype)
+    site_shape = gauge.shape[:-3] if is_pairs(gauge) else gauge.shape[:-2]
+    p0 = random_momentum(k_mom, site_shape, gauge.dtype)
     h0 = mom_action(p0) + action_fn(gauge)
     g1, p1 = integrator(action_fn, gauge, p0, n_steps, dt)
     h1 = mom_action(p1) + action_fn(g1)

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.shift import shift
-from ..ops.su3 import dagger, mat_mul
+from ..ops.su3 import dagger, inv_sqrt_herm3_pairs, is_pairs, mat_mul
 
 
 class HisqCoeffs(NamedTuple):
@@ -116,6 +116,13 @@ def unitarize_links(v: jnp.ndarray) -> jnp.ndarray:
     Differentiable (eigh JVP) — the HISQ-force path relies on this.
     """
     h = mat_mul(dagger(v), v)                      # Hermitian pos. def.
+    if is_pairs(v):
+        # complex-free AND differentiable: Cayley-Hamilton + Cardano on
+        # the real invariants (the reference's own unitarize recipe).  An
+        # eigh of the interleaved 6x6 embedding also computes the value,
+        # but its exactly-doubled spectrum makes the eigh JVP 0/0 — the
+        # HISQ force would be NaN.
+        return mat_mul(v, inv_sqrt_herm3_pairs(h))
     evals, evecs = jnp.linalg.eigh(h)
     inv_sqrt = jnp.einsum(
         "...ab,...b,...cb->...ac", evecs,

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 from ..ops.fmunu import PLANES, field_strength
 from ..ops.shift import shift
-from ..ops.su3 import dagger, mat_mul, trace
+from ..ops.su3 import dagger, is_pairs, mat_mul, re_trace, trace
 
 
 def plaquette_field(gauge: jnp.ndarray, mu: int, nu: int) -> jnp.ndarray:
@@ -26,7 +26,7 @@ def plaquette(gauge: jnp.ndarray):
     """(mean, spatial, temporal) normalised Re tr P / 3 (plaqQuda order)."""
     sp, tm = [], []
     for mu, nu in PLANES:
-        p = jnp.mean(trace(plaquette_field(gauge, mu, nu)).real) / 3.0
+        p = jnp.mean(re_trace(plaquette_field(gauge, mu, nu))) / 3.0
         (tm if nu == 3 else sp).append(p)
     s = sum(sp) / len(sp)
     t = sum(tm) / len(tm)
@@ -36,12 +36,15 @@ def plaquette(gauge: jnp.ndarray):
 def polyakov_loop(gauge: jnp.ndarray):
     """Volume-averaged trace of the temporal Wilson line
     (lib/gauge_polyakov_loop.cu).  Returns complex <tr L>/3."""
-    u_t = gauge[3]                    # (T,Z,Y,X,3,3)
+    u_t = gauge[3]                    # (T,Z,Y,X,3,3) or (...,3,3,2)
     T = u_t.shape[0]
     line = u_t[0]
     for t in range(1, T):
         line = mat_mul(line, u_t[t])
-    return jnp.mean(trace(line)) / 3.0
+    tr = trace(line)
+    if is_pairs(gauge):               # pair scalar: average the sites only
+        return jnp.mean(tr, axis=tuple(range(tr.ndim - 1))) / 3.0
+    return jnp.mean(tr) / 3.0
 
 
 def qcharge_density(gauge: jnp.ndarray) -> jnp.ndarray:
@@ -51,10 +54,10 @@ def qcharge_density(gauge: jnp.ndarray) -> jnp.ndarray:
     f = field_strength(gauge)   # Hermitian F_h; lattice F = i F_h
     # eps contraction over the 6 planes: (01)(23) - (02)(13) + (03)(12)
     fxy, fxz, fxt, fyz, fyt, fzt = (f[i] for i in range(6))
-    dens = (trace(mat_mul(fxy, fzt)) - trace(mat_mul(fxz, fyt))
-            + trace(mat_mul(fxt, fyz)))
+    dens = (re_trace(mat_mul(fxy, fzt)) - re_trace(mat_mul(fxz, fyt))
+            + re_trace(mat_mul(fxt, fyz)))
     # tr(F^latt F^latt) = -tr(F_h F_h); overall factor 8 from eps pairs
-    return -8.0 * dens.real / (32.0 * math.pi ** 2)
+    return -8.0 * dens / (32.0 * math.pi ** 2)
 
 
 def qcharge(gauge: jnp.ndarray):
@@ -65,7 +68,7 @@ def energy(gauge: jnp.ndarray):
     """(total, spatial E, temporal B-ish) field-strength energy
     E = sum tr F^2 (gauge_qcharge.cuh qcharge+energy mode)."""
     f = field_strength(gauge)
-    e = [jnp.sum(trace(mat_mul(f[i], f[i])).real) for i in range(6)]
+    e = [jnp.sum(re_trace(mat_mul(f[i], f[i]))) for i in range(6)]
     spatial = e[0] + e[1] + e[3]   # xy, xz, yz
     temporal = e[2] + e[4] + e[5]  # xt, yt, zt
     return spatial + temporal, spatial, temporal

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.shift import shift
-from ..ops.su3 import dagger, mat_mul, trace
+from ..ops.su3 import dagger, eye_like, is_pairs, mat_mul, re_trace, trace
 
 
 def _shift_by(arr: jnp.ndarray, disp) -> jnp.ndarray:
@@ -66,8 +66,7 @@ def wilson_line(gauge: jnp.ndarray, path: Sequence[int],
             disp[mu] -= 1
         W = link if W is None else mat_mul(link, W)
     if W is None:
-        eye = jnp.eye(3, dtype=gauge.dtype)
-        W = jnp.broadcast_to(eye, gauge.shape[1:])
+        W = eye_like(gauge[0])
     if any(start_disp):
         W = _shift_by(W, start_disp)
     return W, tuple(disp)
@@ -88,7 +87,12 @@ def gauge_loop_trace(gauge: jnp.ndarray, paths: Sequence[Sequence[int]],
         if any(d % e for d, e in zip(disp, ext)):
             # loops may close through the torus (Polyakov lines)
             raise ValueError(f"path {path} does not close: {disp}")
-        out.append(c * jnp.sum(trace(W)))
+        tr = trace(W)
+        if is_pairs(W):          # pair scalar: sum the site axes only
+            tr = jnp.sum(tr, axis=tuple(range(tr.ndim - 1)))
+        else:
+            tr = jnp.sum(tr)
+        out.append(c * tr)
     return jnp.stack(out)
 
 
@@ -111,7 +115,7 @@ def gauge_path_action(gauge: jnp.ndarray,
         start[mu] = 1
         for path, c in zip(input_path_buf[mu], coeffs):
             W, _ = wilson_line(gauge, path, start)
-            s = s + c * jnp.sum(trace(mat_mul(gauge[mu], W)).real)
+            s = s + c * jnp.sum(re_trace(mat_mul(gauge[mu], W)))
     return s
 
 

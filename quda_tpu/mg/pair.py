@@ -39,7 +39,9 @@ import jax.numpy as jnp
 from ..fields.geometry import axis_of_mu
 from ..ops import blas
 from ..ops import gamma as g
-from ..ops.pair import (color_mul_pairs, dagger_pairs, spin_mul_pairs,
+from ..ops.pair import (color_mul_pairs, dagger_pairs,
+                        deinterleave_mat as _deinterleave,
+                        interleave_mat as _interleave, spin_mul_pairs,
                         to_pairs)
 from ..ops.shift import shift
 from .coarse import DIRS
@@ -78,22 +80,6 @@ def _pair_ein(spec: str, a: jnp.ndarray, b: jnp.ndarray,
     return jnp.stack([re, im], axis=-1)
 
 
-def _interleave(m_pairs: jnp.ndarray) -> jnp.ndarray:
-    """(..., N, M, 2) pair matrix -> (..., 2N, 2M) real embedding with
-    entry blocks [[re,-im],[im,re]]."""
-    mr, mi = m_pairs[..., 0], m_pairs[..., 1]
-    blocks = jnp.stack([jnp.stack([mr, -mi], axis=-1),
-                        jnp.stack([mi, mr], axis=-1)], axis=-2)
-    # (..., N, M, a, b) -> (..., N, a, M, b) -> (..., 2N, 2M)
-    blocks = jnp.moveaxis(blocks, -2, -3)
-    s = blocks.shape
-    return blocks.reshape(s[:-4] + (2 * s[-4], 2 * s[-2]))
-
-
-def _deinterleave(m: jnp.ndarray) -> jnp.ndarray:
-    """(..., 2N, 2M) embedding -> (..., N, M, 2) pairs (reads the first
-    column of each 2x2 block)."""
-    return jnp.stack([m[..., 0::2, 0::2], m[..., 1::2, 0::2]], axis=-1)
 
 
 def _cholqr_pass(cols: jnp.ndarray) -> jnp.ndarray:

@@ -60,8 +60,10 @@ def apply_staggered_phases(gauge: jnp.ndarray, geom: LatticeGeometry,
     eta_mu(x) never depends on x_mu itself, so the same site phase is
     correct for the nhop=3 long links; only the boundary depth differs.
     """
+    from .su3 import is_pairs
     eta = jnp.asarray(staggered_phases_milc(geom))
-    out = gauge * eta[..., None, None].astype(gauge.dtype)
+    extra = 3 if is_pairs(gauge) else 2      # (3,3[,2]) trailing axes
+    out = gauge * eta.reshape(eta.shape + (1,) * extra).astype(gauge.dtype)
     if antiperiodic_t:
         out = apply_t_boundary(out, geom, -1, depth=nhop)
     return out

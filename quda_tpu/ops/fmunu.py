@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .shift import shift
-from .su3 import dagger, mat_mul
+from .su3 import dagger, is_pairs, mat_i, mat_mul, trace
 
 PLANES = ((0, 1), (0, 2), (0, 3), (1, 2), (1, 3), (2, 3))
 
@@ -64,8 +64,12 @@ def field_strength(gauge: jnp.ndarray, shift_fn=shift) -> jnp.ndarray:
     fs = []
     for mu, nu in PLANES:
         q = _leaf_sum(gauge, mu, nu, shift_fn)
-        f = (-0.125j) * (q - dagger(q))
-        tr = jnp.einsum("...aa->...", f) / 3.0
-        f = f - tr[..., None, None] * jnp.eye(3, dtype=gauge.dtype)
+        f = -0.125 * mat_i(q - dagger(q))
+        tr = trace(f) / 3.0
+        if is_pairs(gauge):
+            f = f - tr[..., None, None, :] * jnp.eye(
+                3, dtype=gauge.dtype)[..., None]
+        else:
+            f = f - tr[..., None, None] * jnp.eye(3, dtype=gauge.dtype)
         fs.append(f)
     return jnp.stack(fs)

@@ -86,15 +86,40 @@ def dagger_pairs(u: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([ut[..., 0], -ut[..., 1]], axis=-1)
 
 
+def interleave_mat(m_pairs: jnp.ndarray) -> jnp.ndarray:
+    """(..., N, M, 2) pair matrix -> (..., 2N, 2M) real embedding with
+    2x2 entry blocks [[re,-im],[im,re]].
+
+    The embedding is a ring homomorphism C -> R^{2x2}: products,
+    inverses, Cholesky factors, and REAL functions of Hermitian matrices
+    (f(H) = E f(L) E^dag with f real) all commute with it, which is how
+    complex eigh/cholesky/inv are evaluated on runtimes without complex
+    support (mg/pair.py CholQR2, gauge reunitarisation)."""
+    mr, mi = m_pairs[..., 0], m_pairs[..., 1]
+    blocks = jnp.stack([jnp.stack([mr, -mi], axis=-1),
+                        jnp.stack([mi, mr], axis=-1)], axis=-2)
+    blocks = jnp.moveaxis(blocks, -2, -3)   # (..., N, a, M, b)
+    s = blocks.shape
+    return blocks.reshape(s[:-4] + (2 * s[-4], 2 * s[-2]))
+
+
+def deinterleave_mat(m: jnp.ndarray) -> jnp.ndarray:
+    """(..., 2N, 2M) embedding -> (..., N, M, 2) pairs (reads the first
+    column of each 2x2 block)."""
+    return jnp.stack([m[..., 0::2, 0::2], m[..., 1::2, 0::2]], axis=-1)
+
+
 def color_mul_pairs(u: jnp.ndarray, p: jnp.ndarray,
                     out_dtype=F32) -> jnp.ndarray:
     """(..., a, b, 2) x (..., s, b, 2) -> (..., s, a, 2).
 
-    Four real einsums with f32 accumulation — the TPU-native complex
-    multiply for low-precision storage.
+    Four real einsums accumulated at (at least) f32 — the TPU-native
+    complex multiply for low-precision storage; f64 inputs accumulate
+    at f64 (CPU reference paths).
     """
+    acc = jnp.promote_types(F32, u.dtype)
     ein = functools.partial(jnp.einsum, "...ab,...sb->...sa",
-                            preferred_element_type=F32)
+                            preferred_element_type=acc)
     ur, ui = u[..., 0], u[..., 1]
     pr, pi = p[..., 0], p[..., 1]
     re = ein(ur, pr) - ein(ui, pi)

@@ -29,6 +29,13 @@ from .su3 import dagger
 
 
 def _color_mul(u, psi):
+    from .su3 import is_pairs
+    if is_pairs(u):
+        # pair representation: complex-free stencil (TPU runtimes without
+        # complex64; the HISQ-force AD chain differentiates through this)
+        from .pair import color_mul_pairs
+        out_dtype = jnp.promote_types(psi.dtype, jnp.float32)
+        return color_mul_pairs(u, psi, out_dtype=out_dtype)
     return jnp.einsum("...ab,...sb->...sa", u, psi)
 
 

@@ -33,27 +33,91 @@ _l[7, 2, 2] = -2 / np.sqrt(3)
 GELL_MANN = _l
 
 
+# -- representation dispatch ------------------------------------------------
+#
+# Every primitive below is POLYMORPHIC over two matrix representations:
+#   complex  (..., N, N)      — the canonical fields
+#   pairs    (..., N, N, 2)   — real re/im pair arrays, the representation
+#                               TPU runtimes without complex64 execute
+# so the gauge-sector formulas written on top of them (staples, fattening,
+# plaquettes, AD forces — gauge/*.py) run unchanged in either.  The pair
+# recipes follow ops/pair.py; Hermitian matrix functions go through the
+# interleaved real embedding (ops/pair.interleave_mat).
+
+def is_pairs(m: jnp.ndarray) -> bool:
+    """True iff m is a pair-form matrix field (..., N, N, 2)."""
+    return (not jnp.issubdtype(m.dtype, jnp.complexfloating)
+            and m.ndim >= 3 and m.shape[-1] == 2
+            and m.shape[-2] == m.shape[-3])
+
+
 def dagger(m: jnp.ndarray) -> jnp.ndarray:
     """Hermitian conjugate over the trailing (c,c) axes."""
+    if is_pairs(m):
+        mt = jnp.swapaxes(m, -3, -2)
+        return jnp.stack([mt[..., 0], -mt[..., 1]], axis=-1)
     return jnp.conjugate(jnp.swapaxes(m, -1, -2))
 
 
 def mat_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    if is_pairs(a):
+        ar, ai = a[..., 0], a[..., 1]
+        br, bi = b[..., 0], b[..., 1]
+        re = (jnp.einsum("...ab,...bc->...ac", ar, br)
+              - jnp.einsum("...ab,...bc->...ac", ai, bi))
+        im = (jnp.einsum("...ab,...bc->...ac", ar, bi)
+              + jnp.einsum("...ab,...bc->...ac", ai, br))
+        return jnp.stack([re, im], axis=-1)
     return jnp.einsum("...ab,...bc->...ac", a, b)
 
 
 def trace(m: jnp.ndarray) -> jnp.ndarray:
+    """Complex trace: a complex scalar, or a (..., 2) pair scalar."""
+    if is_pairs(m):
+        return jnp.einsum("...aap->...p", m)
     return jnp.einsum("...aa->...", m)
+
+
+def re_trace(m: jnp.ndarray) -> jnp.ndarray:
+    """Re tr m as a plain real array in BOTH representations (use this
+    instead of trace(m).real, which silently keeps the pair axis)."""
+    if is_pairs(m):
+        return jnp.einsum("...aa->...", m[..., 0])
+    return jnp.real(jnp.einsum("...aa->...", m))
+
+
+def mat_i(m: jnp.ndarray) -> jnp.ndarray:
+    """i * m in either representation (a bare ``1j *`` would silently
+    promote a pair array to complex)."""
+    if is_pairs(m):
+        return jnp.stack([-m[..., 1], m[..., 0]], axis=-1)
+    return 1j * m
+
+
+def eye_like(m: jnp.ndarray) -> jnp.ndarray:
+    """Identity matrix broadcast to m's shape, in m's representation."""
+    if is_pairs(m):
+        n = m.shape[-2]
+        e = jnp.zeros((n, n, 2), m.dtype).at[:, :, 0].set(jnp.eye(n, dtype=m.dtype))
+        return jnp.broadcast_to(e, m.shape)
+    return jnp.broadcast_to(jnp.eye(m.shape[-1], dtype=m.dtype), m.shape)
 
 
 def random_hermitian_traceless(key, shape, n=3, dtype=jnp.complex128):
     """Gaussian traceless Hermitian matrices H = sum_a xi_a T_a, xi~N(0,1).
 
     This is the HMC momentum distribution (reference: lib/gauge_random.cu
-    gaussGaugeQuda with the momentum flag).
+    gaussGaugeQuda with the momentum flag).  A FLOATING dtype requests the
+    pair representation (..., 3, 3, 2) — the generators' re/im parts are
+    real constants, so the momenta are sampled complex-free.
     """
-    real_dtype = jnp.finfo(dtype).dtype if jnp.issubdtype(
-        dtype, jnp.floating) else jnp.real(jnp.zeros((), dtype)).dtype
+    if jnp.issubdtype(dtype, jnp.floating):
+        xi = jax.random.normal(key, shape + (8,), dtype=dtype)
+        gen = jnp.asarray(
+            np.stack([GELL_MANN.real, GELL_MANN.imag], axis=-1) / 2.0,
+            dtype=dtype)
+        return jnp.einsum("...a,aijp->...ijp", xi, gen)
+    real_dtype = jnp.real(jnp.zeros((), dtype)).dtype
     xi = jax.random.normal(key, shape + (8,), dtype=real_dtype)
     gen = jnp.asarray(GELL_MANN / 2.0, dtype=dtype)
     return jnp.einsum("...a,aij->...ij", xi.astype(dtype), gen)
@@ -65,10 +129,11 @@ def expm_su3(h: jnp.ndarray, order: int = 16) -> jnp.ndarray:
     Used for the HMC gauge update U <- exp(i eps p) U (reference:
     lib/gauge_update_quda.cu, kernels/gauge_update.cuh) and stout smearing.
     A fixed 6-squaring/Taylor scheme is exact to machine precision for the
-    step sizes HMC uses and is branch-free (jit/TPU friendly).
+    step sizes HMC uses and is branch-free (jit/TPU friendly).  Works on
+    complex or pair-form h (mat_i/eye_like/mat_mul are polymorphic).
     """
-    x = 1j * h / (2.0 ** 6)
-    eye = jnp.broadcast_to(jnp.eye(h.shape[-1], dtype=h.dtype), h.shape)
+    x = mat_i(h) / (2.0 ** 6)
+    eye = eye_like(h)
     term = eye
     acc = eye
     for k in range(1, order):
@@ -90,6 +155,104 @@ def random_su3(key, shape, dtype=jnp.complex128, scale: float = 1.0):
     return expm_su3(scale * h)
 
 
+def det3_pairs(m: jnp.ndarray) -> jnp.ndarray:
+    """det of a (..., 3, 3, 2) pair matrix as a (..., 2) pair scalar."""
+    def cmul(x, y):
+        return jnp.stack([x[..., 0] * y[..., 0] - x[..., 1] * y[..., 1],
+                          x[..., 0] * y[..., 1] + x[..., 1] * y[..., 0]],
+                         axis=-1)
+    a, b, c = m[..., 0, 0, :], m[..., 0, 1, :], m[..., 0, 2, :]
+    d, e, f = m[..., 1, 0, :], m[..., 1, 1, :], m[..., 1, 2, :]
+    g, h, i = m[..., 2, 0, :], m[..., 2, 1, :], m[..., 2, 2, :]
+    return (cmul(a, cmul(e, i) - cmul(f, h))
+            - cmul(b, cmul(d, i) - cmul(f, g))
+            + cmul(c, cmul(d, h) - cmul(e, g)))
+
+
+def inv_sqrt_herm3_pairs(h: jnp.ndarray) -> jnp.ndarray:
+    """H^{-1/2} for a (..., 3, 3, 2) pair-form Hermitian positive-definite
+    matrix, by Cayley-Hamilton: f(H) = a0 I + a1 H + a2 H^2 with the a_i
+    solved from f(lambda_i) = lambda_i^{-1/2} at the three eigenvalues,
+    which come from Cardano's trigonometric form on the (real) invariants.
+
+    This is the reference's own recipe (lib/unitarize_links_quda.cu,
+    include/svd_quda.h use Cayley-Hamilton + closed-form roots) and —
+    unlike an eigh of the interleaved 6x6 embedding, whose eigenvalues are
+    exactly doubled — it is cleanly DIFFERENTIABLE: jax.grad flows through
+    real scalar arithmetic only, so the HISQ force works in pair form.
+    """
+    h2 = mat_mul(h, h)
+    tr1 = re_trace(h)
+    tr2 = re_trace(h2)
+    d = det3_pairs(h)[..., 0]            # det of Hermitian h is real
+    # characteristic polynomial: l^3 + a l^2 + b l + c
+    a = -tr1
+    b = 0.5 * (tr1 * tr1 - tr2)
+    c = -d
+    # depressed cubic x^3 + p x + r with l = x - a/3
+    p = b - a * a / 3.0
+    r = 2.0 * a ** 3 / 27.0 - a * b / 3.0 + c
+    # three real roots (H Hermitian): trigonometric method.  p = r = 0
+    # exactly when the spectrum is fully degenerate (h = c*I: the unit
+    # cold-start gauge!) — guard the 0/0 with a safe denominator so both
+    # the value AND the gradient stay finite (jnp.where alone would leak
+    # NaN through the untaken branch's gradient).
+    m = 2.0 * jnp.sqrt(jnp.maximum(-p / 3.0, 1e-30))
+    pm = p * m
+    # RELATIVE near-degeneracy test (pm scales as (mean eigenvalue *
+    # relative spread)^3): an absolute test leaves a band where
+    # d(r/pm)/d(pm) ~ r/pm^2 overflows to inf in f32 and the clipped
+    # arccos turns it into 0 * inf = NaN in the force
+    s_mean = jnp.maximum(tr1 / 3.0, 1e-30)
+    degenerate = jnp.abs(pm) < 1e-9 * s_mean ** 3
+    arg_raw = 3.0 * r / jnp.where(degenerate, 1.0, pm)
+    arg = jnp.clip(jnp.where(degenerate, 0.0, arg_raw),
+                   -1.0 + 1e-7, 1.0 - 1e-7)   # keep arccos' finite
+    theta = jnp.arccos(arg) / 3.0
+    two_pi_3 = 2.0 * jnp.pi / 3.0
+    lams = [jnp.maximum(m * jnp.cos(theta - k * two_pi_3) - a / 3.0,
+                        1e-18) for k in range(3)]
+
+    # f(H) = f(l0) I + f[l0,l1](H - l0) + f[l0,l1,l2](H - l0)(H - l1)
+    # via Newton divided differences with CONFLUENT limits: when two
+    # eigenvalues collide the difference quotient smoothly becomes the
+    # derivative, so degenerate and near-degenerate spectra (where a
+    # Vandermonde solve is singular) are exact instead of NaN.
+    def f(l):
+        return 1.0 / jnp.sqrt(l)
+
+    def df(l):                           # f'
+        return -0.5 * l ** -1.5
+
+    def ddf_half(l):                     # f''/2
+        return 0.375 * l ** -2.5
+
+    def dd1(la, lb):
+        diff = la - lb
+        near = jnp.abs(diff) < 1e-6 * (la + lb)
+        safe = jnp.where(near, 1.0, diff)
+        return jnp.where(near, df(0.5 * (la + lb)),
+                         (f(la) - f(lb)) / safe)
+
+    l0, l1, l2 = lams
+    d01 = dd1(l0, l1)
+    d12 = dd1(l1, l2)
+    diff02 = l0 - l2
+    near02 = jnp.abs(diff02) < 1e-6 * (l0 + l2)
+    safe02 = jnp.where(near02, 1.0, diff02)
+    d012 = jnp.where(near02, ddf_half((l0 + l1 + l2) / 3.0),
+                     (d01 - d12) / safe02)
+
+    def sc(x):
+        return x[..., None, None, None]
+
+    eye = eye_like(h)
+    h_l0 = h - sc(l0) * eye
+    h_l1 = h - sc(l1) * eye
+    return (sc(f(l0)) * eye + sc(d01) * h_l0
+            + sc(d012) * mat_mul(h_l0, h_l1))
+
+
 def project_su3(u: jnp.ndarray, iters: int = 2) -> jnp.ndarray:
     """Project a near-SU(3) matrix back onto SU(3).
 
@@ -98,18 +261,43 @@ def project_su3(u: jnp.ndarray, iters: int = 2) -> jnp.ndarray:
     the TPU-friendly replacement for QUDA's SVD-based reunitarization
     (include/svd_quda.h:616) for links that are already close to unitary
     (smearing / gauge updates).  HISQ force differentiation uses its own
-    routine in gauge/hisq.py.
+    routine in gauge/hisq.py.  Pair-form inputs run complex-free: inverses
+    through the interleaved real embedding, the det phase by angle/3.
     """
+    from .pair import deinterleave_mat, interleave_mat
+    pairs = is_pairs(u)
     w = u
     for _ in range(iters + 2):
         # Newton iteration for polar decomposition: w <- 0.5 (w + w^-dag)
-        w = 0.5 * (w + jnp.linalg.inv(dagger(w)))
+        if pairs:
+            winv = deinterleave_mat(jnp.linalg.inv(
+                interleave_mat(dagger(w))))
+        else:
+            winv = jnp.linalg.inv(dagger(w))
+        w = 0.5 * (w + winv)
+    if pairs:
+        det = det3_pairs(w)
+        # det is (close to) unit modulus; det^{-1/3} = r^{-1/3} e^{-i a/3}
+        r = jnp.sqrt(det[..., 0] ** 2 + det[..., 1] ** 2)
+        ang = jnp.arctan2(det[..., 1], det[..., 0])
+        mag = r ** (-1.0 / 3.0)
+        ph = jnp.stack([mag * jnp.cos(ang / 3.0),
+                        -mag * jnp.sin(ang / 3.0)], axis=-1)
+        wr, wi = w[..., 0], w[..., 1]
+        pr = ph[..., None, None, 0]
+        pi = ph[..., None, None, 1]
+        return jnp.stack([wr * pr - wi * pi, wr * pi + wi * pr], axis=-1)
     det = jnp.linalg.det(w)
     phase = det ** (-1.0 / 3.0)
     return w * phase[..., None, None]
 
 
 def unit_gauge(shape, dtype=jnp.complex128):
+    """Identity links; a floating dtype gives the pair representation."""
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        e = jnp.zeros((3, 3, 2), dtype).at[:, :, 0].set(
+            jnp.eye(3, dtype=dtype))
+        return jnp.broadcast_to(e, shape + (3, 3, 2))
     return jnp.broadcast_to(jnp.eye(3, dtype=dtype), shape + (3, 3))
 
 
