@@ -303,18 +303,32 @@ class MG:
                 # gather the coarsest rhs onto every device; the bottom
                 # GCR then runs collective-free and redundantly, and the
                 # prolong's input resharding is a single scatter.  Needs
-                # an active mesh context (``with mesh:`` around the jit).
+                # an active mesh: either the new-style abstract mesh
+                # (jax.sharding.use_mesh) or a concrete ``with mesh:``
+                # context (whose mesh get_abstract_mesh does NOT see).
+                from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P
+                spec = P(*([None] * rc.ndim))
                 amesh = jax.sharding.get_abstract_mesh()
+                pmesh = None
+                try:
+                    from jax._src.mesh import thread_resources
+                    pm = thread_resources.env.physical_mesh
+                    if pm is not None and not pm.empty:
+                        pmesh = pm
+                except Exception:
+                    pass
                 if amesh is not None and amesh.shape_tuple:
+                    rc = jax.lax.with_sharding_constraint(rc, spec)
+                elif pmesh is not None:
                     rc = jax.lax.with_sharding_constraint(
-                        rc, P(*([None] * rc.ndim)))
+                        rc, NamedSharding(pmesh, spec))
                 elif not getattr(self, "_warned_replicate", False):
                     import warnings
                     warnings.warn(
                         "coarse_replicate=True has no effect without an "
                         "active mesh context (wrap the jit in `with "
-                        "mesh:`)", stacklevel=2)
+                        "mesh:` or jax.sharding.use_mesh)", stacklevel=2)
                     self._warned_replicate = True
             ec = gcr_fixed(coarse.M, rc, nkrylov=p.coarse_solver_iters,
                            cycles=p.coarse_solver_cycles)

@@ -450,10 +450,25 @@ def _stag_pass_v3(links_pl, psi_pl, X, nhop, bz, interpret, eo=None,
     )(*args)
 
 
+def _require_naik_z(Z: int, with_long: bool):
+    """The Naik pass declares 3-row boundary BlockSpecs; on a Z < 3 axis
+    those exceed the array dim (and a 3-hop on extent < 3 aliases to a
+    shorter hop) — reject clearly instead of letting pallas fail
+    opaquely.  The XLA stencil path serves such degenerate lattices.
+    Checked in the entry points too so an explicit block_z cannot bypass
+    it."""
+    if with_long and Z < 3:
+        raise ValueError(
+            f"improved-staggered v3 pallas kernel needs Z >= 3 for the "
+            f"3-hop Naik boundary rows; got Z={Z} (use the XLA stencil "
+            f"path for degenerate extents)")
+
+
 def _pick_bz_v3(Z, YX, dtype, with_long: bool, eo: bool = False):
     """z-block for the v3 passes: multiple of 3 when the Naik pass runs
     (so its 3-row boundary inputs align to block boundaries)."""
     planes = _STAG_PLANES_V3_EO if eo else _STAG_PLANES_V3
+    _require_naik_z(Z, with_long)
     bz = _pick_bz(Z, YX, dtype, planes=planes,
                   min_bz=3 if (with_long and Z > 3) else 1)
     if with_long and bz != Z and bz % 3 != 0:
@@ -479,6 +494,7 @@ def dslash_staggered_pallas_v3(fat_pl: jnp.ndarray, psi_pl: jnp.ndarray,
     hops — no ``backward_links`` precompute or resident copies (saves
     576 B/site of HBM reads for the improved operator)."""
     _, _, _, Z, YX = psi_pl.shape
+    _require_naik_z(Z, long_pl is not None)
     if block_z is not None:
         bz = block_z
         if Z % bz != 0:
@@ -508,6 +524,7 @@ def dslash_staggered_eo_pallas_v3(fat_here_pl, fat_there_pl, psi_pl, dims,
     T, Z, Y, X = dims
     Xh = X // 2
     _, _, _, _, YXh = psi_pl.shape
+    _require_naik_z(Z, long_here_pl is not None)
     if block_z is not None:
         bz = block_z
         if Z % bz != 0:

@@ -24,15 +24,19 @@ from .cg import SolverResult
 
 
 def _check_nrhs(n: int):
-    """QUDA_TPU_MAX_MULTI_RHS cap (reference: QUDA_MAX_MULTI_RHS, a
-    compile-time instantiation bound there; a guard against
-    accidentally batching past device memory here)."""
+    """QUDA_TPU_MAX_MULTI_RHS advisory cap.  The reference's
+    QUDA_MAX_MULTI_RHS is a compile-time instantiation bound, not a
+    runtime rejection of user batches — so WARN (the risk is batching
+    past device memory) rather than refuse."""
+    import warnings
+
     from ..utils import config as qconf
     cap = qconf.get("QUDA_TPU_MAX_MULTI_RHS", fresh=True)
     if n > cap:
-        raise ValueError(
+        warnings.warn(
             f"{n} right-hand sides exceeds QUDA_TPU_MAX_MULTI_RHS={cap}; "
-            "raise the knob or chunk the sources")
+            "device memory may not hold the batch — raise the knob to "
+            "silence this warning or chunk the sources", stacklevel=3)
 
 
 def batched_cg(matvec: Callable, B: jnp.ndarray, tol: float = 1e-10,

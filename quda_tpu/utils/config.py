@@ -73,13 +73,15 @@ _register("QUDA_TPU_TUNE_VERSION_CHECK", "bool", True,
           "jax/backend version", reference="QUDA_TUNE_VERSION_CHECK")
 
 # -- dslash implementation selection ---------------------------------------
-_register("QUDA_TPU_PACKED", "str", "",
+_register("QUDA_TPU_PACKED", "choice", "",
           "force ('1') or forbid ('0') the TPU-native packed device "
           "order in API solves; empty = platform default (on for TPU)",
+          ("", "0", "1"),
           reference="native FloatN field orders")
-_register("QUDA_TPU_PALLAS", "str", "",
+_register("QUDA_TPU_PALLAS", "choice", "",
           "force ('1') or forbid ('0') pallas dslash kernels in API "
           "solves; empty = autotuned choice",
+          ("", "0", "1"),
           reference="QUDA_ENABLE_DSLASH_POLICY")
 _register("QUDA_TPU_PALLAS_VERSION", "int", 3,
           "pallas kernel generation: 3 = scatter-form backward hops "

@@ -23,6 +23,55 @@ jax.config.update("jax_enable_x64", True)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# -- smoke tier --------------------------------------------------------------
+# One fast, representative case per subsystem (reference: ctest labels,
+# tests/CMakeLists.txt:414-470 tier quick checks the same way).  Run with
+#   python -m pytest tests/ -m smoke -q        (~4 minutes)
+# The full suite remains the default (no marker filter).
+SMOKE = {
+    "test_wilson.py": None,                 # whole file is fast oracles
+    "test_core.py": None,
+    "test_config.py": None,
+    "test_blas_api.py": None,
+    "test_utils.py": None,
+    "test_packed.py": ["test_pack_round_trips",
+                       "test_packed_eo_dslash_matches_canonical"],
+    "test_cg.py": ["test_cg_even_odd_preconditioned"],
+    "test_staggered.py": ["test_dslash_matches_host"],
+    "test_clover.py": ["test_clover_apply_matches_host"],
+    "test_twisted.py": ["test_twisted_mass_adjoint"],
+    "test_domain_wall.py": ["test_mobius_matches_host"],
+    "test_hisq.py": ["test_unitarize", "test_hisq_pipeline"],
+    "test_gauge_hmc.py": ["test_force_matches_finite_difference",
+                          "test_plaquette_random_range"],
+    "test_pair_gauge.py": ["test_su3_primitives_match",
+                           "test_observables_and_actions_match"],
+    "test_pair_mg.py": ["test_cholqr2_orthonormal"],
+    "test_eig.py": ["test_trlm_smallest_vs_arpack"],
+    "test_multishift.py": ["test_multishift_matches_individual_solves"],
+    "test_mixed.py": ["test_pair_stencil_matches_complex"],
+    "test_parallel.py": ["test_gspmd_dslash_matches_single_device"],
+    "test_interface.py": ["test_mat_and_dslash"],
+    "test_lime_io.py": ["test_lime_record_framing",
+                        "test_gauge_lime_round_trip"],
+    "test_blockfloat.py": ["test_bf16_roundtrip_accuracy",
+                           "test_int8_roundtrip_accuracy"],
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "smoke: fast one-per-subsystem tier (~4 min total)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        fname = os.path.basename(str(item.fspath))
+        sel = SMOKE.get(fname, False)
+        if sel is None or (sel and any(item.name.startswith(n)
+                                       for n in sel)):
+            item.add_marker(pytest.mark.smoke)
+
 
 @pytest.fixture
 def rng():

@@ -72,12 +72,15 @@ def test_describe_lists_every_knob():
 
 
 def test_max_multi_rhs_caps_block_solvers(monkeypatch):
+    # advisory warn-and-proceed (the reference's QUDA_MAX_MULTI_RHS is a
+    # compile-time instantiation bound, not a runtime batch rejection)
     from quda_tpu.solvers.block import batched_cg
     monkeypatch.setenv("QUDA_TPU_MAX_MULTI_RHS", "2")
     qconf.reset_cache()
     B = jnp.ones((3, 8), jnp.complex128)
-    with pytest.raises(ValueError, match="MAX_MULTI_RHS"):
-        batched_cg(lambda x: x, B)
+    with pytest.warns(UserWarning, match="MAX_MULTI_RHS"):
+        res = batched_cg(lambda x: x, B)
+    assert res.x.shape == B.shape          # the batch still ran
 
 
 def test_sloppy_precision_override(monkeypatch):

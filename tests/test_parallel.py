@@ -233,12 +233,16 @@ def test_mg_vcycle_replicated_coarsest(data):
     from quda_tpu.models.wilson import DiracWilson
     gauge, psi = data
     d = DiracWilson(gauge, GEOM, 0.12)
-    params = [MGLevelParam(block=(2, 2, 2, 2), n_vec=4, setup_iters=30,
-                           coarse_replicate=True)]
+    # reference V-cycle WITHOUT the replication flag (the flag warns
+    # when no mesh is active — only the meshed run below should use it)
+    params = [MGLevelParam(block=(2, 2, 2, 2), n_vec=4, setup_iters=30)]
     mg = MG(d, GEOM, params)
     bc = mg.adapter.to_chiral(psi)
     want = np.asarray(mg.vcycle(0, bc))
 
+    mg.levels[0]["param"] = MGLevelParam(
+        block=(2, 2, 2, 2), n_vec=4, setup_iters=30,
+        coarse_replicate=True)
     mesh = make_lattice_mesh()
     bc_sh = jax.device_put(
         bc, NamedSharding(mesh, P("t", "z", "y", "x", None, None)))
