@@ -36,6 +36,7 @@ class DiracClover(Dirac):
         self.kappa = kappa
         self.csw = csw
         self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.antiperiodic_t = antiperiodic_t
         # F_munu leaves use the PHYSICAL links (no BC phase): QUDA computes
         # the clover term before applying fermion boundary conditions.
         self.clover = clover_blocks(gauge, kappa * csw / 2.0)
@@ -72,6 +73,7 @@ class DiracCloverPC(DiracPC):
         self.csw = csw
         self.matpc = matpc
         g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.antiperiodic_t = antiperiodic_t
         self.gauge_eo = wops.split_gauge_eo(g, geom)
         blocks = clover_blocks(gauge, kappa * csw / 2.0)
         a_e, a_o = even_odd_split(blocks, geom)
@@ -166,7 +168,9 @@ class DiracCloverPCPairs(_SchurPairOpBase):
                  use_pallas: bool = False, pallas_interpret: bool = False):
         from ..ops import wilson_packed as wpk
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
-                        store_dtype, use_pallas, pallas_interpret)
+                        store_dtype, use_pallas, pallas_interpret,
+                        tb_sign=getattr(dpc, 'antiperiodic_t',
+                                        True))
         self.kappa = float(dpc.kappa)
         self.matpc = dpc.matpc
         self.clover_p_pp = pack_clover_pairs(dpc.clover[dpc.matpc],

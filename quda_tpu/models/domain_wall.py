@@ -58,6 +58,7 @@ class DiracMobius(Dirac):
         self.b5 = b5
         self.c5 = c5
         self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.antiperiodic_t = antiperiodic_t
         dw_diag = 4.0 - m5
         self.s_m5 = m5_sop(ls, b5 * dw_diag + 1.0, c5 * dw_diag - 1.0, mf)
         self.s_m5p = m5_sop(ls, b5, c5, mf)
@@ -103,6 +104,7 @@ class DiracMobiusPC(DiracPC):
         self.mf = mf
         self.matpc = matpc
         g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.antiperiodic_t = antiperiodic_t
         self.gauge_eo = wops.split_gauge_eo(g, geom)
         dw_diag = 4.0 - m5
         self.s_m5 = m5_sop(ls, b5 * dw_diag + 1.0, c5 * dw_diag - 1.0, mf)
@@ -210,7 +212,9 @@ class DiracMobiusPCPairs(_LsPairIOMixin, _PackedHopMixin):
         import numpy as np
         from ..ops import wilson_packed as wpk
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
-                        store_dtype, use_pallas, pallas_interpret)
+                        store_dtype, use_pallas, pallas_interpret,
+                        tb_sign=getattr(dpc, 'antiperiodic_t',
+                                        True))
         self.ls = dpc.ls
         self.matpc = dpc.matpc
 
@@ -446,6 +450,7 @@ class DiracDomainWall5DPC(DiracPC):
         self.kappa5 = 0.5 / (5.0 - m5)
         self.m5 = m5
         g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.antiperiodic_t = antiperiodic_t
         self.gauge_eo = wops.split_gauge_eo(g, geom)
 
     @staticmethod
@@ -567,7 +572,9 @@ class DiracDomainWall5DPCPairs(_LsPairIOMixin, _PackedHopMixin):
                  use_pallas: bool = False, pallas_interpret: bool = False):
         from ..ops import wilson_packed as wpk
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
-                        store_dtype, use_pallas, pallas_interpret)
+                        store_dtype, use_pallas, pallas_interpret,
+                        tb_sign=getattr(dpc, 'antiperiodic_t',
+                                        True))
         self.ls = dpc.ls
         self.mf = float(dpc.mf)
         self.m5 = float(dpc.m5)

@@ -54,6 +54,7 @@ class DiracTwistedMass(Dirac):
         self.mu = mu
         self.a = 2.0 * kappa * mu
         self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.antiperiodic_t = antiperiodic_t
 
     def D(self, psi):
         return wops.dslash_full(self.gauge, psi)
@@ -88,6 +89,7 @@ class DiracTwistedMassPC(DiracPC):
         self.a = 2.0 * kappa * mu
         self.matpc = matpc
         g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.antiperiodic_t = antiperiodic_t
         self.gauge_eo = wops.split_gauge_eo(g, geom)
 
     def D_to(self, psi, target_parity):
@@ -162,7 +164,9 @@ class DiracTwistedMassPCPairs(_SchurPairOpBase):
                  use_pallas: bool = False, pallas_interpret: bool = False):
         from ..ops import wilson_packed as wpk
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
-                        store_dtype, use_pallas, pallas_interpret)
+                        store_dtype, use_pallas, pallas_interpret,
+                        tb_sign=getattr(dpc, 'antiperiodic_t',
+                                        True))
         self.kappa = float(dpc.kappa)
         self.a = float(dpc.a)
         self.matpc = dpc.matpc
@@ -185,7 +189,9 @@ class DiracTwistedCloverPCPairs(_SchurPairOpBase):
         from ..ops import wilson_packed as wpk
         from .clover import pack_clover_pairs
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
-                        store_dtype, use_pallas, pallas_interpret)
+                        store_dtype, use_pallas, pallas_interpret,
+                        tb_sign=getattr(dpc, 'antiperiodic_t',
+                                        True))
         self.kappa = float(dpc.kappa)
         self.a = float(dpc.a)
         self.matpc = dpc.matpc
@@ -247,7 +253,9 @@ class DiracNdegTwistedMassPCPairs(_NdegPairsBase):
                  pallas_interpret: bool = False):
         from ..ops import wilson_packed as wpk
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
-                        store_dtype, use_pallas, pallas_interpret)
+                        store_dtype, use_pallas, pallas_interpret,
+                        tb_sign=getattr(dpc, 'antiperiodic_t',
+                                        True))
         self.kappa = float(dpc.kappa)
         self.a = float(dpc.a)
         self.b = float(dpc.b)
@@ -283,7 +291,9 @@ class DiracNdegTwistedCloverPCPairs(_NdegPairsBase):
         from ..ops import wilson_packed as wpk
         from .clover import pack_clover_pairs
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
-                        store_dtype, use_pallas, pallas_interpret)
+                        store_dtype, use_pallas, pallas_interpret,
+                        tb_sign=getattr(dpc, 'antiperiodic_t',
+                                        True))
         self.kappa = float(dpc.kappa)
         self.a = float(dpc.a)
         self.b = float(dpc.b)
@@ -337,6 +347,7 @@ class DiracNdegTwistedMass(Dirac):
         self.a = 2.0 * kappa * mu
         self.b = 2.0 * kappa * epsilon
         self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.antiperiodic_t = antiperiodic_t
 
     def D(self, psi):
         # vmap over the flavor axis (axis -3)
@@ -373,6 +384,7 @@ class DiracTwistedClover(Dirac):
         self.kappa = kappa
         self.a = 2.0 * kappa * mu
         self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.antiperiodic_t = antiperiodic_t
         self.clover = clover_blocks(gauge, kappa * csw / 2.0)
 
     def D(self, psi):
@@ -418,6 +430,7 @@ class DiracTwistedCloverPC(DiracPC):
         self.a = 2.0 * kappa * mu
         self.matpc = matpc
         g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.antiperiodic_t = antiperiodic_t
         self.gauge_eo = wops.split_gauge_eo(g, geom)
         blocks = clover_blocks(gauge, kappa * csw / 2.0)
         a_e, a_o = even_odd_split(blocks, geom)
@@ -490,6 +503,7 @@ class DiracNdegTwistedClover(Dirac):
         self.a = 2.0 * kappa * mu
         self.b = 2.0 * kappa * epsilon
         self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.antiperiodic_t = antiperiodic_t
         self.clover = clover_blocks(gauge, kappa * csw / 2.0)
 
     def D(self, psi):
@@ -544,6 +558,7 @@ class DiracNdegTwistedCloverPC(DiracPC):
         self.b = 2.0 * kappa * epsilon
         self.matpc = matpc
         g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.antiperiodic_t = antiperiodic_t
         self.gauge_eo = wops.split_gauge_eo(g, geom)
         blocks = clover_blocks(gauge, kappa * csw / 2.0)
         a_e, a_o = even_odd_split(blocks, geom)
@@ -636,6 +651,7 @@ class DiracNdegTwistedMassPC(DiracPC):
         self.b = 2.0 * kappa * epsilon
         self.matpc = matpc
         g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
+        self.antiperiodic_t = antiperiodic_t
         self.gauge_eo = wops.split_gauge_eo(g, geom)
 
     def D_to(self, psi, target_parity):

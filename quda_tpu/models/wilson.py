@@ -68,6 +68,7 @@ class DiracWilsonPC(DiracPC):
         self.geom = geom
         self.kappa = kappa
         self.matpc = matpc
+        self.antiperiodic_t = antiperiodic_t
         g = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
         self.gauge_eo = wops.split_gauge_eo(g, geom)
 
@@ -80,6 +81,7 @@ class DiracWilsonPC(DiracPC):
         self.geom = geom
         self.kappa = kappa
         self.matpc = matpc
+        self.antiperiodic_t = True
         self.gauge_eo = gauge_eo
         return self
 
@@ -189,9 +191,12 @@ class _PackedHopMixin:
     _spin_axis = 0
 
     def _setup_hop(self, geom, gauge_eo_packed, store_dtype,
-                   use_pallas, pallas_interpret, pallas_version=None):
+                   use_pallas, pallas_interpret, pallas_version=None,
+                   tb_sign: bool = True):
         """gauge_eo_packed: (even, odd) complex packed (4,3,3,T,Z,Y*Xh)
-        links (wilson_packed.pack_gauge_eo output)."""
+        links (wilson_packed.pack_gauge_eo output).  ``tb_sign``: whether
+        the links carry a folded antiperiodic-t phase (drives the
+        reconstruct-12 row-2 sign; see wilson_pallas_packed)."""
         from ..ops import wilson_packed as wpk
         self.geom = geom
         self.dims = tuple(geom.lattice_shape)
@@ -200,14 +205,23 @@ class _PackedHopMixin:
             wpk.to_packed_pairs(g, store_dtype) for g in gauge_eo_packed)
         self.use_pallas = use_pallas
         self._pallas_interpret = pallas_interpret
+        self._tb_sign = tb_sign
+        from ..utils import config as qconf
         if pallas_version is None:
-            from ..utils import config as qconf
             pallas_version = qconf.get("QUDA_TPU_PALLAS_VERSION",
                                        fresh=True)
         if pallas_version not in (2, 3):
             raise ValueError(f"pallas_version must be 2 or 3, got "
                              f"{pallas_version}")
         self._pallas_version = pallas_version
+        # in-kernel gauge compression (QUDA reconstruct-12 analog): v3
+        # pallas only; the resident link arrays shrink 288 -> 192 B/site
+        if (use_pallas and pallas_version == 3
+                and str(qconf.get("QUDA_TPU_RECONSTRUCT",
+                                  fresh=True)) == "12"):
+            from ..ops import wilson_pallas_packed as wpp
+            self.gauge_eo_pp = tuple(wpp.to_recon12(g)
+                                     for g in self.gauge_eo_pp)
         # v2 pallas path only: resident pre-shifted backward links (the
         # v3 scatter-form kernel reads the unshifted opposite-parity
         # links directly — no resident copy)
@@ -228,7 +242,7 @@ class _PackedHopMixin:
                     self.gauge_eo_pp[1 - target_parity], psi_pp,
                     tuple(self.dims), target_parity,
                     interpret=self._pallas_interpret,
-                    out_dtype=out_dtype)
+                    out_dtype=out_dtype, tb_sign=self._tb_sign)
             return wpp.dslash_eo_pallas_packed(
                 self.gauge_eo_pp[target_parity],
                 self._u_bw[target_parity], psi_pp, tuple(self.dims),
@@ -405,7 +419,8 @@ class DiracWilsonPCPackedSloppy(_PackedHopMixin, _PairSloppyBase):
                  use_pallas: bool = False, pallas_interpret: bool = False,
                  pallas_version: int | None = None):
         self._setup_hop(dpk.geom, dpk.gauge_eo_p, store_dtype,
-                        use_pallas, pallas_interpret, pallas_version)
+                        use_pallas, pallas_interpret, pallas_version,
+                        tb_sign=getattr(dpk._dpc, "antiperiodic_t", True))
         self.kappa = float(dpk.kappa)
         self.matpc = dpk.matpc
 

@@ -104,6 +104,10 @@ def _cadd(a, b):
     return (a[0] + b[0], a[1] + b[1])
 
 
+def _csub(a, b):
+    return (a[0] - b[0], a[1] - b[1])
+
+
 def _cscale(c: complex, x):
     cr, ci = float(c.real), float(c.imag)
     if ci == 0.0:
@@ -440,18 +444,62 @@ def _recon_acc(acc, uh, table):
         acc[3][c] = _cadd(acc[3][c], _cscale(t["d3"], uh[t["k3"]][c]))
 
 
-def _make_kernel_v3(X: int, bz: int, eo: tuple | None = None):
-    """v3 kernel over one (t, z-block) tile.  Ref shapes:
+def _link_getter(ref, mu, row2_sign=None):
+    """Accessor (a, b) -> (re, im) link element from a packed gauge ref.
+
+    Dispatches on the ref's ROW extent: 3 = full 18-real storage; 2 =
+    reconstruct-12 (QUDA QUDA_RECONSTRUCT_12, gauge_field_order.h
+    Reconstruct<12>): rows 0-1 stored, row 2 = conj(row0 x row1) built
+    on demand and memoised at trace time (each needed column computed
+    once per direction-use).
+
+    ``row2_sign``: the t-boundary wrinkle — links are stored with the
+    antiperiodic phase FOLDED IN, and for V = -U the cross product gives
+    +u2 (the two -1s cancel), so the reconstructed row of a t-link on
+    the boundary plane must be re-negated.  Pass a (scalar) +-1 factor.
+    """
+    nrow = ref.shape[1]
+
+    def stored(a, b):
+        return (ref[mu, a, b, 0, 0].astype(F32),
+                ref[mu, a, b, 1, 0].astype(F32))
+
+    if nrow == 3:
+        return stored
+
+    cache = {}
+
+    def get(a, b):
+        if a < 2:
+            return stored(a, b)
+        if b not in cache:
+            b1, b2 = (b + 1) % 3, (b + 2) % 3
+            x = _csub(_cmul(stored(0, b1), stored(1, b2)),
+                      _cmul(stored(0, b2), stored(1, b1)))
+            re, im = x[0], -x[1]          # conjugate of the cross product
+            if row2_sign is not None:
+                re, im = re * row2_sign, im * row2_sign
+            cache[b] = (re, im)
+        return cache[b]
+
+    return get
+
+
+def _make_kernel_v3(X: int, bz: int, eo: tuple | None = None,
+                    T: int | None = None, tb_sign: bool = True):
+    """v3 kernel over one (t, z-block) tile.  Ref shapes (R = 3 rows for
+    full storage, 2 for reconstruct-12):
       psi_c/tp/tm:      (4, 3, 2, 1, bz, YX)
       psi_zp/zm rows:   (4, 3, 2, 1, 1, YX)
-      g_c:              (4, 3, 3, 2, 1, bz, YX)   forward links
-      g_t_tm:           (1, 3, 3, 2, 1, bz, YX)   U_t plane at t-1
-      g_z_zm:           (1, 3, 3, 2, 1, 1, YX)    U_z row at z-1
+      g_c:              (4, R, 3, 2, 1, bz, YX)   forward links
+      g_t_tm:           (1, R, 3, 2, 1, bz, YX)   U_t plane at t-1
+      g_z_zm:           (1, R, 3, 2, 1, 1, YX)    U_z row at z-1
     With ``eo = (target_parity, Xh)`` the backward links live on the
     OPPOSITE parity, so three extra refs carry them (see
-    dslash_eo_pallas_packed_v3): g_there_xyz (3,3,3,2,1,bz,YX) replaces
+    dslash_eo_pallas_packed_v3): g_there_xyz (3,R,3,2,1,bz,YX) replaces
     g_c for backward x/y/z and g_t_tm/g_z_zm slice the opposite-parity
-    gauge array.
+    gauge array.  ``T``/``tb_sign`` drive the reconstruct-12 t-boundary
+    row-2 sign (see _link_getter).
     """
     from jax.experimental import pallas as pl
 
@@ -482,9 +530,17 @@ def _make_kernel_v3(X: int, bz: int, eo: tuple | None = None):
             return (ref[s, c, 0, 0].astype(F32),
                     ref[s, c, 1, 0].astype(F32))
 
-        def link_of(ref, mu):
-            return lambda a, b: (ref[mu, a, b, 0, 0].astype(F32),
-                                 ref[mu, a, b, 1, 0].astype(F32))
+        # reconstruct-12 t-boundary sign planes (None for full storage /
+        # periodic t): forward t-link lives on plane t, backward on t-1
+        if g_c.shape[1] == 2 and tb_sign:
+            t_idx = pl.program_id(0)
+            s_fwd = jnp.where(t_idx == T - 1, -1.0, 1.0).astype(F32)
+            s_bwd = jnp.where(t_idx == 0, -1.0, 1.0).astype(F32)
+        else:
+            s_fwd = s_bwd = None
+
+        def link_of(ref, mu, row2_sign=None):
+            return _link_getter(ref, mu, row2_sign)
 
         acc = [[(jnp.zeros(psi_c.shape[-2:], F32),
                  jnp.zeros(psi_c.shape[-2:], F32))
@@ -538,12 +594,13 @@ def _make_kernel_v3(X: int, bz: int, eo: tuple | None = None):
         # t forward: whole neighbour plane, local U_t, no shift
         tf = TABLES[(3, +1)]
         h = _project(lambda s, c: psi_at(psi_tp, s, c), tf)
-        _recon_acc(acc, _color_mul(h, link_of(g_c, 3), False), tf)
+        _recon_acc(acc, _color_mul(h, link_of(g_c, 3, s_fwd), False), tf)
 
         # t backward: U_t(t-1)^dag psi(t-1), both read at t-1 directly
         tb = TABLES[(3, -1)]
         h = _project(lambda s, c: psi_at(psi_tm, s, c), tb)
-        _recon_acc(acc, _color_mul(h, link_of(g_t_tm, 0), True), tb)
+        _recon_acc(acc, _color_mul(h, link_of(g_t_tm, 0, s_bwd), True),
+                   tb)
 
         odt = out_ref.dtype
         for s in range(4):
@@ -554,21 +611,36 @@ def _make_kernel_v3(X: int, bz: int, eo: tuple | None = None):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("X", "interpret", "block_z"))
+def to_recon12(gauge_pl: jnp.ndarray) -> jnp.ndarray:
+    """Packed links -> reconstruct-12 storage: keep rows 0-1 only.
+    (4, 3, 3, 2, T, Z, YX) -> (4, 2, 3, 2, T, Z, YX); 192 B/site f32
+    instead of 288.  Valid for SU(3) links (incl. folded antiperiodic-t:
+    the kernels re-apply the boundary sign to the reconstructed row)."""
+    return gauge_pl[:, :2]
+
+
+@functools.partial(jax.jit, static_argnames=("X", "interpret", "block_z",
+                                             "tb_sign"))
 def dslash_pallas_packed_v3(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
                             X: int, interpret: bool = False,
-                            block_z: int | None = None) -> jnp.ndarray:
+                            block_z: int | None = None,
+                            tb_sign: bool = True) -> jnp.ndarray:
     """Wilson hop sum, v3: no backward-gauge copy, row-sized z inputs.
 
     Same layouts and semantics as ``dslash_pallas_packed`` but reads
     ~780 B/site instead of ~1150 and needs no ``backward_gauge``
-    precompute or resident copy.
+    precompute or resident copy.  A gauge array with ROW extent 2 (see
+    ``to_recon12``) selects in-kernel reconstruct-12: gauge traffic
+    drops another 96 B/site for ~66 extra VPU flops/site
+    (gauge_field_order.h Reconstruct<12>); ``tb_sign`` re-applies the
+    folded antiperiodic-t phase to the reconstructed row.
     """
     from jax.experimental import pallas as pl
 
     _, _, _, T, Z, YX = psi_pl.shape
-    bz = block_z if block_z is not None else _pick_bz(Z, YX, psi_pl.dtype,
-                                                     planes=280)
+    R = gauge_pl.shape[1]
+    bz = block_z if block_z is not None else _pick_bz(
+        Z, YX, psi_pl.dtype, planes=280 if R == 3 else 232)
     if Z % bz != 0:
         raise ValueError(f"block_z={bz} does not divide Z={Z}")
     nzb = Z // bz
@@ -590,15 +662,15 @@ def dslash_pallas_packed_v3(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
             lambda t, zb: (0, 0, 0, t, (zb * bz - 1) % Z, 0))
 
     gauge_spec = pl.BlockSpec(
-        (4, 3, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+        (4, R, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
     g_t_spec = pl.BlockSpec(
-        (1, 3, 3, 2, 1, bz, YX),
+        (1, R, 3, 2, 1, bz, YX),
         lambda t, zb: (3, 0, 0, 0, (t - 1) % T, zb, 0))
     g_z_spec = pl.BlockSpec(
-        (1, 3, 3, 2, 1, 1, YX),
+        (1, R, 3, 2, 1, 1, YX),
         lambda t, zb: (2, 0, 0, 0, t, (zb * bz - 1) % Z, 0))
 
-    kernel = _make_kernel_v3(X, bz)
+    kernel = _make_kernel_v3(X, bz, T=T, tb_sign=tb_sign)
 
     return pl.pallas_call(
         kernel,
@@ -682,32 +754,37 @@ def dslash_eo_pallas_packed(u_here_pl: jnp.ndarray, u_bw_pl: jnp.ndarray,
 
 @functools.partial(jax.jit, static_argnames=("dims", "target_parity",
                                              "interpret", "block_z",
-                                             "out_dtype"))
+                                             "out_dtype", "tb_sign"))
 def dslash_eo_pallas_packed_v3(u_here_pl: jnp.ndarray,
                                u_there_pl: jnp.ndarray,
                                psi_pl: jnp.ndarray, dims,
                                target_parity: int, interpret: bool = False,
                                block_z: int | None = None,
-                               out_dtype=None) -> jnp.ndarray:
+                               out_dtype=None,
+                               tb_sign: bool = True) -> jnp.ndarray:
     """Checkerboarded Wilson hop, v3: scatter-form backward hops read
     the UNSHIFTED opposite-parity links directly — no
     ``backward_gauge_eo`` precompute or resident pre-shifted copy, and
     the z neighbours arrive as single boundary rows instead of whole
     tiles (~160 B/site less HBM traffic than the v2 kernel).
 
-    u_here_pl: (4,3,3,2,T,Z,Y*Xh) forward links at target-parity sites;
+    u_here_pl: (4,R,3,2,T,Z,Y*Xh) forward links at target-parity sites;
     u_there_pl: links at the OPPOSITE parity (the source parity of
     psi_pl), same layout; psi_pl: (4,3,2,T,Z,Y*Xh) parity-(1-p) spinor.
+    ROW extent R = 2 selects in-kernel reconstruct-12 (see to_recon12);
+    ``tb_sign`` re-applies the folded antiperiodic-t phase to the
+    reconstructed row.
     """
     from jax.experimental import pallas as pl
 
     T, Z, Y, X = dims
     Xh = X // 2
     _, _, _, _, _, YXh = psi_pl.shape
+    R = u_here_pl.shape[1]
     # working set: 3 psi tiles (72 planes) + u_here (144) + u_there_xyz
-    # (108) + U_t plane (36) + out (24) = 384 bz-row planes
-    bz = block_z if block_z is not None else _pick_bz(Z, YXh, psi_pl.dtype,
-                                                     planes=390)
+    # (108) + U_t plane (36) + out (24) = 384 bz-row planes (R=3)
+    bz = block_z if block_z is not None else _pick_bz(
+        Z, YXh, psi_pl.dtype, planes=390 if R == 3 else 294)
     if Z % bz != 0:
         raise ValueError(f"block_z={bz} does not divide Z={Z}")
     nzb = Z // bz
@@ -727,17 +804,18 @@ def dslash_eo_pallas_packed_v3(u_here_pl: jnp.ndarray,
             lambda t, zb: (0, 0, 0, t, (zb * bz - 1) % Z, 0))
 
     g_here_spec = pl.BlockSpec(
-        (4, 3, 3, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+        (4, R, 3, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
     g_there_xyz_spec = pl.BlockSpec(
-        (3, 3, 3, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+        (3, R, 3, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
     g_t_spec = pl.BlockSpec(
-        (1, 3, 3, 2, 1, bz, YXh),
+        (1, R, 3, 2, 1, bz, YXh),
         lambda t, zb: (3, 0, 0, 0, (t - 1) % T, zb, 0))
     g_z_spec = pl.BlockSpec(
-        (1, 3, 3, 2, 1, 1, YXh),
+        (1, R, 3, 2, 1, 1, YXh),
         lambda t, zb: (2, 0, 0, 0, t, (zb * bz - 1) % Z, 0))
 
-    kernel = _make_kernel_v3(X, bz, eo=(target_parity, Xh))
+    kernel = _make_kernel_v3(X, bz, eo=(target_parity, Xh), T=T,
+                             tb_sign=tb_sign)
 
     return pl.pallas_call(
         kernel,

@@ -49,31 +49,32 @@ def _hop_term(psi_slab, u_slab, table, adjoint):
                           table, adjoint), jnp.float32)
 
 
-def _face(arr, axis, lo: bool):
+def _face_n(arr, axis, lo: bool, n: int = 1):
+    """n boundary planes (one slab; n=1 for Wilson, 3 for Naik)."""
     L = arr.shape[axis]
-    return (lax.slice_in_dim(arr, 0, 1, axis=axis) if lo
-            else lax.slice_in_dim(arr, L - 1, L, axis=axis))
+    return (lax.slice_in_dim(arr, 0, n, axis=axis) if lo
+            else lax.slice_in_dim(arr, L - n, L, axis=axis))
 
 
-def _add_face(out, corr, axis, lo: bool):
+def _add_face_n(out, corr, axis, lo: bool, n: int = 1):
     L = out.shape[axis]
-    idx = 0 if lo else L - 1
-    face = lax.slice_in_dim(out, idx, idx + 1, axis=axis)
+    idx = 0 if lo else L - n
+    face = lax.slice_in_dim(out, idx, idx + n, axis=axis)
     fixed = (face.astype(jnp.float32) + corr).astype(out.dtype)
     return lax.dynamic_update_slice_in_dim(out, fixed, idx, axis)
 
 
-def _fix_hi_face(out, gauge_pl, psi_pl, axis, name, n, mu):
+def _fix_hi_face_n(out, gauge_pl, psi_pl, axis, name, n, mu):
     """Forward-hop fix on the HIGH face (shared by both policies):
     psi(x+mu) must come from the next shard's first plane — the kernel
     used the local first plane."""
-    u_fwd_hi = _face(gauge_pl[mu], axis, lo=False)
-    halo_hi = _nbr(_face(psi_pl, axis, lo=True), name,
+    u_fwd_hi = _face_n(gauge_pl[mu], axis, lo=False)
+    halo_hi = _nbr(_face_n(psi_pl, axis, lo=True), name,
                    towards_lower=True, n=n)
-    wrong_hi = _face(psi_pl, axis, lo=True)
+    wrong_hi = _face_n(psi_pl, axis, lo=True)
     corr_hi = (_hop_term(halo_hi, u_fwd_hi, TABLES[(mu, +1)], False)
                - _hop_term(wrong_hi, u_fwd_hi, TABLES[(mu, +1)], False))
-    return _add_face(out, corr_hi, axis, lo=False)
+    return _add_face_n(out, corr_hi, axis, lo=False)
 
 
 def dslash_pallas_sharded(gauge_pl, gauge_bw_pl, psi_pl, X: int, mesh,
@@ -106,18 +107,159 @@ def dslash_pallas_sharded(gauge_pl, gauge_bw_pl, psi_pl, X: int, mesh,
     for axis, name, n, mu in ((t_ax, "t", n_t, 3), (z_ax, "z", n_z, 2)):
         if n == 1:
             continue                      # periodic wrap is correct
-        out = _fix_hi_face(out, gauge_pl, psi_pl, axis, name, n, mu)
+        out = _fix_hi_face_n(out, gauge_pl, psi_pl, axis, name, n, mu)
         # backward hop on the LOW face: psi(x-mu) from the previous
         # shard's last plane (the backward link u_bwd_lo is already the
         # correct cross-shard link: backward_gauge ran globally)
-        u_bwd_lo = _face(gauge_bw_pl[mu], axis, lo=True)   # U_mu(x-mu) at 0
-        halo_lo = _nbr(_face(psi_pl, axis, lo=False), name,
+        u_bwd_lo = _face_n(gauge_bw_pl[mu], axis, lo=True)   # U_mu(x-mu) at 0
+        halo_lo = _nbr(_face_n(psi_pl, axis, lo=False), name,
                        towards_lower=False, n=n)
-        wrong_lo = _face(psi_pl, axis, lo=False)
+        wrong_lo = _face_n(psi_pl, axis, lo=False)
         corr_lo = (_hop_term(halo_lo, u_bwd_lo, TABLES[(mu, -1)], True)
                    - _hop_term(wrong_lo, u_bwd_lo, TABLES[(mu, -1)],
                                True))
-        out = _add_face(out, corr_lo, axis, lo=True)
+        out = _add_face_n(out, corr_lo, axis, lo=True)
+    return out
+
+
+def _stag_term(u_slab, psi_slab, adjoint: bool):
+    """Staggered color multiply on a boundary slab: (3,3,2,slab...) x
+    (3,2,slab...) -> (3,2,slab...) f32 (no spin algebra)."""
+    from ..ops.staggered_packed import (_color_planes, _mat_vec_pairs,
+                                        _u_planes)
+    out = _mat_vec_pairs(_u_planes(u_slab), _color_planes(psi_slab),
+                         adjoint)
+    return jnp.stack([jnp.stack([re, im]) for re, im in out])
+
+
+def _stag_fix_faces(out, links_fwd, links_bwd, psi_pl, nhop: int, axis,
+                    name, n, mu):
+    """Fat (nhop=1) or Naik (nhop=3) face fixes for one partitioned
+    direction, v3 scatter-form conventions:
+
+    * forward hop, HIGH slab: psi(x + nhop*mu) must come from the next
+      shard's first nhop planes (the kernel wrapped the local ones);
+      hop-to-plane alignment is 1:1 within the slab.
+    * backward hop, LOW slab: the kernel wrapped the locally-computed
+      product U^dag psi of the LAST nhop planes; ppermute the product
+      slab itself (linear in the face) — no link exchange.
+
+    ``links_fwd``/``links_bwd``: the link arrays each hop reads — the
+    same full-lattice array, or (checkerboarded) the target-parity and
+    opposite-parity link arrays respectively."""
+    u_hi = _face_n(links_fwd[mu], axis, lo=False, n=nhop)
+    halo_hi = _nbr(_face_n(psi_pl, axis, lo=True, n=nhop), name,
+                   towards_lower=True, n=n)
+    wrong_hi = _face_n(psi_pl, axis, lo=True, n=nhop)
+    corr_hi = 0.5 * (_stag_term(u_hi, halo_hi, False)
+                     - _stag_term(u_hi, wrong_hi, False))
+    out = _add_face_n(out, corr_hi, axis, lo=False, n=nhop)
+
+    prod = _stag_term(_face_n(links_bwd[mu], axis, lo=False, n=nhop),
+                      _face_n(psi_pl, axis, lo=False, n=nhop), True)
+    corr_lo = -0.5 * (_nbr(prod, name, towards_lower=False, n=n) - prod)
+    return _add_face_n(out, corr_lo, axis, lo=True, n=nhop)
+
+
+def dslash_staggered_pallas_sharded_v3(fat_pl, psi_pl, X: int, mesh,
+                                       long_pl=None,
+                                       interpret: bool = False):
+    """Staggered / improved-staggered D psi on per-shard local packed
+    pair blocks — call INSIDE shard_map over ``mesh`` (t/z mesh axes
+    partition T/Z; y/x mesh axes must be 1).  The interior runs the
+    single-chip v3 scatter-form kernel (ops/staggered_pallas); the Naik
+    term's 3-hop boundary is three planes per face, fixed with ONE
+    3-plane ppermute per direction-sign (reference: the nFace=3
+    staggered policies of lib/dslash_policy.hpp:365 applied to
+    include/kernels/dslash_staggered.cuh).
+
+    Requires local T/Z extents >= 3 when ``long_pl`` is given (the slab
+    fix assumes the 3-hop crosses at most one shard boundary).
+    """
+    from ..ops.staggered_pallas import dslash_staggered_pallas_v3
+
+    n_t, n_z = mesh.shape["t"], mesh.shape["z"]
+    if mesh.shape["y"] != 1 or mesh.shape["x"] != 1:
+        raise ValueError(
+            "dslash_staggered_pallas_sharded_v3 shards t/z only (y/x "
+            "mesh axes must be 1)")
+    if long_pl is not None:
+        for ax, nn in ((-3, n_t), (-2, n_z)):
+            if nn > 1 and psi_pl.shape[ax] < 3:
+                raise ValueError(
+                    "local extent < 3 on a partitioned axis: the Naik "
+                    "slab fix needs the 3-hop to cross at most one "
+                    "shard boundary")
+
+    out = dslash_staggered_pallas_v3(fat_pl, psi_pl, X, long_pl=long_pl,
+                                     interpret=interpret)
+
+    t_ax, z_ax = -3, -2
+    for axis, name, n, mu in ((t_ax, "t", n_t, 3), (z_ax, "z", n_z, 2)):
+        if n == 1:
+            continue
+        out = _stag_fix_faces(out, fat_pl, fat_pl, psi_pl, 1, axis,
+                              name, n, mu)
+        if long_pl is not None:
+            out = _stag_fix_faces(out, long_pl, long_pl, psi_pl, 3,
+                                  axis, name, n, mu)
+    return out
+
+
+def dslash_staggered_eo_pallas_sharded_v3(fat_here_pl, fat_there_pl,
+                                          psi_pl, dims,
+                                          target_parity: int, mesh,
+                                          long_here_pl=None,
+                                          long_there_pl=None,
+                                          interpret: bool = False):
+    """Checkerboarded staggered hop under shard_map — the complex-free
+    staggered SOLVE stencil (models/staggered.DiracStaggeredPCPairs)
+    made multi-chip: interior eo v3 kernel + the same slab face fixes,
+    with forward hops reading the target-parity links and the backward
+    product built from the opposite-parity links (both already resident
+    per shard; only psi slabs and product slabs ride the ppermute).
+
+    t/z hops flip parity but keep the checkerboarded x-slot layout, so
+    the full-lattice slab alignment carries over unchanged.  ``dims``
+    are the GLOBAL (T, Z, Y, X); the interior kernel runs on the LOCAL
+    block (extents from psi_pl), and the in-kernel x-slot parity masks
+    use local coordinates, so partitioned axes must have EVEN local
+    extents (shard offsets then do not flip the site parity).
+    """
+    from ..ops.staggered_pallas import dslash_staggered_eo_pallas_v3
+
+    n_t, n_z = mesh.shape["t"], mesh.shape["z"]
+    if mesh.shape["y"] != 1 or mesh.shape["x"] != 1:
+        raise ValueError(
+            "dslash_staggered_eo_pallas_sharded_v3 shards t/z only "
+            "(y/x mesh axes must be 1)")
+    t_loc, z_loc = psi_pl.shape[-3], psi_pl.shape[-2]
+    for nn, ext, nm in ((n_t, t_loc, "T"), (n_z, z_loc, "Z")):
+        if nn > 1 and ext % 2 != 0:
+            raise ValueError(
+                f"local {nm} extent {ext} must be even on a partitioned "
+                f"axis (the checkerboard masks use local coordinates)")
+        if nn > 1 and long_here_pl is not None and ext < 3:
+            raise ValueError(
+                "local extent < 3 on a partitioned axis: the Naik slab "
+                "fix needs the 3-hop to cross at most one shard "
+                "boundary")
+    dims_local = (t_loc, z_loc, dims[2], dims[3])
+
+    out = dslash_staggered_eo_pallas_v3(
+        fat_here_pl, fat_there_pl, psi_pl, dims_local, target_parity,
+        long_here_pl=long_here_pl, long_there_pl=long_there_pl,
+        interpret=interpret)
+
+    t_ax, z_ax = -3, -2
+    for axis, name, n, mu in ((t_ax, "t", n_t, 3), (z_ax, "z", n_z, 2)):
+        if n == 1:
+            continue
+        out = _stag_fix_faces(out, fat_here_pl, fat_there_pl, psi_pl, 1,
+                              axis, name, n, mu)
+        if long_here_pl is not None:
+            out = _stag_fix_faces(out, long_here_pl, long_there_pl,
+                                  psi_pl, 3, axis, name, n, mu)
     return out
 
 
@@ -148,13 +290,13 @@ def dslash_pallas_sharded_v3(gauge_pl, psi_pl, X: int, mesh,
     for axis, name, n, mu in ((t_ax, "t", n_t, 3), (z_ax, "z", n_z, 2)):
         if n == 1:
             continue
-        out = _fix_hi_face(out, gauge_pl, psi_pl, axis, name, n, mu)
+        out = _fix_hi_face_n(out, gauge_pl, psi_pl, axis, name, n, mu)
         # backward hop, LOW face: the kernel wrapped the LOCAL last
         # plane's product U^dag psi into row 0; the true contribution is
         # the PREVIOUS shard's — permute the product itself
-        prod = _hop_term(_face(psi_pl, axis, lo=False),
-                         _face(gauge_pl[mu], axis, lo=False),
+        prod = _hop_term(_face_n(psi_pl, axis, lo=False),
+                         _face_n(gauge_pl[mu], axis, lo=False),
                          TABLES[(mu, -1)], True)
         corr_lo = _nbr(prod, name, towards_lower=False, n=n) - prod
-        out = _add_face(out, corr_lo, axis, lo=True)
+        out = _add_face_n(out, corr_lo, axis, lo=True)
     return out

@@ -83,6 +83,13 @@ _register("QUDA_TPU_PALLAS", "choice", "",
           "solves; empty = autotuned choice",
           ("", "0", "1"),
           reference="QUDA_ENABLE_DSLASH_POLICY")
+_register("QUDA_TPU_RECONSTRUCT", "choice", "18",
+          "gauge link storage for v3 pallas kernels: '18' = full, "
+          "'12' = two rows + in-kernel third-row reconstruction "
+          "(192 B/site instead of 288; SU(3) links only)",
+          ("18", "12"),
+          reference="QUDA_RECONSTRUCT / gauge_field_order.h "
+                    "Reconstruct<12>")
 _register("QUDA_TPU_PALLAS_VERSION", "int", 3,
           "pallas kernel generation: 3 = scatter-form backward hops "
           "(no backward-link copies), 2 = gather kernels with "

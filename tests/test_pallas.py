@@ -233,3 +233,86 @@ def test_pallas_eo_operator_in_cg():
     rp = cg(op_p.MdagM, rhs, tol=1e-8, maxiter=200)
     err = float(jnp.sqrt(blas.norm2(rx.x - rp.x) / blas.norm2(rx.x)))
     assert err < 1e-5
+
+
+@pytest.mark.parametrize("antiperiodic", [True, False])
+def test_pallas_v3_recon12_matches_full(antiperiodic):
+    """Reconstruct-12 storage (rows 0-1 + in-kernel cross-product third
+    row, gauge_field_order.h Reconstruct<12> analog) == full 18-real
+    storage on SU(3) links, with and without the folded antiperiodic-t
+    phase (whose sign must be re-applied to the reconstructed row)."""
+    import jax
+    import jax.numpy as jnp
+    from quda_tpu.fields.geometry import LatticeGeometry
+    from quda_tpu.fields.gauge import GaugeField
+    from quda_tpu.fields.spinor import ColorSpinorField
+    from quda_tpu.ops import blas
+    from quda_tpu.ops import wilson_packed as wpk
+    from quda_tpu.ops import wilson_pallas_packed as wpp
+    from quda_tpu.ops.boundary import apply_t_boundary
+
+    geom = LatticeGeometry((4, 4, 6, 4))
+    T, Z, Y, X = geom.lattice_shape
+    gauge = GaugeField.random(jax.random.PRNGKey(11), geom).data.astype(
+        jnp.complex64)
+    if antiperiodic:
+        gauge = apply_t_boundary(gauge, geom, -1)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(12),
+                                    geom).data.astype(jnp.complex64)
+    g_pl = wpp.to_pallas_layout(wpk.pack_gauge(gauge))
+    p_pl = wpp.to_pallas_layout(wpk.pack_spinor(psi))
+    full = wpp.dslash_pallas_packed_v3(g_pl, p_pl, X, interpret=True,
+                                       tb_sign=antiperiodic)
+    r12 = wpp.dslash_pallas_packed_v3(wpp.to_recon12(g_pl), p_pl, X,
+                                      interpret=True,
+                                      tb_sign=antiperiodic)
+    err = float(jnp.sqrt(blas.norm2(full - r12) / blas.norm2(full)))
+    assert err < 1e-5
+
+
+def test_pallas_eo_v3_recon12_solve_matches():
+    """The reconstruct-12 eo operator (QUDA_TPU_RECONSTRUCT=12 wiring
+    through DiracWilsonPCPackedSloppy) reproduces the full-storage
+    operator application to f32 accuracy."""
+    import jax
+    import jax.numpy as jnp
+    from quda_tpu.fields.geometry import LatticeGeometry
+    from quda_tpu.fields.gauge import GaugeField
+    from quda_tpu.models.wilson import DiracWilsonPC
+    from quda_tpu.ops import blas
+    from quda_tpu.utils import config as qconf
+
+    geom = LatticeGeometry((4, 4, 6, 4))
+    T, Z, Y, X = geom.lattice_shape
+    gauge = GaugeField.random(jax.random.PRNGKey(13), geom).data.astype(
+        jnp.complex64)
+    dpc = DiracWilsonPC(gauge, geom, kappa=0.12)
+    rhs = jax.random.normal(jax.random.PRNGKey(14),
+                            (4, 3, 2, T, Z, Y * X // 2), jnp.float32)
+    import os
+    prev = os.environ.get("QUDA_TPU_RECONSTRUCT")
+    try:
+        # force BOTH modes explicitly: a user-exported
+        # QUDA_TPU_RECONSTRUCT=12 must not make this comparison vacuous
+        os.environ["QUDA_TPU_RECONSTRUCT"] = "18"
+        qconf.reset_cache()
+        sl_full = dpc.packed().pairs(jnp.float32, use_pallas=True,
+                                     pallas_interpret=True,
+                                     pallas_version=3)
+        os.environ["QUDA_TPU_RECONSTRUCT"] = "12"
+        qconf.reset_cache()
+        sl_r12 = dpc.packed().pairs(jnp.float32, use_pallas=True,
+                                    pallas_interpret=True,
+                                    pallas_version=3)
+    finally:
+        if prev is None:
+            os.environ.pop("QUDA_TPU_RECONSTRUCT", None)
+        else:
+            os.environ["QUDA_TPU_RECONSTRUCT"] = prev
+        qconf.reset_cache()
+    assert sl_full.gauge_eo_pp[0].shape[1] == 3
+    assert sl_r12.gauge_eo_pp[0].shape[1] == 2       # compressed resident
+    a = sl_full.MdagM_pairs(rhs)
+    b = sl_r12.MdagM_pairs(rhs)
+    err = float(jnp.sqrt(blas.norm2(a - b) / blas.norm2(a)))
+    assert err < 1e-5

@@ -90,3 +90,131 @@ def test_sharded_pallas_v3_matches_single_device(grid):
 
     err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
     assert err < 1e-6
+
+
+@pytest.mark.parametrize("grid", [(4, 2, 1, 1), (2, 4, 1, 1),
+                                  (8, 1, 1, 1)])
+def test_sharded_staggered_v3_matches_single_device(grid):
+    """Staggered fused policy (fat 1-hop): interior v3 scatter kernel +
+    face fixes must bit-match the single-device packed stencil
+    (lib/dslash_policy.hpp:365 applied to dslash_staggered.cuh)."""
+    from quda_tpu.ops import staggered_packed as spk
+    from quda_tpu.parallel.pallas_dslash import (
+        dslash_staggered_pallas_sharded_v3)
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    geom = LatticeGeometry((4, 4, 8, 8))
+    T, Z, Y, X = geom.lattice_shape
+    gauge = GaugeField.random(jax.random.PRNGKey(21), geom).data.astype(
+        jnp.complex64)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(22), geom
+                                    ).data.astype(jnp.complex64)[..., :1, :]
+    fat_pp = wpk.to_packed_pairs(spk.pack_links(gauge), jnp.float32)
+    psi_pp = wpk.to_packed_pairs(spk.pack_staggered(psi), jnp.float32)
+    ref = spk.dslash_staggered_packed_pairs(fat_pp, psi_pp, X, Y)
+
+    mesh = make_lattice_mesh(grid=grid, n_src=1)
+    psi_spec = P(None, None, "t", "z", None)
+    g_spec = P(None, None, None, None, "t", "z", None)
+    fn = jax.shard_map(
+        lambda g, p: dslash_staggered_pallas_sharded_v3(
+            g, p, X, mesh, interpret=True),
+        mesh=mesh, in_specs=(g_spec, psi_spec), out_specs=psi_spec,
+        check_vma=False)
+    fat_s = jax.device_put(fat_pp, NamedSharding(mesh, g_spec))
+    psi_s = jax.device_put(psi_pp, NamedSharding(mesh, psi_spec))
+    out = jax.jit(fn)(fat_s, psi_s)
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-6
+
+
+def test_sharded_improved_staggered_v3_matches_single_device():
+    """Improved staggered (fat + 3-hop Naik): the 3-plane slab fixes per
+    partitioned direction must bit-match the single-device stencil.
+    Local extents must be >= 3 (checked by the kernel)."""
+    from quda_tpu.ops import staggered_packed as spk
+    from quda_tpu.parallel.pallas_dslash import (
+        dslash_staggered_pallas_sharded_v3)
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    geom = LatticeGeometry((4, 4, 8, 12))    # (x,y,z,t): T=12 -> local 3
+    T, Z, Y, X = geom.lattice_shape
+    fat_c = GaugeField.random(jax.random.PRNGKey(23), geom).data.astype(
+        jnp.complex64)
+    long_c = GaugeField.random(jax.random.PRNGKey(24), geom).data.astype(
+        jnp.complex64)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(25), geom
+                                    ).data.astype(jnp.complex64)[..., :1, :]
+    fat_pp = wpk.to_packed_pairs(spk.pack_links(fat_c), jnp.float32)
+    long_pp = wpk.to_packed_pairs(spk.pack_links(long_c), jnp.float32)
+    psi_pp = wpk.to_packed_pairs(spk.pack_staggered(psi), jnp.float32)
+    ref = spk.dslash_staggered_packed_pairs(fat_pp, psi_pp, X, Y, long_pp)
+
+    mesh = make_lattice_mesh(grid=(4, 2, 1, 1), n_src=1)
+    psi_spec = P(None, None, "t", "z", None)
+    g_spec = P(None, None, None, None, "t", "z", None)
+    fn = jax.shard_map(
+        lambda f, l, p: dslash_staggered_pallas_sharded_v3(
+            f, p, X, mesh, long_pl=l, interpret=True),
+        mesh=mesh, in_specs=(g_spec, g_spec, psi_spec),
+        out_specs=psi_spec, check_vma=False)
+    fat_s = jax.device_put(fat_pp, NamedSharding(mesh, g_spec))
+    long_s = jax.device_put(long_pp, NamedSharding(mesh, g_spec))
+    psi_s = jax.device_put(psi_pp, NamedSharding(mesh, psi_spec))
+    out = jax.jit(fn)(fat_s, long_s, psi_s)
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-6
+
+
+@pytest.mark.parametrize("parity", [0, 1])
+def test_sharded_staggered_eo_v3_matches_single_device(parity):
+    """Checkerboarded improved-staggered hop (the complex-free staggered
+    SOLVE stencil) under shard_map == the single-device eo pair stencil,
+    both parities, fat + Naik."""
+    from quda_tpu.fields.spinor import even_odd_split
+    from quda_tpu.ops import staggered_packed as spk
+    from quda_tpu.ops.wilson import split_gauge_eo
+    from quda_tpu.parallel.pallas_dslash import (
+        dslash_staggered_eo_pallas_sharded_v3)
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    # T=16: local extents must be EVEN on partitioned axes (checkerboard
+    # masks use local coordinates) and >= 3 for the Naik slab fix
+    geom = LatticeGeometry((4, 4, 8, 16))
+    T, Z, Y, X = geom.lattice_shape
+    dims = (T, Z, Y, X)
+    fat_c = GaugeField.random(jax.random.PRNGKey(31), geom).data.astype(
+        jnp.complex64)
+    long_c = GaugeField.random(jax.random.PRNGKey(32), geom).data.astype(
+        jnp.complex64)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(33), geom
+                                    ).data.astype(jnp.complex64)[..., :1, :]
+    fat_eo = split_gauge_eo(fat_c, geom)
+    long_eo = split_gauge_eo(long_c, geom)
+    pe, po = even_odd_split(psi, geom)
+    src = pe if parity == 1 else po
+    fat_eo_pp = tuple(wpk.to_packed_pairs(spk.pack_links(g), jnp.float32)
+                      for g in fat_eo)
+    long_eo_pp = tuple(wpk.to_packed_pairs(spk.pack_links(g), jnp.float32)
+                       for g in long_eo)
+    src_pp = wpk.to_packed_pairs(spk.pack_staggered(src), jnp.float32)
+    ref = spk.dslash_staggered_eo_packed_pairs(
+        fat_eo_pp, src_pp, dims, parity, long_eo_pp)
+
+    mesh = make_lattice_mesh(grid=(4, 2, 1, 1), n_src=1)
+    psi_spec = P(None, None, "t", "z", None)
+    g_spec = P(None, None, None, None, "t", "z", None)
+    fn = jax.shard_map(
+        lambda fh, ft, lh, lt, p: dslash_staggered_eo_pallas_sharded_v3(
+            fh, ft, p, dims, parity, mesh, long_here_pl=lh,
+            long_there_pl=lt, interpret=True),
+        mesh=mesh,
+        in_specs=(g_spec, g_spec, g_spec, g_spec, psi_spec),
+        out_specs=psi_spec, check_vma=False)
+    args = [jax.device_put(a, NamedSharding(mesh, g_spec))
+            for a in (fat_eo_pp[parity], fat_eo_pp[1 - parity],
+                      long_eo_pp[parity], long_eo_pp[1 - parity])]
+    src_s = jax.device_put(src_pp, NamedSharding(mesh, psi_spec))
+    out = jax.jit(fn)(*args, src_s)
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-6
