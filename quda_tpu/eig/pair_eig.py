@@ -92,3 +92,47 @@ def trlm_pairs(matvec: Callable, example: jnp.ndarray, param: EigParam,
     converged = res.converged and len(kept) == param.n_ev
     return EigResult(np.asarray(kept_vals), jnp.stack(kept),
                      np.asarray(kept_res), res.restarts, converged)
+
+
+def deflation_space_pairs(matvec: Callable, example: jnp.ndarray,
+                          n_ev: int, n_kr: int = None, tol: float = 1e-6,
+                          max_restarts: int = 200, key=None,
+                          use_poly_acc: bool = False, poly_deg: int = 20,
+                          a_min: float = 0.1, a_max: float = 4.0):
+    """Complex-free deflation space (lib/deflation.cpp analog).
+
+    The spectral-solve deflation x0 = sum_k u_k <u_k, b> / lambda_k is
+    EXACT in the real picture when the basis holds BOTH real vectors of
+    each complex low eigen-plane {v, iv} — so unlike trlm_pairs (which
+    deduplicates for complex output), here the doubled spectrum is the
+    feature: ask the real TRLM for 2*n_ev vectors and keep them all.
+    The returned DeflationSpace works with eig/deflation.deflated_guess
+    unchanged (its conjugated einsums are plain real dots on pair
+    arrays), so the whole deflated solve runs with no complex dtype.
+    """
+    from .deflation import DeflationSpace
+
+    assert not jnp.issubdtype(example.dtype, jnp.complexfloating), \
+        "deflation_space_pairs wants a REAL pair-array example"
+    # the caller thinks in complex terms: double the Krylov dimension
+    # with n_ev (same convention as trlm_pairs) and validate it
+    n_kr = 2 * n_kr if n_kr is not None else max(4 * n_ev + 8, 32)
+    if n_kr <= 2 * n_ev:
+        raise ValueError(
+            f"n_kr={n_kr // 2} must exceed n_ev={n_ev} (realified "
+            f"Krylov dimension {n_kr} vs {2 * n_ev} wanted pairs)")
+    param = EigParam(n_ev=2 * n_ev, n_kr=n_kr,
+                     tol=tol, max_restarts=max_restarts, spectrum="SR",
+                     use_poly_acc=use_poly_acc, poly_deg=poly_deg,
+                     a_min=a_min, a_max=a_max)
+    res = trlm(matvec, example, param, key=key)
+    if not res.converged:
+        import warnings
+        warnings.warn(
+            "deflation_space_pairs: TRLM did not converge all "
+            f"{2 * n_ev} vectors (max residuum "
+            f"{float(np.max(res.residua)):.2e}); the space may project "
+            "onto non-eigen directions — raise n_kr/max_restarts or "
+            "loosen tol", stacklevel=2)
+    return DeflationSpace(res.evecs,
+                          jnp.asarray(res.evals, example.dtype))

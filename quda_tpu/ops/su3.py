@@ -301,6 +301,74 @@ def unit_gauge(shape, dtype=jnp.complex128):
     return jnp.broadcast_to(jnp.eye(3, dtype=dtype), shape + (3, 3))
 
 
+def compress8(u: jnp.ndarray) -> jnp.ndarray:
+    """Reconstruct-8 storage (QUDA QUDA_RECONSTRUCT_8,
+    include/gauge_field_order.h Reconstruct<8>, arXiv:0911.3191): eight
+    reals per SU(3) link.  Works on the row-swapped matrix
+    M = {{u1},{u0},{-u2}} (det M = det U; avoids the unit-gauge
+    singularity): stores arg(M00)/pi, arg(M20)/pi, and the complex
+    M01, M02, M10.  (..., 3, 3) complex -> (..., 8) real."""
+    m00 = u[..., 1, 0]
+    m20 = -u[..., 2, 0]
+    out = jnp.stack([
+        jnp.arctan2(m00.imag, m00.real) / jnp.pi,
+        jnp.arctan2(m20.imag, m20.real) / jnp.pi,
+        u[..., 1, 1].real, u[..., 1, 1].imag,
+        u[..., 1, 2].real, u[..., 1, 2].imag,
+        u[..., 0, 0].real, u[..., 0, 0].imag,
+    ], axis=-1)
+    return out            # already u's real dtype
+
+
+def reconstruct8(r: jnp.ndarray, dtype=jnp.complex64) -> jnp.ndarray:
+    """Inverse of compress8 (valid for SU(3); u0 = 1, boundary phases
+    NOT folded — fold after reconstruction).  (..., 8) -> (..., 3, 3)."""
+    m01 = (r[..., 2] + 1j * r[..., 3]).astype(dtype)
+    m02 = (r[..., 4] + 1j * r[..., 5]).astype(dtype)
+    m10 = (r[..., 6] + 1j * r[..., 7]).astype(dtype)
+    ph0 = jnp.exp(1j * jnp.pi * r[..., 0]).astype(dtype)
+    ph2 = jnp.exp(1j * jnp.pi * r[..., 1]).astype(dtype)
+    row_sum = (jnp.abs(m01) ** 2 + jnp.abs(m02) ** 2).real
+    m00_mag = jnp.sqrt(jnp.maximum(1.0 - row_sum, 0.0))
+    m00 = ph0 * m00_mag.astype(dtype)
+    col_sum = (jnp.abs(m00) ** 2 + jnp.abs(m10) ** 2).real
+    m20 = ph2 * jnp.sqrt(jnp.maximum(1.0 - col_sum, 0.0)).astype(dtype)
+    r_inv2 = (1.0 / jnp.maximum(row_sum, 1e-30)).astype(dtype)
+    a = jnp.conjugate(m00) * m10
+    m11 = -(jnp.conjugate(m20) * jnp.conjugate(m02) + a * m01) * r_inv2
+    m12 = (jnp.conjugate(m20) * jnp.conjugate(m01) - a * m02) * r_inv2
+    b = jnp.conjugate(m00) * m20
+    m21 = (jnp.conjugate(m10) * jnp.conjugate(m02) - b * m01) * r_inv2
+    m22 = -(jnp.conjugate(m10) * jnp.conjugate(m01) + b * m02) * r_inv2
+    row0 = jnp.stack([m00, m01, m02], axis=-1)
+    row1 = jnp.stack([m10, m11, m12], axis=-1)
+    row2 = jnp.stack([m20, m21, m22], axis=-1)
+    # undo the row swap: U = {{m1}, {m0}, {-m2}}
+    return jnp.stack([row1, row0, -row2], axis=-2)
+
+
+def compress13(w: jnp.ndarray, scale: float):
+    """Reconstruct-13 (QUDA Reconstruct<13>, staggered long links):
+    the link is scale * V with V in SU(3) (HISQ Naik links are scaled
+    products of unitarized links) — store V's first two rows + the
+    global scale.  Returns ((..., 2, 3) complex, scale)."""
+    return compress12(w / scale), float(scale)
+
+
+def reconstruct13(r, scale: float) -> jnp.ndarray:
+    return scale * reconstruct12(r)
+
+
+def compress9(w: jnp.ndarray, scale: float):
+    """Reconstruct-9 (QUDA Reconstruct<9>): recon-8 of V = w / scale
+    plus the global scale.  Returns ((..., 8) real, scale)."""
+    return compress8(w / scale), float(scale)
+
+
+def reconstruct9(r, scale: float, dtype=jnp.complex64) -> jnp.ndarray:
+    return scale * reconstruct8(r, dtype)
+
+
 def compress12(u: jnp.ndarray) -> jnp.ndarray:
     """Reconstruct-12 storage: keep the first two rows of an SU(3) link
     (QUDA QUDA_RECONSTRUCT_12, include/gauge_field_order.h Reconstruct<12>).

@@ -124,3 +124,128 @@ def spinor_to_cps(psi, geom: LatticeGeometry):
 
 def spinor_from_cps(array, geom: LatticeGeometry):
     return spinor_from_qdp(np.swapaxes(np.asarray(array), -1, -2), geom)
+
+
+# -- BQCD / TIFR gauge orders ----------------------------------------------
+
+def _cb_coords(geom: LatticeGeometry, parity: int):
+    """Lexicographic (t, z, y, x) coordinates of the parity's sites in
+    checkerboard rank order (x fastest, x-coordinate halved)."""
+    T, Z, Y, X = geom.lattice_shape
+    t, z, y, x = np.meshgrid(np.arange(T), np.arange(Z), np.arange(Y),
+                             np.arange(X), indexing="ij")
+    sel = ((t + z + y + x) % 2) == parity
+    return (t[sel], z[sel], y[sel], x[sel])
+
+
+def gauge_to_bqcd(gauge, geom: LatticeGeometry):
+    """canonical (4,T,Z,Y,X,3,3) -> BQCD layout (gauge_field_order.h
+    BQCDOrder:2137): [dir][parity][extended-cb-site][3][3] with the 3x3
+    TRANSPOSED and an extended halo margin of 1 site on every side
+    (exVolumeCB = (X/2+2) * (Y+2) * (Z+2) * (T+2)); interior sites sit
+    at coordinates + 1, the halo ring is zero-filled (BQCD populates it
+    by its own communication)."""
+    T, Z, Y, X = geom.lattice_shape
+    ex = (X // 2 + 2, Y + 2, Z + 2, T + 2)      # x fastest
+    ex_vol = int(np.prod(ex))
+    g = np.asarray(gauge)
+    out = np.zeros((4, 2, ex_vol, 3, 3), g.dtype)
+    for parity in (0, 1):
+        t, z, y, x = _cb_coords(geom, parity)
+        idx = (((t + 1) * ex[2] + (z + 1)) * ex[1] + (y + 1)) * ex[0] \
+            + (x // 2 + 1)
+        for mu in range(4):
+            out[mu, parity, idx] = np.swapaxes(
+                g[mu, t, z, y, x], -1, -2)
+    return out
+
+
+def gauge_from_bqcd(array, geom: LatticeGeometry):
+    T, Z, Y, X = geom.lattice_shape
+    ex = (X // 2 + 2, Y + 2, Z + 2, T + 2)
+    a = np.asarray(array).reshape(4, 2, int(np.prod(ex)), 3, 3)
+    g = np.zeros((4,) + geom.lattice_shape + (3, 3), a.dtype)
+    for parity in (0, 1):
+        t, z, y, x = _cb_coords(geom, parity)
+        idx = (((t + 1) * ex[2] + (z + 1)) * ex[1] + (y + 1)) * ex[0] \
+            + (x // 2 + 1)
+        for mu in range(4):
+            g[mu, t, z, y, x] = np.swapaxes(a[mu, parity, idx], -1, -2)
+    return jnp.asarray(g)
+
+
+def gauge_to_tifr(gauge, geom: LatticeGeometry, scale: float = 1.0):
+    """canonical -> TIFR layout (TIFROrder:2199):
+    [dir][parity][cb-site][3][3] transposed, scaled by ``scale`` — the
+    QDP per-direction even-odd order with CPS's transpose+scale twist,
+    so it delegates to the one eo-ordering implementation."""
+    q = np.stack([a.reshape(2, geom.volume // 2, 3, 3)
+                  for a in gauge_to_qdp(gauge, geom)])
+    return np.swapaxes(q, -1, -2) * scale
+
+
+def gauge_from_tifr(array, geom: LatticeGeometry, scale: float = 1.0):
+    a = np.swapaxes(
+        np.asarray(array).reshape(4, 2, geom.volume // 2, 3, 3),
+        -1, -2) / scale
+    return gauge_from_qdp(
+        [x.reshape(geom.volume, 3, 3) for x in a], geom)
+
+
+def gauge_to_tifr_padded(gauge, geom: LatticeGeometry, scale: float = 1.0):
+    """canonical -> TIFR-padded layout (TIFRPaddedOrder:2263): like TIFR
+    but the z dimension is padded by 4 (interior at z+2)."""
+    T, Z, Y, X = geom.lattice_shape
+    ex_z = Z + 4
+    ex_vol_cb = T * ex_z * Y * X // 2
+    g = np.asarray(gauge)
+    out = np.zeros((4, 2, ex_vol_cb, 3, 3), g.dtype)
+    for parity in (0, 1):
+        t, z, y, x = _cb_coords(geom, parity)
+        idx = (((t * ex_z) + (z + 2)) * Y + y) * (X // 2) + x // 2
+        for mu in range(4):
+            out[mu, parity, idx] = np.swapaxes(
+                g[mu, t, z, y, x], -1, -2) * scale
+    return out
+
+
+def gauge_from_tifr_padded(array, geom: LatticeGeometry,
+                           scale: float = 1.0):
+    T, Z, Y, X = geom.lattice_shape
+    ex_z = Z + 4
+    a = np.asarray(array).reshape(4, 2, T * ex_z * Y * X // 2, 3, 3)
+    g = np.zeros((4,) + geom.lattice_shape + (3, 3), a.dtype)
+    for parity in (0, 1):
+        t, z, y, x = _cb_coords(geom, parity)
+        idx = (((t * ex_z) + (z + 2)) * Y + y) * (X // 2) + x // 2
+        for mu in range(4):
+            g[mu, t, z, y, x] = np.swapaxes(a[mu, parity, idx],
+                                            -1, -2) / scale
+    return jnp.asarray(g)
+
+
+def spinor_to_tifr_padded(psi, geom: LatticeGeometry):
+    """canonical (T,Z,Y,X,4,3) -> TIFR-padded spinor
+    (color_spinor_field_order.h PaddedSpaceSpinorColorOrder:1683):
+    [2][padded-cb-site][4 spin][3 color], z padded by 4."""
+    T, Z, Y, X = geom.lattice_shape
+    ex_z = Z + 4
+    p = np.asarray(psi)
+    out = np.zeros((2, T * ex_z * Y * X // 2, 4, 3), p.dtype)
+    for parity in (0, 1):
+        t, z, y, x = _cb_coords(geom, parity)
+        idx = (((t * ex_z) + (z + 2)) * Y + y) * (X // 2) + x // 2
+        out[parity, idx] = p[t, z, y, x]
+    return out
+
+
+def spinor_from_tifr_padded(array, geom: LatticeGeometry):
+    T, Z, Y, X = geom.lattice_shape
+    ex_z = Z + 4
+    a = np.asarray(array).reshape(2, T * ex_z * Y * X // 2, 4, 3)
+    p = np.zeros(geom.lattice_shape + (4, 3), a.dtype)
+    for parity in (0, 1):
+        t, z, y, x = _cb_coords(geom, parity)
+        idx = (((t * ex_z) + (z + 2)) * Y + y) * (X // 2) + x // 2
+        p[t, z, y, x] = a[parity, idx]
+    return jnp.asarray(p)

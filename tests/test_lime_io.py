@@ -172,3 +172,60 @@ def test_milc_order_load_and_invert():
     rel = float(jnp.sqrt(blas.norm2(b - d.M(jnp.asarray(x)))
                          / blas.norm2(b)))
     assert rel < 1e-8
+
+
+def test_bqcd_tifr_gauge_round_trips(gauge):
+    """BQCD (extended-halo, transposed) and TIFR / TIFR-padded (scaled,
+    transposed, z-padded) gauge orders round-trip the canonical field
+    (gauge_field_order.h BQCDOrder:2137, TIFROrder:2199,
+    TIFRPaddedOrder:2263)."""
+    T, Z, Y, X = GEOM.lattice_shape
+    b = ho.gauge_to_bqcd(gauge, GEOM)
+    ex_vol = (X // 2 + 2) * (Y + 2) * (Z + 2) * (T + 2)
+    assert b.shape == (4, 2, ex_vol, 3, 3)
+    assert np.allclose(np.asarray(ho.gauge_from_bqcd(b, GEOM)),
+                       np.asarray(gauge))
+    t = ho.gauge_to_tifr(gauge, GEOM, scale=1.7)
+    assert t.shape == (4, 2, GEOM.volume // 2, 3, 3)
+    assert np.allclose(np.asarray(ho.gauge_from_tifr(t, GEOM, 1.7)),
+                       np.asarray(gauge), atol=1e-12)
+    tp = ho.gauge_to_tifr_padded(gauge, GEOM, scale=0.8)
+    assert tp.shape == (4, 2, T * (Z + 4) * Y * X // 2, 3, 3)
+    assert np.allclose(np.asarray(ho.gauge_from_tifr_padded(tp, GEOM,
+                                                            0.8)),
+                       np.asarray(gauge), atol=1e-12)
+    # transposition pin: BQCD stores column-major 3x3 at the origin
+    g = np.asarray(gauge)
+    ex = (X // 2 + 2, Y + 2, Z + 2, T + 2)
+    origin = ((1 * ex[2] + 1) * ex[1] + 1) * ex[0] + 1
+    assert np.allclose(b[0, 0, origin], g[0, 0, 0, 0, 0].T)
+
+
+def test_tifr_padded_spinor_round_trip():
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(9), GEOM).data
+    T, Z, Y, X = GEOM.lattice_shape
+    s = ho.spinor_to_tifr_padded(psi, GEOM)
+    assert s.shape == (2, T * (Z + 4) * Y * X // 2, 4, 3)
+    assert np.allclose(np.asarray(ho.spinor_from_tifr_padded(s, GEOM)),
+                       np.asarray(psi))
+
+
+def test_recon_codecs_round_trip():
+    """Reconstruct-8/9/12/13 storage codecs (gauge_field_order.h
+    Reconstruct<N>) rebuild SU(3) / scaled-SU(3) links; recon-8's f32
+    round-trip error is intrinsic to its parameterisation (it is the
+    reference's 'sloppy' storage too)."""
+    from quda_tpu.ops.su3 import (compress8, compress9, compress12,
+                                  compress13, random_su3, reconstruct8,
+                                  reconstruct9, reconstruct12,
+                                  reconstruct13)
+    u = random_su3(jax.random.PRNGKey(3), (500,),
+                   dtype=jnp.complex128).astype(jnp.complex64)
+    assert float(jnp.max(jnp.abs(
+        reconstruct12(compress12(u)) - u))) < 1e-6
+    assert float(jnp.max(jnp.abs(reconstruct8(compress8(u)) - u))) < 1e-3
+    w = (-1.0 / 24.0) * u
+    r13, s13 = compress13(w, -1.0 / 24.0)
+    assert float(jnp.max(jnp.abs(reconstruct13(r13, s13) - w))) < 1e-7
+    r9, s9 = compress9(w, -1.0 / 24.0)
+    assert float(jnp.max(jnp.abs(reconstruct9(r9, s9) - w))) < 1e-6
