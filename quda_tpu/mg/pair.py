@@ -179,12 +179,21 @@ class PairTransfer:
 @dataclasses.dataclass
 class PairCoarseOperator:
     """Nearest-neighbour coarse stencil on (latc, 2, N, 2) pair fields
-    (realified mg/coarse.CoarseOperator)."""
+    (realified mg/coarse.CoarseOperator).
+
+    ``use_embedding=True`` applies each link as ONE real
+    (2Nc, 2Nc) matmul on the interleaved embedding instead of four
+    (Nc, Nc) einsums: identical flops (a complex matvec is 4 Nc^2 real
+    multiplies either way) but a single, larger MXU contraction per
+    link — the shape the systolic array wants.  Embedded links are
+    built lazily and cached.
+    """
 
     x_diag: jnp.ndarray                      # (latc, Nc, Nc, 2)
     y: Dict[Tuple[int, int], jnp.ndarray]    # (mu,sign) -> (latc, Nc, Nc, 2)
     n_vec: int
     g5_hermitian: bool = True
+    use_embedding: bool = False
 
     @property
     def nc(self):
@@ -196,15 +205,30 @@ class PairCoarseOperator:
     def _unflat(self, v):
         return v.reshape(v.shape[:4] + (2, self.n_vec, 2))
 
+    def _emb(self, key):
+        cache = self.__dict__.setdefault("_emb_cache", {})
+        if key not in cache:
+            m = self.x_diag if key == "diag" else self.y[key]
+            cache[key] = _interleave(m)      # (latc, 2Nc, 2Nc)
+        return cache[key]
+
+    def _apply(self, key, f):
+        """One coarse link application on the flat (latc, Nc, 2) field."""
+        if self.use_embedding:
+            # vector pairs -> interleaved (.., 2Nc): (re0, im0, re1, ..)
+            fi = f.reshape(f.shape[:4] + (self.nc * 2,))
+            out = jnp.einsum("...ab,...b->...a", self._emb(key), fi)
+            return out.reshape(f.shape)
+        m = self.x_diag if key == "diag" else self.y[key]
+        return _pair_ein("...ab,...b->...a", m, f)
+
     def diag(self, v):
-        f = self._flat(v)
-        return self._unflat(_pair_ein("...ab,...b->...a", self.x_diag, f))
+        return self._unflat(self._apply("diag", self._flat(v)))
 
     def hop(self, v, mu, sign):
         f = self._flat(v)
         nbr = jnp.roll(f, -sign, axis=axis_of_mu(mu))
-        return self._unflat(
-            _pair_ein("...ab,...b->...a", self.y[(mu, sign)], nbr))
+        return self._unflat(self._apply((mu, sign), nbr))
 
     def M(self, v):
         out = self.diag(v)
@@ -228,7 +252,15 @@ class PairCoarseOperator:
     def from_complex(cls, coarse) -> "PairCoarseOperator":
         return cls(to_pairs(coarse.x_diag, F32),
                    {d: to_pairs(coarse.y[d], F32) for d in DIRS},
-                   coarse.n_vec, coarse.g5_hermitian)
+                   coarse.n_vec, coarse.g5_hermitian,
+                   use_embedding=_embed_default())
+
+
+def _embed_default() -> bool:
+    """QUDA_TPU_MG_EMBED: apply coarse links as single interleaved-
+    embedding matmuls (MXU-shaped) instead of 4-einsum pair products."""
+    from ..utils import config as qconf
+    return str(qconf.get("QUDA_TPU_MG_EMBED", fresh=True)) == "1"
 
 
 def build_coarse_pairs(fine_parts, transfer: PairTransfer,
@@ -294,7 +326,8 @@ def build_coarse_pairs(fine_parts, transfer: PairTransfer,
 
     x_diag = jnp.stack(diag_cols, axis=-2)         # (latc, Nc, Nc, 2)
     y = {d: jnp.stack(hop_cols[d], axis=-2) for d in DIRS}
-    return PairCoarseOperator(x_diag, y, n, g5_hermitian)
+    return PairCoarseOperator(x_diag, y, n, g5_hermitian,
+                              use_embedding=_embed_default())
 
 
 # -- fine-level pair adapters ----------------------------------------------

@@ -83,6 +83,12 @@ _register("QUDA_TPU_PALLAS", "choice", "",
           "solves; empty = autotuned choice",
           ("", "0", "1"),
           reference="QUDA_ENABLE_DSLASH_POLICY")
+_register("QUDA_TPU_MG_EMBED", "choice", "",
+          "apply pair-MG coarse links as single interleaved-embedding "
+          "matmuls ('1') instead of 4-einsum pair products; empty/'0' "
+          "= pair einsums (flip after chip measurement)",
+          ("", "0", "1"),
+          reference="coarse-dslash MMA path (lib/dslash_coarse.cu)")
 _register("QUDA_TPU_RECONSTRUCT", "choice", "18",
           "gauge link storage for v3 pallas kernels: '18' = full, "
           "'12' = two rows + in-kernel third-row reconstruction "

@@ -160,6 +160,25 @@ def test_pair_mg_no_complex_dtype_anywhere(setup):
     assert "complex" not in str(jaxpr)
 
 
+def test_pair_coarse_embedding_matches_einsums(setup):
+    """use_embedding=True (one interleaved (2Nc,2Nc) real matmul per
+    link, the MXU-shaped coarse apply) == the 4-einsum pair products."""
+    import dataclasses
+    d = setup
+    mg = PairMG(d, GEOM, [MGLevelParam(block=BLOCK, n_vec=4,
+                                       setup_iters=8)],
+                key=jax.random.PRNGKey(3))
+    co = mg.levels[0]["coarse"]
+    co_emb = dataclasses.replace(co, use_embedding=True)
+    v = jax.random.normal(jax.random.PRNGKey(5),
+                          co.x_diag.shape[:4] + (2, co.n_vec, 2),
+                          jnp.float32)
+    a = co.M(v)
+    b = co_emb.M(v)
+    assert float(jnp.max(jnp.abs(a - b))) < 1e-5 * float(
+        jnp.max(jnp.abs(a)))
+
+
 def test_gcr_mg_api_routes_to_pair_hierarchy(monkeypatch):
     """invertQuda(inv_type=gcr-mg) under the packed mode must build and
     reuse the complex-free resident hierarchy and still converge
