@@ -91,6 +91,12 @@ def dslash_pallas_sharded(gauge_pl, gauge_bw_pl, psi_pl, X: int, mesh,
     """
     from ..ops.wilson_pallas_packed import dslash_pallas_packed
 
+    if gauge_pl.shape[1] == 2:
+        raise ValueError(
+            "sharded pallas policies need full 18-real link storage: "
+            "the exterior face fixes read 3x3 link slabs "
+            "(reconstruct-12 faces are a planned follow-up; pass the "
+            "uncompressed gauge here)")
     n_t, n_z = mesh.shape["t"], mesh.shape["z"]
     if mesh.shape["y"] != 1 or mesh.shape["x"] != 1:
         raise ValueError(
@@ -277,6 +283,12 @@ def dslash_pallas_sharded_v3(gauge_pl, psi_pl, X: int, mesh,
     """
     from ..ops.wilson_pallas_packed import dslash_pallas_packed_v3
 
+    if gauge_pl.shape[1] == 2:
+        raise ValueError(
+            "sharded pallas policies need full 18-real link storage: "
+            "the exterior face fixes read 3x3 link slabs "
+            "(reconstruct-12 faces are a planned follow-up; pass the "
+            "uncompressed gauge here)")
     n_t, n_z = mesh.shape["t"], mesh.shape["z"]
     if mesh.shape["y"] != 1 or mesh.shape["x"] != 1:
         raise ValueError(
