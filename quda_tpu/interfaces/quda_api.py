@@ -605,10 +605,15 @@ def _mg_level_params(mp: "MultigridParamAPI"):
 
 
 def _mg_pairs_enabled(d, param: InvertParam, on_tpu: bool) -> bool:
-    """Pair-hierarchy gate: Wilson only, and — like every other pair gate
-    in this file — never silently degrade an f64 solve to f32 pairs."""
-    return (_packed_enabled(on_tpu)
-            and type(d).__name__ == "DiracWilson"
+    """Pair-hierarchy gate: Wilson or plain staggered (the improved
+    operator's MG is fat-only — the complex route documents the same
+    restriction but can at least defect-correct), and — like every
+    other pair gate in this file — never silently degrade an f64 solve
+    to f32 pairs."""
+    family_ok = (type(d).__name__ == "DiracWilson"
+                 or (type(d).__name__ == "DiracStaggered"
+                     and not getattr(d, "improved", False)))
+    return (_packed_enabled(on_tpu) and family_ok
             and (param.cuda_prec == "single" or on_tpu))
 
 

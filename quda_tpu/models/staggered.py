@@ -64,14 +64,7 @@ class DiracStaggered(Dirac):
         return 2.0 * self.mass * psi
 
     def hop(self, psi, mu, sign):
-        from ..ops.shift import shift
-        from ..ops.su3 import dagger
-        if sign > 0:
-            return 0.5 * jnp.einsum("...ab,...sb->...sa", self.fat[mu],
-                                    shift(psi, mu, +1))
-        ub = shift(dagger(self.fat[mu]), mu, -1)
-        return -0.5 * jnp.einsum("...ab,...sb->...sa", ub,
-                                 shift(psi, mu, -1))
+        return sops.hop_term(self.fat, psi, mu, sign)
 
 
 class DiracStaggeredPC(DiracPC):

@@ -60,6 +60,19 @@ def dslash_full(fat: jnp.ndarray, psi: jnp.ndarray,
     return out
 
 
+def hop_term(links: jnp.ndarray, psi: jnp.ndarray, mu: int,
+             sign: int) -> jnp.ndarray:
+    """Single-direction staggered hop (the MG probing decomposition:
+    D = sum hop_term).  Polymorphic via _color_mul's dispatch on the
+    LINKS operand: complex links + complex psi, or pair links + pair
+    psi (mg/pair.PairStaggeredLevelOp)."""
+    from .su3 import dagger
+    if sign > 0:
+        return 0.5 * _color_mul(links[mu], shift(psi, mu, +1))
+    ub = shift(dagger(links[mu]), mu, -1)
+    return -0.5 * _color_mul(ub, shift(psi, mu, -1))
+
+
 def dslash_eo(fat_eo, psi: jnp.ndarray, geom: LatticeGeometry,
               target_parity: int, long_eo=None) -> jnp.ndarray:
     """Checkerboarded staggered hop: parity-(1-p) field -> parity-p sites."""
