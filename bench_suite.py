@@ -639,14 +639,17 @@ def main(argv):
             _jax.random.PRNGKey(3), geo_m.lattice_shape + (4, 3, 2),
             jnp.float32), dev)
 
-        def time_apply(mg):
-            fn = _jax.jit(mg.precondition)
-            out = fn(b)
-            out.block_until_ready()
+        def time_avg(jf, arg, n=5):
+            """jf must already be jitted (avoid re-trace per call)."""
+            jf(arg).block_until_ready()          # compile + warm
             t1 = time.perf_counter()
-            out = fn(b)
+            for _ in range(n):
+                out = jf(arg)
             _ = _fetch(jnp.sum(out.astype(jnp.float32) ** 2))
-            return time.perf_counter() - t1
+            return (time.perf_counter() - t1) / n
+
+        def time_apply(mg):
+            return time_avg(_jax.jit(mg.precondition), b)
 
         # pin BOTH representations explicitly: with QUDA_TPU_MG_EMBED=1
         # the built coarse op is already embedded and the comparison
@@ -660,6 +663,39 @@ def main(argv):
             "setup_secs": round(setup_s, 2), "setup_platform": "cpu",
             "apply_secs": round(secs_v, 4),
             "apply_secs_embed_coarse": round(secs_e, 4),
+            "platform": platform, "lattice": [Lm] * 4,
+            "n_vec": 8}), flush=True)
+
+        # Yhat A/B (the COMPONENTS.md §2.7 measurement debt): explicit
+        # X^{-1}Y links vs X^{-1}-after-stencil, per coarse apply
+        from quda_tpu.mg.pair import (_deinterleave, _interleave,
+                                      _pair_ein, yhat_links)
+        hat = yhat_links(co)
+        xinv = _jax.device_put(_deinterleave(jnp.linalg.inv(
+            _interleave(co.x_diag))), dev)
+        vc = _jax.device_put(_jax.random.normal(
+            _jax.random.PRNGKey(5),
+            co.x_diag.shape[:4] + (2, co.n_vec, 2), jnp.float32), dev)
+
+        def fly(v):
+            mv = co.M(v)
+            f = mv.reshape(mv.shape[:4] + (co.nc, 2))
+            return _pair_ein("...ab,...b->...a", xinv, f).reshape(
+                v.shape)
+
+        # interleave the two forms per round and keep the min of each:
+        # a single pass is order/noise-sensitive on shared hosts
+        # (observed 6x artifacts), and a load spike must not be able to
+        # inflate all of one form's samples
+        jf_hat, jf_fly = _jax.jit(hat.M), _jax.jit(fly)
+        t_hat, t_fly = float("inf"), float("inf")
+        for _ in range(3):
+            t_hat = min(t_hat, time_avg(jf_hat, vc, n=20))
+            t_fly = min(t_fly, time_avg(jf_fly, vc, n=20))
+        print(json.dumps({
+            "suite": "mg", "name": "coarse_yhat_ab",
+            "explicit_yhat_secs": round(t_hat, 5),
+            "xinv_after_stencil_secs": round(t_fly, 5),
             "platform": platform, "lattice": [Lm] * 4,
             "n_vec": 8}), flush=True)
 

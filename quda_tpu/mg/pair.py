@@ -194,6 +194,7 @@ class PairCoarseOperator:
     n_vec: int
     g5_hermitian: bool = True
     use_embedding: bool = False
+    identity_diag: bool = False              # Yhat form (yhat_links)
 
     @property
     def nc(self):
@@ -223,6 +224,8 @@ class PairCoarseOperator:
         return _pair_ein("...ab,...b->...a", m, f)
 
     def diag(self, v):
+        if self.identity_diag:
+            return v            # Yhat form: M_hat = v + sum(hops)
         return self._unflat(self._apply("diag", self._flat(v)))
 
     def hop(self, v, mu, sign):
@@ -254,6 +257,28 @@ class PairCoarseOperator:
                    {d: to_pairs(coarse.y[d], F32) for d in DIRS},
                    coarse.n_vec, coarse.g5_hermitian,
                    use_embedding=_embed_default())
+
+
+def yhat_links(coarse: PairCoarseOperator) -> "PairCoarseOperator":
+    """Explicit preconditioned coarse links Yhat = X^{-1} Y (QUDA
+    calculateYhat, lib/coarse_op_preconditioned.in.cu:329): returns a
+    coarse operator whose diag is the identity and whose links are
+    X^{-1}-premultiplied, so M_hat = I + sum X^{-1} Y hops — the
+    Jacobi-preconditioned coarse stencil QUDA smooths with.
+
+    COMPONENTS.md §2.7 argues XLA's fusion makes the precompute moot on
+    TPU (apply X^{-1} on the fly); this explicit form exists so that
+    claim can be MEASURED — bench_suite's mg suite times both.  The
+    inverse runs through the interleaved embedding (complex-free).
+    """
+    inv_emb = jnp.linalg.inv(_interleave(coarse.x_diag))
+    xinv = _deinterleave(inv_emb)                    # (latc, Nc, Nc, 2)
+    yhat = {d: _pair_ein("...ab,...bc->...ac", xinv, coarse.y[d])
+            for d in DIRS}
+    # identity_diag: M_hat = v + sum(hops) — no dense identity matmul
+    # (charging one would bias the A/B against the explicit form)
+    return dataclasses.replace(coarse, y=yhat, g5_hermitian=False,
+                               identity_diag=True)
 
 
 def _embed_default() -> bool:

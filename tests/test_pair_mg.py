@@ -240,3 +240,27 @@ def test_pair_staggered_mg_solve():
     a = mg.adapter
     jaxpr = jax.make_jaxpr(lambda v: a.M_std(mg.precondition(v)))(b)
     assert "complex" not in str(jaxpr)
+
+
+def test_yhat_links_match_on_the_fly(setup):
+    """Explicit Yhat = X^{-1} Y coarse links (calculateYhat analog) ==
+    applying X^{-1} after the plain coarse stencil — the two forms whose
+    chip timing settles the COMPONENTS.md Yhat-omission argument."""
+    from quda_tpu.mg.pair import _interleave, _deinterleave, yhat_links
+    d = setup
+    mg = PairMG(d, GEOM, [MGLevelParam(block=BLOCK, n_vec=4,
+                                       setup_iters=8)],
+                key=jax.random.PRNGKey(3))
+    co = mg.levels[0]["coarse"]
+    hat = yhat_links(co)
+    v = jax.random.normal(jax.random.PRNGKey(5),
+                          co.x_diag.shape[:4] + (2, co.n_vec, 2),
+                          jnp.float32)
+    lhs = hat.M(v)
+    xinv = _deinterleave(jnp.linalg.inv(_interleave(co.x_diag)))
+    mv = co.M(v)
+    f = mv.reshape(mv.shape[:4] + (co.nc, 2))
+    from quda_tpu.mg.pair import _pair_ein
+    rhs = _pair_ein("...ab,...b->...a", xinv, f).reshape(v.shape)
+    scale = float(jnp.max(jnp.abs(rhs)))
+    assert float(jnp.max(jnp.abs(lhs - rhs))) < 1e-4 * scale
