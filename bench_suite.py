@@ -667,12 +667,16 @@ def main(argv):
             "n_vec": 8}), flush=True)
 
         # Yhat A/B (the COMPONENTS.md §2.7 measurement debt): explicit
-        # X^{-1}Y links vs X^{-1}-after-stencil, per coarse apply
+        # X^{-1}Y links vs X^{-1}-after-stencil, per coarse apply.
+        # Representation pinned to the 4-einsum pair form (and recorded
+        # in the JSON) so records compare across hosts/configs; the
+        # embedding inverse is computed ONCE and shared by both forms.
         from quda_tpu.mg.pair import (_deinterleave, _interleave,
                                       _pair_ein, yhat_links)
-        hat = yhat_links(co)
+        co = _dc.replace(co, use_embedding=False)
         xinv = _jax.device_put(_deinterleave(jnp.linalg.inv(
             _interleave(co.x_diag))), dev)
+        hat = yhat_links(co, xinv=xinv)
         vc = _jax.device_put(_jax.random.normal(
             _jax.random.PRNGKey(5),
             co.x_diag.shape[:4] + (2, co.n_vec, 2), jnp.float32), dev)
@@ -696,6 +700,7 @@ def main(argv):
             "suite": "mg", "name": "coarse_yhat_ab",
             "explicit_yhat_secs": round(t_hat, 5),
             "xinv_after_stencil_secs": round(t_fly, 5),
+            "use_embedding": False,
             "platform": platform, "lattice": [Lm] * 4,
             "n_vec": 8}), flush=True)
 

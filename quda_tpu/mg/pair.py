@@ -259,7 +259,9 @@ class PairCoarseOperator:
                    use_embedding=_embed_default())
 
 
-def yhat_links(coarse: PairCoarseOperator) -> "PairCoarseOperator":
+def yhat_links(coarse: PairCoarseOperator,
+               xinv: jnp.ndarray | None = None
+               ) -> "PairCoarseOperator":
     """Explicit preconditioned coarse links Yhat = X^{-1} Y (QUDA
     calculateYhat, lib/coarse_op_preconditioned.in.cu:329): returns a
     coarse operator whose diag is the identity and whose links are
@@ -271,8 +273,9 @@ def yhat_links(coarse: PairCoarseOperator) -> "PairCoarseOperator":
     claim can be MEASURED — bench_suite's mg suite times both.  The
     inverse runs through the interleaved embedding (complex-free).
     """
-    inv_emb = jnp.linalg.inv(_interleave(coarse.x_diag))
-    xinv = _deinterleave(inv_emb)                    # (latc, Nc, Nc, 2)
+    if xinv is None:
+        xinv = _deinterleave(jnp.linalg.inv(
+            _interleave(coarse.x_diag)))             # (latc, Nc, Nc, 2)
     yhat = {d: _pair_ein("...ab,...bc->...ac", xinv, coarse.y[d])
             for d in DIRS}
     # identity_diag: M_hat = v + sum(hops) — no dense identity matmul
