@@ -122,9 +122,15 @@ def trlm(matvec: Callable, example: jnp.ndarray, param: EigParam,
         lambda V, U: jnp.einsum("ij,i...->j...", jnp.asarray(U, V.dtype), V))
 
     def lanczos_extend(V, T, start, prev_beta_vec):
-        """Extend basis from slot `start` to m with full reorth."""
+        """Extend basis from slot `start` to m with full reorth.
+
+        The matvec output is cast to the basis dtype: a higher-precision
+        operator (e.g. a double-precision resident gauge driving a
+        single-precision eigensolve) must not silently promote the
+        Krylov basis updates (scatter-dtype mismatch otherwise)."""
         for j in range(start, m):
             w = op_j(V[j - 1]) if j > 0 else op_j(V[0])
+            w = w.astype(V.dtype)
             alpha = float(blas.cdot(V[j - 1], w).real)
             T[j - 1, j - 1] = alpha
             # full re-orthogonalisation (stability; QUDA blockOrthogonalize)
@@ -141,9 +147,9 @@ def trlm(matvec: Callable, example: jnp.ndarray, param: EigParam,
                 coef = jnp.einsum("i...,...->i", jnp.conjugate(V[:j]), w)
                 w = w - jnp.einsum("i,i...->...", coef, V[:j])
                 beta = float(np.sqrt(float(blas.norm2(w))))
-            V = V.at[j].set(w / beta)
+            V = V.at[j].set((w / beta).astype(V.dtype))
         # final alpha and residual beta
-        w = op_j(V[m - 1])
+        w = op_j(V[m - 1]).astype(V.dtype)
         T[m - 1, m - 1] = float(blas.cdot(V[m - 1], w).real)
         coef = jnp.einsum("i...,...->i", jnp.conjugate(V), w)
         w = w - jnp.einsum("i,i...->...", coef, V)

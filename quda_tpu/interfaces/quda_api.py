@@ -845,12 +845,65 @@ def eigensolve_quda(eig_param: EigParamAPI, invert_param: InvertParam):
         shape = shape[:-2] + (2, 4, 3)   # flavor doublet axis
     if invert_param.dslash_type in _DWF_TYPES:
         shape = (invert_param.Ls,) + shape
-    example = jnp.zeros(shape, dtype)
     p = EigParam(n_ev=eig_param.n_ev, n_kr=eig_param.n_kr,
                  tol=eig_param.tol, max_restarts=eig_param.max_restarts,
                  use_poly_acc=eig_param.use_poly_acc,
                  poly_deg=eig_param.poly_deg, a_min=eig_param.a_min,
                  a_max=eig_param.a_max, spectrum=eig_param.spectrum)
+    on_tpu = jax.default_backend() == "tpu"
+    if (eig_param.eig_type == "trlm" and eig_param.use_norm_op and pc
+            and _packed_enabled(on_tpu)
+            and (invert_param.cuda_prec == "single" or on_tpu)
+            and invert_param.dslash_type in ("wilson", "staggered",
+                                             "asqtad", "hisq")):
+        # complex-free TRLM (eig/pair_eig.py): the only eigensolve that
+        # executes on TPU runtimes without complex64.  Realified
+        # Hermitian Lanczos on the pair operator; kept vectors convert
+        # to complex at the host boundary.  Dispatched BEFORE the
+        # complex example/operator construction below so no complex
+        # device array is materialised on this path.
+        import numpy as np
+        from ..eig.pair_eig import trlm_pairs
+        T, Z, Y, X = geom.lattice_shape
+        if invert_param.dslash_type == "wilson":
+            sl = d.packed().pairs(jnp.float32,
+                                  use_pallas=_pallas_enabled(on_tpu))
+            mv = sl.MdagM_pairs
+            ex_pp = jnp.zeros((4, 3, 2, T, Z, Y * X // 2), jnp.float32)
+            pair_axis = 2
+            conv = sl.solution_from_pairs
+        else:
+            ad = _StaggeredPairsSolve(d, _pallas_enabled(on_tpu))
+            mv = ad.M
+            ex_pp = jnp.zeros((3, 2, T, Z, Y * X // 2), jnp.float32)
+            pair_axis = 1
+            conv = ad.op._from_pairs
+        res = trlm_pairs(mv, ex_pp, p, pair_axis)
+        if res.evecs.shape[0] < eig_param.n_ev:
+            qlog.printq(
+                f"eigensolve (pair route): only {res.evecs.shape[0]} of "
+                f"{eig_param.n_ev} eigenpairs converged/deduplicated — "
+                "raise n_kr/max_restarts or loosen tol",
+                qlog.SUMMARIZE)
+        evecs_h = np.stack([np.asarray(conv(res.evecs[i], dtype))
+                            for i in range(res.evecs.shape[0])])
+        # host-side modified Gram-Schmidt: converged non-degenerate
+        # vectors are already orthonormal (the rotation is ~identity);
+        # within DEGENERATE eigenspaces the realified dedup only
+        # guarantees |overlap| < 0.5, and deflation consumers assume an
+        # orthonormal basis
+        for i in range(evecs_h.shape[0]):
+            for k in range(i):
+                ov = np.vdot(evecs_h[k], evecs_h[i])
+                evecs_h[i] = evecs_h[i] - ov * evecs_h[k]
+            evecs_h[i] /= np.sqrt(np.vdot(evecs_h[i],
+                                          evecs_h[i]).real)
+        evecs = jnp.asarray(evecs_h)
+        if eig_param.vec_outfile:
+            from ..utils.io import save_vectors
+            save_vectors(eig_param.vec_outfile, evecs, res.evals)
+        return res.evals, evecs
+    example = jnp.zeros(shape, dtype)
     if eig_param.use_norm_op:
         # staggered PC: M already IS the (Hermitian) normal operator
         op = d.M if getattr(d, "hermitian", False) else d.MdagM

@@ -99,3 +99,44 @@ def test_deflated_pair_cg_cuts_iterations():
     # executability: no complex dtype anywhere in the deflated step
     jaxpr = jax.make_jaxpr(lambda v: mv(deflated_guess(space, v)))(b)
     assert "complex" not in str(jaxpr)
+
+
+def test_eigensolve_api_routes_complex_free(monkeypatch):
+    """eigensolveQuda under the packed mode runs the realified TRLM and
+    must reproduce the complex route's smallest normal-op eigenvalues."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from quda_tpu.fields.geometry import LatticeGeometry
+    from quda_tpu.fields.gauge import GaugeField
+    from quda_tpu.interfaces import quda_api as api
+    from quda_tpu.interfaces.params import (EigParamAPI, GaugeParam,
+                                            InvertParam)
+
+    dims = (4, 4, 4, 4)
+    geom = LatticeGeometry(dims)
+    U = np.asarray(GaugeField.random(jax.random.PRNGKey(0), geom).data)
+    api.init_quda()
+    api.load_gauge_quda(U, GaugeParam(X=dims))
+    try:
+        ip = InvertParam(dslash_type="wilson", kappa=0.12,
+                         solve_type="normop-pc", cuda_prec="single")
+        ep = EigParamAPI(eig_type="trlm", n_ev=4, n_kr=24, tol=1e-6,
+                         use_norm_op=True, spectrum="SR")
+        monkeypatch.setenv("QUDA_TPU_PACKED", "1")
+        evals_p, evecs_p = api.eigensolve_quda(ep, ip)
+        monkeypatch.setenv("QUDA_TPU_PACKED", "0")
+        evals_c, _ = api.eigensolve_quda(ep, ip)
+        assert not jnp.iscomplexobj(jnp.asarray(evals_p))
+        assert np.allclose(np.sort(np.asarray(evals_p).real),
+                           np.sort(np.asarray(evals_c).real),
+                           rtol=1e-3)
+        # the converted eigenvectors are genuine eigenvectors of MdagM
+        d = api._build_dirac(ip, True)
+        v0 = evecs_p[0]
+        lam = float(np.sort(np.asarray(evals_p).real)[0])
+        r = d.MdagM(v0) - evals_p[0] * v0
+        from quda_tpu.ops import blas
+        assert float(jnp.sqrt(blas.norm2(r))) < 1e-3 * max(lam, 1e-3)
+    finally:
+        api.end_quda()
