@@ -1,0 +1,24 @@
+#!/bin/bash
+# First-TPU-window measurement queue (PERF.md round-4 checklist).
+# Probe first; run ONE phase at a time (never two TPU processes); every
+# phase is timeout-bounded and appends to measurements_tpu.log.
+set -u
+cd "$(dirname "$0")"
+LOG=measurements_tpu.log
+probe=$(timeout 90 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+echo "[$(date -u +%FT%TZ)] probe: ${probe:-none}" | tee -a "$LOG"
+if [ "$probe" != "tpu" ]; then
+  echo "tunnel down; aborting" | tee -a "$LOG"
+  exit 1
+fi
+run() {
+  echo "[$(date -u +%FT%TZ)] == $*" | tee -a "$LOG"
+  timeout 2400 "$@" 2>&1 | tail -20 | tee -a "$LOG"
+}
+run python bench.py
+run python bench_suite.py dslash
+run python bench_suite.py solver
+run python bench_suite.py mg
+run python bench_suite.py gauge
+run python bench_suite.py blas
+echo "[$(date -u +%FT%TZ)] queue complete" | tee -a "$LOG"
