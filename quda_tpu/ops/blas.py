@@ -15,6 +15,7 @@ already exceeds QUDA's QUDA_DETERMINISTIC_REDUCE guarantee.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -62,6 +63,36 @@ def heavy_quark_residual_norm(x, r):
     ratio = jnp.where(xs > 0, rs / jnp.where(xs > 0, xs, 1.0), 1.0)
     vol = ratio.size
     return norm2(x), norm2(r), jnp.sum(ratio) / vol
+
+
+# -- compensated reductions -------------------------------------------------
+# The dbldbl.h analog (include/dbldbl.h via include/reduce_helper.h): global
+# sums whose accumulation error is O(eps^2 log n) instead of the plain-sum
+# O(eps sqrt(n)) — used wherever a reported residual must be trusted below
+# the f32 accumulation floor (reliable updates, final true_res).  f64
+# inputs already exceed that floor and keep the plain reduction.
+
+def _needs_comp(x) -> bool:
+    return x.dtype not in (jnp.float64, jnp.complex128)
+
+
+def norm2_comp(x):
+    """|x|^2 with two_prod/two_sum compensation (f32-class inputs)."""
+    if not _needs_comp(x):
+        return norm2(x)
+    from . import df64 as dfm
+    v = jnp.stack([x.real, x.imag]) if jnp.iscomplexobj(x) else x
+    return dfm.to_f32(dfm.norm2_f32(v))
+
+
+def cdot_comp(x, y):
+    """<x, y> with compensation; returns a complex scalar."""
+    if not _needs_comp(x):
+        return cdot(x, y)
+    from . import df64 as dfm
+    re = dfm.add(dfm.dot_f32(x.real, y.real), dfm.dot_f32(x.imag, y.imag))
+    im = dfm.sub(dfm.dot_f32(x.real, y.imag), dfm.dot_f32(x.imag, y.real))
+    return jax.lax.complex(dfm.to_f32(re), dfm.to_f32(im))
 
 
 # -- axpy family ------------------------------------------------------------

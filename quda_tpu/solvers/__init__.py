@@ -10,8 +10,8 @@ from .bicgstab import bicgstab, bicgstab_l  # noqa: F401
 from .gcr import gcr, mr, mr_fixed, sd  # noqa: F401
 from .ca import ca_cg, ca_gcr  # noqa: F401
 from .multishift import multishift_cg  # noqa: F401
-from .mixed import (cg_reliable, dtype_codec, pair_codec,  # noqa: F401
-                    pair_inplace_codec, solve_refined)
+from .mixed import (cg_reliable, cg_reliable_df, dtype_codec,  # noqa: F401
+                    pair_codec, pair_inplace_codec, solve_refined)
 from .chrono import ChronoStore, mre_guess  # noqa: F401
 
 _REGISTRY = {

@@ -144,7 +144,9 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
         def reliable(_):
             x_new = c["x"] + codec.up(x_lo)
             r_true = c["b"] - matvec_hi(x_new)
-            r2_true = blas.norm2(r_true).astype(rdt)
+            # compensated: the reported residual must be trustworthy
+            # below the plain-f32 accumulation floor (dbldbl.h analog)
+            r2_true = blas.norm2_comp(r_true).astype(rdt)
             return dict(
                 c, x=x_new, r=r_true, r2=r2_true,
                 r_lo=codec.down(r_true),
@@ -166,8 +168,101 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
     # final fold of any un-injected sloppy contribution
     x_fin = out["x"] + codec.up(out["x_lo"])
     r_fin = b - matvec_hi(x_fin)
-    r2_fin = blas.norm2(r_fin)
+    r2_fin = blas.norm2_comp(r_fin)
     return SolverResult(x_fin, out["k"], r2_fin, r2_fin <= stop)
+
+
+def cg_reliable_df(op_df, matvec_lo: Callable, rhs_df, codec: StorageCodec,
+                   tol: float = 1e-10, maxiter: int = 4000,
+                   delta: float = 0.1) -> SolverResult:
+    """Extended-precision reliable-update CG on the normal equations.
+
+    The TPU analog of QUDA's double-precise / sloppy-pair solve to 1e-10
+    (fp64 matPrecise in lib/inv_cg_quda.cpp:63 + dbldbl accumulators,
+    include/dbldbl.h): the precise side runs in df64 (float32-pair,
+    ops/df64.py) — no f64, no complex, executable on TPU.
+
+    * ``op_df``: df64 operator bundle (ops/wilson_df64.WilsonPCDF64):
+      ``M``/``Mdag`` on df64 fields and ``residual_df``.
+    * ``matvec_lo``: the SLOPPY normal operator (MdagM) acting on the
+      storage representation (f32/bf16 pair arrays, same layout as the
+      df64 hi word).
+    * ``rhs_df``: df64 DIRECT rhs (the PC system b).  The loop iterates
+      on Mdag M x = Mdag b in sloppy storage; convergence is judged on
+      the df64 DIRECT residual |b - M x| recomputed at every reliable
+      update, so the returned r2 certifies the direct system at the
+      ~1e-14 df64 floor.
+
+    The normal-residual trigger threshold tightens itself (x1/16) when
+    the normal system looks converged but the direct residual is not —
+    the branch-free analog of QUDA tightening solver tolerances between
+    refinement cycles.
+    """
+    from ..ops import df64 as dfm
+
+    f32 = jnp.float32
+    b2d = dfm.to_f32(dfm.norm2(rhs_df)).astype(f32)
+    stop_d = (tol ** 2) * b2d
+
+    rn_df = op_df.Mdag(rhs_df)           # normal residual at x = 0
+    rn = dfm.to_f32(rn_df)
+    bn2 = dfm.to_f32(dfm.norm2_f32(rn)).astype(f32)
+    stop_n = (tol ** 2) * bn2
+
+    x = (jnp.zeros_like(rhs_df[0]), jnp.zeros_like(rhs_df[1]))
+    r_lo = codec.down(rn)
+    x_lo = jnp.zeros_like(r_lo)
+    rn2 = codec.norm2(r_lo).astype(f32)
+
+    def cond(c):
+        return jnp.logical_and(c["d2"] > stop_d, c["k"] < maxiter)
+
+    def body(c):
+        Ap = matvec_lo(c["p"])
+        pAp = codec.redot(c["p"], Ap).astype(f32)
+        alpha = c["r2_lo"] / jnp.maximum(pAp, jnp.finfo(f32).tiny)
+        x_lo = codec.axpy(alpha, c["p"], c["x_lo"])
+        r_lo = codec.axpy(-alpha, Ap, c["r_lo"])
+        r2_new = codec.norm2(r_lo).astype(f32)
+        beta = r2_new / c["r2_lo"]
+        p = codec.axpy(beta, c["p"], r_lo)
+        r2max = jnp.maximum(c["r2max"], r2_new)
+
+        do_reliable = jnp.logical_or(r2_new < (delta ** 2) * r2max,
+                                     r2_new < c["stop_n"])
+
+        def reliable(_):
+            x_new = dfm.add(c["x"], dfm.promote(codec.up(x_lo)))
+            d_df = op_df.residual_df(rhs_df, x_new)
+            d2 = dfm.to_f32(dfm.norm2(d_df)).astype(f32)
+            rn_df = op_df.Mdag(d_df)
+            rn = dfm.to_f32(rn_df)
+            rn2_true = dfm.to_f32(dfm.norm2_f32(rn)).astype(f32)
+            # not converged on the direct system but the normal target
+            # was met -> tighten the inner target
+            tighten = jnp.logical_and(d2 > stop_d,
+                                      rn2_true <= c["stop_n"])
+            stop_n_new = jnp.where(tighten, c["stop_n"] / 16.0,
+                                   c["stop_n"])
+            return dict(
+                c, x=x_new, d2=d2, stop_n=stop_n_new,
+                r_lo=codec.down(rn), p=codec.down(rn),
+                x_lo=jnp.zeros_like(x_lo),
+                r2_lo=rn2_true, r2max=rn2_true, k=c["k"] + 1)
+
+        def keep(_):
+            return dict(c, p=p, r_lo=r_lo, x_lo=x_lo, r2_lo=r2_new,
+                        r2max=r2max, k=c["k"] + 1)
+
+        return jax.lax.cond(do_reliable, reliable, keep, None)
+
+    init = dict(x=x, d2=b2d, stop_n=stop_n, r_lo=r_lo, p=r_lo, x_lo=x_lo,
+                r2_lo=rn2, r2max=rn2, k=jnp.int32(0))
+    out = jax.lax.while_loop(cond, body, init)
+    x_fin = dfm.add(out["x"], dfm.promote(codec.up(out["x_lo"])))
+    d_df = op_df.residual_df(rhs_df, x_fin)
+    d2_fin = dfm.to_f32(dfm.norm2(d_df))
+    return SolverResult(x_fin, out["k"], d2_fin, d2_fin <= stop_d)
 
 
 def solve_refined(matvec_hi: Callable, inner_solve: Callable, b: jnp.ndarray,

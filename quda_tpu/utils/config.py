@@ -101,6 +101,13 @@ _register("QUDA_TPU_PALLAS_VERSION", "int", 3,
           "(no backward-link copies), 2 = gather kernels with "
           "pre-shifted backward links",
           reference="dslash policy selection")
+_register("QUDA_TPU_DF64", "choice", "",
+          "extended-precision (float32-pair) precise path for deep-tol "
+          "Wilson CG: '1' = force, '0' = off, empty = auto (engaged when "
+          "tol is below the f32 floor and no f64 backend serves)",
+          ("", "0", "1"),
+          reference="fp64 matPrecise + dbldbl reductions "
+                    "(include/dbldbl.h)")
 _register("QUDA_TPU_SLOPPY_PRECISION", "choice", "",
           "override cuda_prec_sloppy='auto' resolution",
           ("", "single", "half", "quarter"),
