@@ -13,6 +13,7 @@ reconstruction x_{1-p} = b_{1-p} + kappa D_{1-p,p} x_p
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..fields.geometry import EVEN, LatticeGeometry
@@ -192,7 +193,7 @@ class _PackedHopMixin:
 
     def _setup_hop(self, geom, gauge_eo_packed, store_dtype,
                    use_pallas, pallas_interpret, pallas_version=None,
-                   tb_sign: bool = True):
+                   tb_sign: bool = True, mesh=None):
         """gauge_eo_packed: (even, odd) complex packed (4,3,3,T,Z,Y*Xh)
         links (wilson_packed.pack_gauge_eo output).  ``tb_sign``: whether
         the links carry a folded antiperiodic-t phase (drives the
@@ -231,11 +232,33 @@ class _PackedHopMixin:
                 wpp.backward_gauge_eo(self.gauge_eo_pp[1 - p],
                                       tuple(self.dims), p)
                 for p in (0, 1))
+        # multi-chip: run the sharded eo pallas policy under shard_map
+        # (parallel/pallas_dslash.dslash_eo_pallas_sharded_v3); the
+        # resident links move onto the mesh once here
+        self._mesh = mesh
+        if mesh is not None:
+            if not (use_pallas and self._pallas_version == 3):
+                raise ValueError(
+                    "mesh-sharded packed hops need the v3 pallas path "
+                    "(use_pallas=True, pallas_version=3)")
+            if self.gauge_eo_pp[0].shape[1] == 2:
+                raise ValueError(
+                    "mesh-sharded packed hops need full 18-real links "
+                    "(set QUDA_TPU_RECONSTRUCT=18)")
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            gspec = NamedSharding(
+                mesh, P(None, None, None, None, "t", "z", None))
+            self.gauge_eo_pp = tuple(jax.device_put(g, gspec)
+                                     for g in self.gauge_eo_pp)
 
     def _d_to(self, psi_pp, target_parity, out_dtype):
         from ..ops import wilson_packed as wpk
         if self.use_pallas:
             from ..ops import wilson_pallas_packed as wpp
+            if getattr(self, "_mesh", None) is not None:
+                fn = self._sharded_d_to(target_parity, out_dtype)
+                return fn(self.gauge_eo_pp[target_parity],
+                          self.gauge_eo_pp[1 - target_parity], psi_pp)
             if self._pallas_version == 3:
                 return wpp.dslash_eo_pallas_packed_v3(
                     self.gauge_eo_pp[target_parity],
@@ -251,6 +274,28 @@ class _PackedHopMixin:
         return wpk.dslash_eo_packed_pairs(self.gauge_eo_pp, psi_pp,
                                           self.dims, target_parity,
                                           out_dtype=out_dtype)
+
+    def _sharded_d_to(self, target_parity, out_dtype):
+        """Memoized shard_map of the sharded eo pallas policy (a fresh
+        wrapper per call would defeat the pjit cache — it is keyed on
+        callable identity)."""
+        cache = self.__dict__.setdefault("_sharded_fns", {})
+        key = (target_parity, jnp.dtype(out_dtype).name if out_dtype
+               else None)
+        if key not in cache:
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.pallas_dslash import dslash_eo_pallas_sharded_v3
+            pspec = P(None, None, None, "t", "z", None)
+            gspec = P(None, None, None, None, "t", "z", None)
+            cache[key] = jax.jit(jax.shard_map(
+                lambda uh, ut, p: dslash_eo_pallas_sharded_v3(
+                    uh, ut, p, tuple(self.dims), target_parity,
+                    self._mesh, interpret=self._pallas_interpret,
+                    out_dtype=out_dtype),
+                mesh=self._mesh, in_specs=(gspec, gspec, pspec),
+                out_specs=pspec, check_vma=False))
+        return cache[key]
 
     def _to_pairs(self, x):
         """Canonical (T,Z,Y,Xh,4,3) complex -> packed pairs."""
@@ -384,7 +429,8 @@ class DiracWilsonPCPacked:
 
     def pairs(self, store_dtype=jnp.bfloat16, use_pallas: bool = False,
               pallas_interpret: bool = False,
-              pallas_version: int | None = None) -> "DiracWilsonPCPackedSloppy":
+              pallas_version: int | None = None,
+              mesh=None) -> "DiracWilsonPCPackedSloppy":
         """Pair-storage companion at an arbitrary storage dtype.
 
         With f32 storage this is the PRECISE operator in a fully
@@ -395,9 +441,13 @@ class DiracWilsonPCPacked:
         ``use_pallas`` swaps the stencil for the hand-tuned pallas eo
         kernel; ``pallas_version`` 3 (default) uses the scatter-form
         kernel that needs no resident pre-shifted backward links, 2 the
-        round-2 gather kernel."""
+        round-2 gather kernel.  ``mesh``: a jax.sharding.Mesh with t/z
+        axes partitioning the lattice T/Z — the stencil then runs the
+        sharded eo pallas policy under shard_map (multi-chip CG hot
+        loop, lib/dslash_policy.hpp:522 analog)."""
         return DiracWilsonPCPackedSloppy(self, store_dtype, use_pallas,
-                                         pallas_interpret, pallas_version)
+                                         pallas_interpret, pallas_version,
+                                         mesh=mesh)
 
     def codec(self, precise_dtype, store_dtype=None):
         """StorageCodec matching this operator's sloppy representation
@@ -417,10 +467,11 @@ class DiracWilsonPCPackedSloppy(_PackedHopMixin, _PairSloppyBase):
 
     def __init__(self, dpk: "DiracWilsonPCPacked", store_dtype=jnp.bfloat16,
                  use_pallas: bool = False, pallas_interpret: bool = False,
-                 pallas_version: int | None = None):
+                 pallas_version: int | None = None, mesh=None):
         self._setup_hop(dpk.geom, dpk.gauge_eo_p, store_dtype,
                         use_pallas, pallas_interpret, pallas_version,
-                        tb_sign=getattr(dpk._dpc, "antiperiodic_t", True))
+                        tb_sign=getattr(dpk._dpc, "antiperiodic_t", True),
+                        mesh=mesh)
         self.kappa = float(dpk.kappa)
         self.matpc = dpk.matpc
 

@@ -62,9 +62,8 @@ def _dfc_cscale(c: complex, x):
 
 
 def _mul_f32_df(a, x):
-    """plain f32 a times df64 x."""
-    p, e = dfm.two_prod(a, x[0])
-    return dfm.quick_two_sum(p, e + a * x[1])
+    """plain f32 a times df64 x (one home: ops/df64.mul_f32)."""
+    return dfm.mul_f32(x, a)
 
 
 def _dfc_cmul_f32(u, h):

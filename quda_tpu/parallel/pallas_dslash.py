@@ -77,6 +77,39 @@ def _fix_hi_face_n(out, gauge_pl, psi_pl, axis, name, n, mu):
     return _add_face_n(out, corr_hi, axis, lo=False)
 
 
+def _wilson_fix_faces_v3(out, links_fwd, links_bwd, psi_pl, axis, name,
+                         n, mu):
+    """Both slab fixes for one partitioned direction, v3 scatter-form
+    conventions (one home for the full-lattice AND eo policies):
+
+    * forward hop, HIGH face: psi(x+mu) from the next shard's first
+      plane against ``links_fwd`` (the links the forward hop reads);
+    * backward hop, LOW face: the kernel wrapped the locally-computed
+      product U^dag psi of the last plane (built from ``links_bwd``);
+      permute the product itself — linear in the face, no link exchange.
+    """
+    out = _fix_hi_face_n(out, links_fwd, psi_pl, axis, name, n, mu)
+    prod = _hop_term(_face_n(psi_pl, axis, lo=False),
+                     _face_n(links_bwd[mu], axis, lo=False),
+                     TABLES[(mu, -1)], True)
+    corr_lo = _nbr(prod, name, towards_lower=False, n=n) - prod
+    return _add_face_n(out, corr_lo, axis, lo=True)
+
+
+def _check_sharded_mesh(name: str, links, mesh):
+    """Shared guards of the v3 sharded Wilson policies."""
+    if links.shape[1] == 2:
+        raise ValueError(
+            "sharded pallas policies need full 18-real link storage: "
+            "the exterior face fixes read 3x3 link slabs "
+            "(reconstruct-12 faces are a planned follow-up; pass the "
+            "uncompressed gauge here)")
+    if mesh.shape["y"] != 1 or mesh.shape["x"] != 1:
+        raise ValueError(
+            f"{name} shards t/z only (y/x mesh axes must be 1)")
+    return mesh.shape["t"], mesh.shape["z"]
+
+
 def dslash_pallas_sharded(gauge_pl, gauge_bw_pl, psi_pl, X: int, mesh,
                           interpret: bool = False):
     """Wilson hop sum on per-shard local packed pair blocks — call
@@ -269,6 +302,52 @@ def dslash_staggered_eo_pallas_sharded_v3(fat_here_pl, fat_there_pl,
     return out
 
 
+def dslash_eo_pallas_sharded_v3(u_here_pl, u_there_pl, psi_pl, dims,
+                                target_parity: int, mesh,
+                                interpret: bool = False,
+                                out_dtype=None):
+    """Checkerboarded Wilson hop under shard_map — the CG hot loop's
+    stencil made multi-chip (reference: the eo interior/exterior policies
+    of lib/dslash_policy.hpp:365-560 driving dslash_wilson.cuh).
+
+    Interior: the single-chip v3 scatter-form eo kernel
+    (ops/wilson_pallas_packed.dslash_eo_pallas_packed_v3) on the LOCAL
+    block.  Exterior: the same slab algebra as the full-lattice v3 policy
+    — forward hops read the target-parity links (u_here) against the
+    next shard's first psi plane; the backward hop permutes the locally
+    computed product U^dag psi built from the opposite-parity links
+    (u_there).  Both link arrays are already shard-resident: only psi
+    slabs and product slabs ride the ppermute.
+
+    t/z hops flip parity but keep the checkerboarded x-slot layout, so
+    slab alignment matches the full-lattice case; partitioned axes need
+    EVEN local extents (the in-kernel x-slot parity masks use local
+    coordinates).  ``dims`` is the GLOBAL (T, Z, Y, X).
+    """
+    from ..ops.wilson_pallas_packed import dslash_eo_pallas_packed_v3
+
+    n_t, n_z = _check_sharded_mesh("dslash_eo_pallas_sharded_v3",
+                                   u_here_pl, mesh)
+    t_loc, z_loc = psi_pl.shape[-3], psi_pl.shape[-2]
+    for nn, ext, nm in ((n_t, t_loc, "T"), (n_z, z_loc, "Z")):
+        if nn > 1 and ext % 2 != 0:
+            raise ValueError(
+                f"local {nm} extent {ext} must be even on a partitioned "
+                f"axis (the checkerboard masks use local coordinates)")
+    dims_local = (t_loc, z_loc, dims[2], dims[3])
+
+    out = dslash_eo_pallas_packed_v3(
+        u_here_pl, u_there_pl, psi_pl, dims_local, target_parity,
+        interpret=interpret, out_dtype=out_dtype)
+
+    for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
+        if n == 1:
+            continue
+        out = _wilson_fix_faces_v3(out, u_here_pl, u_there_pl, psi_pl,
+                                   axis, name, n, mu)
+    return out
+
+
 def dslash_pallas_sharded_v3(gauge_pl, psi_pl, X: int, mesh,
                              interpret: bool = False):
     """v3 of the fused manual policy: the scatter-form interior kernel
@@ -283,32 +362,15 @@ def dslash_pallas_sharded_v3(gauge_pl, psi_pl, X: int, mesh,
     """
     from ..ops.wilson_pallas_packed import dslash_pallas_packed_v3
 
-    if gauge_pl.shape[1] == 2:
-        raise ValueError(
-            "sharded pallas policies need full 18-real link storage: "
-            "the exterior face fixes read 3x3 link slabs "
-            "(reconstruct-12 faces are a planned follow-up; pass the "
-            "uncompressed gauge here)")
-    n_t, n_z = mesh.shape["t"], mesh.shape["z"]
-    if mesh.shape["y"] != 1 or mesh.shape["x"] != 1:
-        raise ValueError(
-            "dslash_pallas_sharded_v3 shards t/z only (y/x mesh axes "
-            "must be 1; their shifts are in-plane lane rolls)")
+    n_t, n_z = _check_sharded_mesh("dslash_pallas_sharded_v3", gauge_pl,
+                                   mesh)
 
     out = dslash_pallas_packed_v3(gauge_pl, psi_pl, X,
                                   interpret=interpret)
 
-    t_ax, z_ax = -3, -2
-    for axis, name, n, mu in ((t_ax, "t", n_t, 3), (z_ax, "z", n_z, 2)):
+    for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
         if n == 1:
             continue
-        out = _fix_hi_face_n(out, gauge_pl, psi_pl, axis, name, n, mu)
-        # backward hop, LOW face: the kernel wrapped the LOCAL last
-        # plane's product U^dag psi into row 0; the true contribution is
-        # the PREVIOUS shard's — permute the product itself
-        prod = _hop_term(_face_n(psi_pl, axis, lo=False),
-                         _face_n(gauge_pl[mu], axis, lo=False),
-                         TABLES[(mu, -1)], True)
-        corr_lo = _nbr(prod, name, towards_lower=False, n=n) - prod
-        out = _add_face_n(out, corr_lo, axis, lo=True)
+        out = _wilson_fix_faces_v3(out, gauge_pl, gauge_pl, psi_pl,
+                                   axis, name, n, mu)
     return out

@@ -167,6 +167,78 @@ def test_sharded_improved_staggered_v3_matches_single_device():
 
 
 @pytest.mark.parametrize("parity", [0, 1])
+def test_sharded_wilson_eo_v3_matches_single_device(parity):
+    """Checkerboarded Wilson hop (the CG hot loop) under shard_map == the
+    single-device eo pair stencil, both parities (the policy the
+    reference's engine exists to serve, lib/dslash_policy.hpp:522)."""
+    from quda_tpu.fields.spinor import even_odd_split
+    from quda_tpu.ops.wilson import split_gauge_eo
+    from quda_tpu.parallel.pallas_dslash import dslash_eo_pallas_sharded_v3
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    # partitioned local extents must be EVEN (local-coordinate masks)
+    geom = LatticeGeometry((4, 4, 8, 16))
+    T, Z, Y, X = geom.lattice_shape
+    dims = (T, Z, Y, X)
+    gauge = GaugeField.random(jax.random.PRNGKey(41), geom).data.astype(
+        jnp.complex64)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(42), geom
+                                    ).data.astype(jnp.complex64)
+    g_eo = split_gauge_eo(gauge, geom)
+    pe, po = even_odd_split(psi, geom)
+    src = pe if parity == 1 else po
+    g_eo_pp = tuple(wpk.to_packed_pairs(wpk.pack_gauge(g), jnp.float32)
+                    for g in g_eo)
+    src_pp = wpk.to_packed_pairs(wpk.pack_spinor(src), jnp.float32)
+    ref = wpk.dslash_eo_packed_pairs(g_eo_pp, src_pp, dims, parity)
+
+    mesh = make_lattice_mesh(grid=(4, 2, 1, 1), n_src=1)
+    psi_spec = P(None, None, None, "t", "z", None)
+    g_spec = P(None, None, None, None, "t", "z", None)
+    fn = jax.shard_map(
+        lambda uh, ut, p: dslash_eo_pallas_sharded_v3(
+            uh, ut, p, dims, parity, mesh, interpret=True),
+        mesh=mesh, in_specs=(g_spec, g_spec, psi_spec),
+        out_specs=psi_spec, check_vma=False)
+    uh_s = jax.device_put(g_eo_pp[parity], NamedSharding(mesh, g_spec))
+    ut_s = jax.device_put(g_eo_pp[1 - parity], NamedSharding(mesh, g_spec))
+    src_s = jax.device_put(src_pp, NamedSharding(mesh, psi_spec))
+    out = jax.jit(fn)(uh_s, ut_s, src_s)
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-6
+
+
+def test_sharded_wilson_eo_operator_solve_path():
+    """The operator-level wiring: DiracWilsonPCPacked.pairs(mesh=...)
+    runs MdagM through the sharded eo pallas policy and matches the
+    unsharded pair operator."""
+    from quda_tpu.models.wilson import DiracWilsonPC
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    geom = LatticeGeometry((4, 4, 8, 16))
+    T, Z, Y, X = geom.lattice_shape
+    gauge = GaugeField.random(jax.random.PRNGKey(43), geom).data.astype(
+        jnp.complex64)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(44), geom
+                                    ).data.astype(jnp.complex64)
+    from quda_tpu.fields.spinor import even_odd_split
+    pe, _ = even_odd_split(psi, geom)
+    dpk = DiracWilsonPC(gauge, geom, kappa=0.12).packed()
+    ref_op = dpk.pairs(jnp.float32)
+    x_pp = wpk.to_packed_pairs(wpk.pack_spinor(pe), jnp.float32)
+    ref = ref_op.MdagM_pairs(x_pp)
+
+    mesh = make_lattice_mesh(grid=(4, 2, 1, 1), n_src=1)
+    sh_op = dpk.pairs(jnp.float32, use_pallas=True, pallas_interpret=True,
+                      mesh=mesh)
+    x_s = jax.device_put(
+        x_pp, NamedSharding(mesh, P(None, None, None, "t", "z", None)))
+    out = jax.jit(sh_op.MdagM_pairs)(x_s)
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-5
+
+
+@pytest.mark.parametrize("parity", [0, 1])
 def test_sharded_staggered_eo_v3_matches_single_device(parity):
     """Checkerboarded improved-staggered hop (the complex-free staggered
     SOLVE stencil) under shard_map == the single-device eo pair stencil,
