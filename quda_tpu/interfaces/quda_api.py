@@ -611,6 +611,11 @@ def invert_quda(source, param: InvertParam):
         x_full = res.x
         param.iter_count = int(res.iters)
         param.secs = time.perf_counter() - t0
+        # fine-operator work only (V-cycle smoother/coarse flops not
+        # charged — same convention as QUDA's outer-solver gflops)
+        param.gflops = (param.iter_count
+                        * getattr(d_full, "flops_per_site_M", lambda: 0)()
+                        * _ctx["geom"].volume) / 1e9
         if pair_true_res is not None:
             # the pair route already measured it complex-free; re-deriving
             # it here with d_full.M would put a complex op on the device
@@ -785,6 +790,15 @@ def invert_multishift_quda(source, param: InvertParam):
     d = _build_dirac(param, True)
     be, bo = _split(b, param, d)
 
+    def _account(n_extra_mv: int = 0):
+        """Populate param.gflops like invert_quda does (monitor parity,
+        lib/monitor.cpp solver fields): each multishift iteration costs
+        one MdagM = 2 operator applies; polish solves add their own."""
+        flops = getattr(d, "flops_per_site_M", lambda: 0)()
+        vol = _ctx["geom"].volume
+        param.gflops = ((param.iter_count * 2.0 + n_extra_mv) * flops
+                        * vol) / 1e9
+
     on_tpu = jax.default_backend() == "tpu"
     if (param.dslash_type in ("staggered", "asqtad", "hisq")
             and (param.cuda_prec == "single" or on_tpu)
@@ -800,6 +814,7 @@ def invert_multishift_quda(source, param: InvertParam):
                             tol=param.tol, maxiter=param.maxiter)
         param.iter_count = int(res.iters)
         param.secs = time.perf_counter() - t0
+        _account()
         r0 = rhs_pp - (ad.M(res.x[0])
                        + param.offset[0] * res.x[0].astype(jnp.float32))
         param.true_res = float(jnp.sqrt(blas.norm2(r0)
@@ -831,6 +846,7 @@ def invert_multishift_quda(source, param: InvertParam):
                             maxiter=param.maxiter)
         param.iter_count = int(res.iters)
         param.secs = time.perf_counter() - t0
+        _account()
         r0 = nrm_rhs - (sl.MdagM_pairs(res.x[0])
                         + param.offset[0] * res.x[0].astype(jnp.float32))
         param.true_res = float(jnp.sqrt(blas.norm2(r0)
@@ -868,6 +884,7 @@ def invert_multishift_quda(source, param: InvertParam):
             iters += int(ref.iters)
         param.iter_count = iters
         param.secs = time.perf_counter() - t0
+        _account()
         r0 = rhs - (mv(xs[0]) + shifts[0] * xs[0])
         param.true_res = float(jnp.sqrt(blas.norm2(r0) / blas.norm2(rhs)))
         return jnp.stack(xs)
@@ -875,6 +892,7 @@ def invert_multishift_quda(source, param: InvertParam):
                         maxiter=param.maxiter)
     param.iter_count = int(res.iters)
     param.secs = time.perf_counter() - t0
+    _account()
     r0 = rhs - (mv(res.x[0]) + shifts[0] * res.x[0])
     param.true_res = float(jnp.sqrt(blas.norm2(r0) / blas.norm2(rhs)))
     return res.x

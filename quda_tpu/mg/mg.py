@@ -408,17 +408,21 @@ def staggered_mg_solve(dirac, geom, b_std, params: Sequence[MGLevelParam],
     KD machinery remains available and is what QUDA composes on
     physical configurations.
 
-    For improved staggered the hierarchy represents the fat-link stencil;
-    the outer operator here is the same fat-link M (solve the full
-    improved operator by defect correction around this, or pass the
-    fat-only Dirac)."""
+    For improved staggered the hierarchy represents the fat-link stencil
+    but the outer GCR applies the FULL fat+Naik M — flexible-Krylov
+    defect correction of the Naik term around the fat-only V-cycle (ref
+    lib/dirac_improved_staggered_kd.cpp, the production improved-staggered
+    MG wiring).  With kd=True the KD composition stays fat-only."""
     if mg is None:
         mg = MG(dirac, geom, params, key, kd=kd)
     a = mg.adapter
     # the adapter knows whether IT composes Xinv — never trust the kd
     # argument when a prebuilt hierarchy is passed in
     kd_active = getattr(a, "kd", False)
-    res = gcr(a.apply_std, b_std, precond=mg.precondition, tol=tol,
+    outer = a.apply_std
+    if not kd_active and getattr(a.dirac, "long", None) is not None:
+        outer = a.dirac.M          # full improved operator (fat + Naik)
+    res = gcr(outer, b_std, precond=mg.precondition, tol=tol,
               nkrylov=nkrylov, max_restarts=max_restarts)
     x = a._xinv_std(res.x) if kd_active else res.x
     res = res._replace(x=x)

@@ -443,18 +443,20 @@ class PairStaggeredLevelOp:
 
     def __init__(self, dirac):
         import numpy as np
-        if getattr(dirac, "long", None) is not None:
-            import warnings
-            warnings.warn(
-                "pair staggered MG represents the FAT-LINK stencil only "
-                "(the standard preconditioner simplification, like "
-                "mg/mg.staggered_mg_solve); the outer solve here is the "
-                "fat-only operator too — defect-correct around it for "
-                "the full improved operator", stacklevel=3)
         self.dirac = dirac
         self.geom = dirac.geom
         self.mass = float(dirac.mass)
         self.fat_pairs = to_pairs(dirac.fat, F32)
+        # Improved staggered: the HIERARCHY represents the fat-link
+        # stencil (the standard preconditioner simplification, matching
+        # mg/mg._StaggeredLevelOp and QUDA's coarse construction,
+        # lib/staggered_coarse_op.in.cu), while M_std_full applies the
+        # full fat+Naik operator — mg_solve_pairs runs the outer Krylov
+        # on M_std_full so the fat-only V-cycle defect-corrects the
+        # Naik term implicitly (ref lib/dirac_improved_staggered_kd.cpp).
+        self.long_pairs = (to_pairs(dirac.long, F32)
+                           if getattr(dirac, "long", None) is not None
+                           else None)
         T, Z, Y, X = self.geom.lattice_shape
         t = np.arange(T)[:, None, None, None]
         z = np.arange(Z)[None, :, None, None]
@@ -472,6 +474,23 @@ class PairStaggeredLevelOp:
 
     def _mdag_std(self, v):
         return 2.0 * self.mass * v - self._d_std(v)
+
+    # -- full improved operator (fat + Naik), standard layout ----------
+    def _d_std_full(self, v):
+        from ..ops import staggered as sops
+        return sops.dslash_full(self.fat_pairs, v, self.long_pairs)
+
+    def M_std_full(self, v):
+        """The operator the OUTER solve targets: fat+Naik when long
+        links exist, else identical to M_std."""
+        if self.long_pairs is None:
+            return self.M_std(v)
+        return 2.0 * self.mass * v + self._d_std_full(v)
+
+    def Mdag_std_full(self, v):
+        if self.long_pairs is None:
+            return self._mdag_std(v)
+        return 2.0 * self.mass * v - self._d_std_full(v)
 
     # -- chiral layout --------------------------------------------------
     def to_chiral(self, v):
@@ -568,12 +587,19 @@ def mg_solve_pairs(fine_dirac, geom, b_pairs, params: Sequence[MGLevelParam],
     mg/mg.staggered_mg_solve (the adapter supplies the right M_std:
     Wilson (T,Z,Y,X,4,3,2) or staggered (T,Z,Y,X,1,3,2) pair fields).
 
+    For improved staggered (fine_dirac.long is not None) the outer GCR
+    applies the FULL fat+Naik operator while the hierarchy preconditions
+    with the fat-only stencil — flexible-Krylov defect correction of the
+    Naik term (ref lib/dirac_improved_staggered_kd.cpp:1, the production
+    improved-staggered MG wiring).
+
     Returns (SolverResult with pair x, mg).
     """
     from ..solvers.gcr import gcr
     if mg is None:
         mg = PairMG(fine_dirac, geom, params, key)
     a = mg.adapter
-    res = gcr(a.M_std, b_pairs, precond=mg.precondition, tol=tol,
+    outer = getattr(a, "M_std_full", a.M_std)
+    res = gcr(outer, b_pairs, precond=mg.precondition, tol=tol,
               nkrylov=nkrylov, max_restarts=max_restarts)
     return res, mg

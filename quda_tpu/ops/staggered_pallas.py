@@ -92,7 +92,14 @@ def _make_stag_kernel(X: int, nhop: int, bz: int, eo: tuple | None = None):
 
     def kernel(psi_c, psi_tp, psi_tm, psi_zp, psi_zm, u, u_bw, out_ref):
         def psi_at(ref, c):
-            return (ref[c, 0, 0].astype(F32), ref[c, 1, 0].astype(F32))
+            # center blocks are (3,2,1,bz,YX); boundary-ROW inputs carry
+            # one extra singleton z axis (3,2,1,1,nhop,YX) — an nhop-
+            # extent block on the sublane axis of a Z-extent array is
+            # illegal on hardware, so rows arrive as separate arrays
+            # whose z extent IS nhop (block == dim is legal)
+            pad = (0,) * (len(ref.shape) - 5)
+            return (ref[(c, 0, 0) + pad].astype(F32),
+                    ref[(c, 1, 0) + pad].astype(F32))
 
         if eo is not None:
             parity, Xh = eo
@@ -114,8 +121,9 @@ def _make_stag_kernel(X: int, nhop: int, bz: int, eo: tuple | None = None):
                              nhop)
 
         def link(ref, mu, a, b):
-            return (ref[mu, a, b, 0, 0].astype(F32),
-                    ref[mu, a, b, 1, 0].astype(F32))
+            pad = (0,) * (len(ref.shape) - 7)
+            return (ref[(mu, a, b, 0, 0) + pad].astype(F32),
+                    ref[(mu, a, b, 1, 0) + pad].astype(F32))
 
         acc = [(jnp.zeros(psi_c.shape[-2:], F32),
                 jnp.zeros(psi_c.shape[-2:], F32)) for _ in range(3)]
@@ -292,7 +300,14 @@ def _make_stag_kernel_v3(X: int, nhop: int, bz: int,
             mask_r0 = ((t_id + z + y + parity) % 2) == 0
 
         def psi_at(ref, c):
-            return (ref[c, 0, 0].astype(F32), ref[c, 1, 0].astype(F32))
+            # center blocks are (3,2,1,bz,YX); boundary-ROW inputs carry
+            # one extra singleton z axis (3,2,1,1,nhop,YX) — an nhop-
+            # extent block on the sublane axis of a Z-extent array is
+            # illegal on hardware, so rows arrive as separate arrays
+            # whose z extent IS nhop (block == dim is legal)
+            pad = (0,) * (len(ref.shape) - 5)
+            return (ref[(c, 0, 0) + pad].astype(F32),
+                    ref[(c, 1, 0) + pad].astype(F32))
 
         def shift_x(v, sign):
             if eo is None:
@@ -304,8 +319,9 @@ def _make_stag_kernel_v3(X: int, nhop: int, bz: int,
                              nhop)
 
         def link(ref, mu, a, b):
-            return (ref[mu, a, b, 0, 0].astype(F32),
-                    ref[mu, a, b, 1, 0].astype(F32))
+            pad = (0,) * (len(ref.shape) - 7)
+            return (ref[(mu, a, b, 0, 0) + pad].astype(F32),
+                    ref[(mu, a, b, 1, 0) + pad].astype(F32))
 
         acc = [(jnp.zeros(psi_c.shape[-2:], F32),
                 jnp.zeros(psi_c.shape[-2:], F32)) for _ in range(3)]
@@ -397,21 +413,30 @@ def _stag_pass_v3(links_pl, psi_pl, X, nhop, bz, interpret, eo=None,
             (3, 2, 1, bz, YX),
             lambda t, zb, dt=dt: (0, 0, (t + dt) % T, zb, 0))
 
-    def psi_row_spec(pos):
-        # z blocked in units of nhop -> indices count nhop-row blocks.
-        # With a single z-block the kernel uses in-tile rolls and these
-        # refs are unread; pin them to block 0 (Z may not divide nhop).
-        if nzb == 1:
-            return pl.BlockSpec((3, 2, 1, nhop, YX),
-                                lambda t, zb: (0, 0, t, 0, 0))
-        if pos == "zp":
-            return pl.BlockSpec(
-                (3, 2, 1, nhop, YX),
-                lambda t, zb: (0, 0, t, ((zb + 1) * bz // nhop) % (Z // nhop),
-                               0))
-        return pl.BlockSpec(
-            (3, 2, 1, nhop, YX),
-            lambda t, zb: (0, 0, t, (zb * bz // nhop - 1) % (Z // nhop), 0))
+    # Boundary z-rows as separate pre-gathered arrays whose z extent IS
+    # nhop: an nhop-extent block on the sublane axis of a Z-extent array
+    # is illegal on hardware (second-to-minor block extent must divide
+    # by 8 or equal the array's), while block nhop == array extent nhop
+    # is legal.  With a single z-block the kernel uses in-tile rolls and
+    # the row refs are unread — pass minimal dummies (Z may not divide
+    # nhop there).
+    bwd_src = links_pl if links_there_pl is None else links_there_pl
+    if nzb == 1:
+        rows_zp = rows_zm = jnp.zeros((3, 2, T, 1, nhop, YX),
+                                      psi_pl.dtype)
+        u_rows_zm = jnp.zeros((1, 3, 3, 2, T, 1, nhop, YX),
+                              bwd_src.dtype)
+    else:
+        q = bz // nhop
+        psi_q = psi_pl.reshape(3, 2, T, nzb, q, nhop, YX)
+        rows_zp = jnp.roll(psi_q[:, :, :, :, 0], -1, axis=3)
+        rows_zm = jnp.roll(psi_q[:, :, :, :, q - 1], 1, axis=3)
+        u_q = bwd_src[2:3].reshape(1, 3, 3, 2, T, nzb, q, nhop, YX)
+        u_rows_zm = jnp.roll(u_q[:, :, :, :, :, :, q - 1], 1, axis=5)
+
+    def psi_row_spec():
+        return pl.BlockSpec((3, 2, 1, 1, nhop, YX),
+                            lambda t, zb: (0, 0, t, zb, 0, 0))
 
     links_spec = pl.BlockSpec(
         (4, 3, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
@@ -420,24 +445,18 @@ def _stag_pass_v3(links_pl, psi_pl, X, nhop, bz, interpret, eo=None,
     u_t_spec = pl.BlockSpec(
         (1, 3, 3, 2, 1, bz, YX),
         lambda t, zb: (3, 0, 0, 0, (t - nhop) % T, zb, 0))
-    if nzb == 1:
-        u_z_spec = pl.BlockSpec((1, 3, 3, 2, 1, nhop, YX),
-                                lambda t, zb: (2, 0, 0, 0, t, 0, 0))
-    else:
-        u_z_spec = pl.BlockSpec(
-            (1, 3, 3, 2, 1, nhop, YX),
-            lambda t, zb: (2, 0, 0, 0, t, (zb * bz // nhop - 1) % (Z // nhop),
-                           0))
+    u_z_spec = pl.BlockSpec(
+        (1, 3, 3, 2, 1, 1, nhop, YX),
+        lambda t, zb: (0, 0, 0, 0, t, zb, 0, 0))
 
-    bwd_src = links_pl if links_there_pl is None else links_there_pl
     in_specs = [psi_spec(0), psi_spec(+nhop), psi_spec(-nhop),
-                psi_row_spec("zp"), psi_row_spec("zm"), links_spec]
-    args = [psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, links_pl]
+                psi_row_spec(), psi_row_spec(), links_spec]
+    args = [psi_pl, psi_pl, psi_pl, rows_zp, rows_zm, links_pl]
     if links_there_pl is not None:
         in_specs.append(links_xyz_spec)
         args.append(links_there_pl)
     in_specs += [u_t_spec, u_z_spec]
-    args += [bwd_src, bwd_src]
+    args += [bwd_src, u_rows_zm]
 
     return pl.pallas_call(
         _make_stag_kernel_v3(X, nhop, bz, eo, single_zb=(nzb == 1)),

@@ -212,8 +212,13 @@ def _make_kernel(X: int, bz: int, eo: tuple | None = None):
 
         # loads cast storage dtype (f32 or bf16) to f32 compute
         def psi_at(ref, s, c):
-            return (ref[s, c, 0, 0].astype(F32),
-                    ref[s, c, 1, 0].astype(F32))
+            # center blocks are (4,3,2,1,bz,YX); boundary-ROW inputs
+            # carry one extra singleton z axis (…,1,1,YX) because a
+            # 1-extent block on the sublane axis of a Z-extent array is
+            # illegal on hardware — index the extra axis away
+            pad = (0,) * (len(ref.shape) - 6)
+            return (ref[(s, c, 0, 0) + pad].astype(F32),
+                    ref[(s, c, 1, 0) + pad].astype(F32))
 
         def psi_row(ref, s, c, rows):
             return (ref[s, c, 0, 0][rows].astype(F32),
@@ -314,6 +319,14 @@ def _pick_bz(Z: int, YX: int, dtype=jnp.float32, planes: int = 288,
     block of a bf16 array occupies a half-empty (16,128) tile — loads
     run at 50% utilisation (measured: bf16 SLOWER than f32 at bz=8) —
     so candidates are ranked by (utilisation, size), not size alone.
+
+    HARDWARE LEGALITY (learned the hard way, round-5 chip run): the
+    Mosaic TPU lowering requires the second-to-minor block extent to be
+    divisible by 8 OR equal to the full array extent — interpret mode
+    does not enforce this, so a utilisation-ranked bz=12 compiled on
+    CPU and failed on the chip.  Candidates violating the rule are
+    excluded here.
+
     Raises when even BZ=1 does not fit — callers fall back to the XLA
     packed path."""
     sub = 16 if jnp.dtype(dtype).itemsize < 4 else 8
@@ -323,6 +336,8 @@ def _pick_bz(Z: int, YX: int, dtype=jnp.float32, planes: int = 288,
     fitting = []
     for bz in sorted({d for d in range(min_bz, Z + 1)
                       if Z % d == 0}):
+        if bz % 8 != 0 and bz != Z:
+            continue               # illegal block on real TPU hardware
         bz_pad = -(-bz // sub) * sub
         if planes * bz_pad * yx_pad * nbytes <= budget:
             fitting.append((bz / bz_pad, bz, bz_pad))
@@ -461,8 +476,11 @@ def _link_getter(ref, mu, row2_sign=None):
     nrow = ref.shape[1]
 
     def stored(a, b):
-        return (ref[mu, a, b, 0, 0].astype(F32),
-                ref[mu, a, b, 1, 0].astype(F32))
+        # full-link blocks are (4,R,3,2,1,bz,YX); boundary-ROW gauge
+        # inputs carry one extra singleton z axis (see psi_at)
+        pad = (0,) * (len(ref.shape) - 7)
+        return (ref[(mu, a, b, 0, 0) + pad].astype(F32),
+                ref[(mu, a, b, 1, 0) + pad].astype(F32))
 
     if nrow == 3:
         return stored
@@ -527,8 +545,13 @@ def _make_kernel_v3(X: int, bz: int, eo: tuple | None = None,
             return _shift_x_eo(v, sign, eo[1], mask_r0)
 
         def psi_at(ref, s, c):
-            return (ref[s, c, 0, 0].astype(F32),
-                    ref[s, c, 1, 0].astype(F32))
+            # center blocks are (4,3,2,1,bz,YX); boundary-ROW inputs
+            # carry one extra singleton z axis (…,1,1,YX) because a
+            # 1-extent block on the sublane axis of a Z-extent array is
+            # illegal on hardware — index the extra axis away
+            pad = (0,) * (len(ref.shape) - 6)
+            return (ref[(s, c, 0, 0) + pad].astype(F32),
+                    ref[(s, c, 1, 0) + pad].astype(F32))
 
         # reconstruct-12 t-boundary sign planes (None for full storage /
         # periodic t): forward t-link lives on plane t, backward on t-1
@@ -650,16 +673,25 @@ def dslash_pallas_packed_v3(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
             (4, 3, 2, 1, bz, YX),
             lambda t, zb, dt=dt: (0, 0, 0, (t + dt) % T, zb, 0))
 
-    def psi_row_spec(pos):
-        # pos = 'zp' (first row of the next block) or 'zm' (last row of
-        # the previous block); z axis blocked by 1 -> absolute z index
-        if pos == "zp":
-            return pl.BlockSpec(
-                (4, 3, 2, 1, 1, YX),
-                lambda t, zb: (0, 0, 0, t, ((zb + 1) * bz) % Z, 0))
+    # Boundary z-ROWS as separate pre-gathered arrays with a SINGLETON z
+    # axis: a 1-extent block on the sublane axis of a Z-extent array is
+    # rejected by the hardware lowering (block second-to-minor extent
+    # must divide by 8 or equal the array's), so the rows are sliced out
+    # ahead of the kernel — O(Z/bz) of the field, fused by XLA — and the
+    # block extent 1 legally equals the array extent 1.
+    psi_r = psi_pl.reshape(4, 3, 2, T, nzb, bz, YX)
+    rows_zp = jnp.roll(psi_r[:, :, :, :, :, 0, :], -1,
+                       axis=4)[:, :, :, :, :, None, :]
+    rows_zm = jnp.roll(psi_r[:, :, :, :, :, bz - 1, :], 1,
+                       axis=4)[:, :, :, :, :, None, :]
+    g_r = gauge_pl[2:3].reshape(1, R, 3, 2, T, nzb, bz, YX)
+    g_rows_zm = jnp.roll(g_r[:, :, :, :, :, :, bz - 1, :], 1,
+                         axis=5)[:, :, :, :, :, :, None, :]
+
+    def psi_row_spec():
         return pl.BlockSpec(
-            (4, 3, 2, 1, 1, YX),
-            lambda t, zb: (0, 0, 0, t, (zb * bz - 1) % Z, 0))
+            (4, 3, 2, 1, 1, 1, YX),
+            lambda t, zb: (0, 0, 0, t, zb, 0, 0))
 
     gauge_spec = pl.BlockSpec(
         (4, R, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
@@ -667,8 +699,8 @@ def dslash_pallas_packed_v3(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
         (1, R, 3, 2, 1, bz, YX),
         lambda t, zb: (3, 0, 0, 0, (t - 1) % T, zb, 0))
     g_z_spec = pl.BlockSpec(
-        (1, R, 3, 2, 1, 1, YX),
-        lambda t, zb: (2, 0, 0, 0, t, (zb * bz - 1) % Z, 0))
+        (1, R, 3, 2, 1, 1, 1, YX),
+        lambda t, zb: (0, 0, 0, 0, t, zb, 0, 0))
 
     kernel = _make_kernel_v3(X, bz, T=T, tb_sign=tb_sign)
 
@@ -676,14 +708,14 @@ def dslash_pallas_packed_v3(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
         kernel,
         grid=(T, nzb),
         in_specs=[psi_spec(0), psi_spec(+1), psi_spec(-1),
-                  psi_row_spec("zp"), psi_row_spec("zm"),
+                  psi_row_spec(), psi_row_spec(),
                   gauge_spec, g_t_spec, g_z_spec],
         out_specs=pl.BlockSpec((4, 3, 2, 1, bz, YX),
                                lambda t, zb: (0, 0, 0, t, zb, 0)),
         out_shape=jax.ShapeDtypeStruct(psi_pl.shape, psi_pl.dtype),
         interpret=interpret,
-    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, gauge_pl, gauge_pl,
-      gauge_pl)
+    )(psi_pl, psi_pl, psi_pl, rows_zp, rows_zm, gauge_pl, gauge_pl,
+      g_rows_zm)
 
 
 # -- even/odd (checkerboarded) kernel: the solver hot path ------------------
@@ -794,14 +826,21 @@ def dslash_eo_pallas_packed_v3(u_here_pl: jnp.ndarray,
             (4, 3, 2, 1, bz, YXh),
             lambda t, zb, dt=dt: (0, 0, 0, (t + dt) % T, zb, 0))
 
-    def psi_row_spec(pos):
-        if pos == "zp":
-            return pl.BlockSpec(
-                (4, 3, 2, 1, 1, YXh),
-                lambda t, zb: (0, 0, 0, t, ((zb + 1) * bz) % Z, 0))
+    # boundary z-rows as singleton-z-axis arrays (hardware-legal block
+    # extent 1; see dslash_pallas_packed_v3)
+    psi_r = psi_pl.reshape(4, 3, 2, T, nzb, bz, YXh)
+    rows_zp = jnp.roll(psi_r[:, :, :, :, :, 0, :], -1,
+                       axis=4)[:, :, :, :, :, None, :]
+    rows_zm = jnp.roll(psi_r[:, :, :, :, :, bz - 1, :], 1,
+                       axis=4)[:, :, :, :, :, None, :]
+    g_r = u_there_pl[2:3].reshape(1, R, 3, 2, T, nzb, bz, YXh)
+    g_rows_zm = jnp.roll(g_r[:, :, :, :, :, :, bz - 1, :], 1,
+                         axis=5)[:, :, :, :, :, :, None, :]
+
+    def psi_row_spec():
         return pl.BlockSpec(
-            (4, 3, 2, 1, 1, YXh),
-            lambda t, zb: (0, 0, 0, t, (zb * bz - 1) % Z, 0))
+            (4, 3, 2, 1, 1, 1, YXh),
+            lambda t, zb: (0, 0, 0, t, zb, 0, 0))
 
     g_here_spec = pl.BlockSpec(
         (4, R, 3, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
@@ -811,8 +850,8 @@ def dslash_eo_pallas_packed_v3(u_here_pl: jnp.ndarray,
         (1, R, 3, 2, 1, bz, YXh),
         lambda t, zb: (3, 0, 0, 0, (t - 1) % T, zb, 0))
     g_z_spec = pl.BlockSpec(
-        (1, R, 3, 2, 1, 1, YXh),
-        lambda t, zb: (2, 0, 0, 0, t, (zb * bz - 1) % Z, 0))
+        (1, R, 3, 2, 1, 1, 1, YXh),
+        lambda t, zb: (0, 0, 0, 0, t, zb, 0, 0))
 
     kernel = _make_kernel_v3(X, bz, eo=(target_parity, Xh), T=T,
                              tb_sign=tb_sign)
@@ -821,12 +860,12 @@ def dslash_eo_pallas_packed_v3(u_here_pl: jnp.ndarray,
         kernel,
         grid=(T, nzb),
         in_specs=[psi_spec(0), psi_spec(+1), psi_spec(-1),
-                  psi_row_spec("zp"), psi_row_spec("zm"),
+                  psi_row_spec(), psi_row_spec(),
                   g_here_spec, g_there_xyz_spec, g_t_spec, g_z_spec],
         out_specs=pl.BlockSpec((4, 3, 2, 1, bz, YXh),
                                lambda t, zb: (0, 0, 0, t, zb, 0)),
         out_shape=jax.ShapeDtypeStruct(psi_pl.shape,
                                        out_dtype or psi_pl.dtype),
         interpret=interpret,
-    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, u_here_pl, u_there_pl,
-      u_there_pl, u_there_pl)
+    )(psi_pl, psi_pl, psi_pl, rows_zp, rows_zm, u_here_pl, u_there_pl,
+      u_there_pl, g_rows_zm)

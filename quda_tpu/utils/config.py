@@ -96,11 +96,16 @@ _register("QUDA_TPU_RECONSTRUCT", "choice", "18",
           ("18", "12"),
           reference="QUDA_RECONSTRUCT / gauge_field_order.h "
                     "Reconstruct<12>")
-_register("QUDA_TPU_PALLAS_VERSION", "int", 3,
-          "pallas kernel generation: 3 = scatter-form backward hops "
-          "(no backward-link copies), 2 = gather kernels with "
-          "pre-shifted backward links",
-          reference="dslash policy selection")
+_register("QUDA_TPU_PALLAS_VERSION", "int", 2,
+          "pallas kernel generation: 2 = gather kernels with "
+          "pre-shifted backward links, 3 = scatter-form backward hops "
+          "(no backward-link copies).  Default 2 BY MEASUREMENT "
+          "(2026-07-31, TPU v5 lite, 24^4 Wilson full: v2 f32 5673 "
+          "GFLOPS vs v3 1768 / v3+recon-12 1919 — the scatter shifts "
+          "cost more VPU work than the saved HBM traffic buys; the "
+          "autotuner can still select v3 per-shape when it wins)",
+          reference="dslash policy selection; tune.cpp:862 — policies "
+                    "are timed, never assumed")
 _register("QUDA_TPU_DF64", "choice", "",
           "extended-precision (float32-pair) precise path for deep-tol "
           "Wilson CG: '1' = force, '0' = off, empty = auto (engaged when "

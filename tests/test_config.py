@@ -116,14 +116,15 @@ def test_pallas_version_knob(monkeypatch):
     g = GaugeField.random(jax.random.PRNGKey(0), geom).data.astype(
         jnp.complex64)
     dpk = DiracWilsonPC(g, geom, 0.1).packed()
-    monkeypatch.setenv("QUDA_TPU_PALLAS_VERSION", "2")
-    qconf.reset_cache()
-    sl = dpk.pairs(jnp.float32, use_pallas=True, pallas_interpret=True)
-    assert sl._pallas_version == 2 and sl._u_bw is not None
-    monkeypatch.delenv("QUDA_TPU_PALLAS_VERSION")
+    monkeypatch.setenv("QUDA_TPU_PALLAS_VERSION", "3")
     qconf.reset_cache()
     sl3 = dpk.pairs(jnp.float32, use_pallas=True, pallas_interpret=True)
     assert sl3._pallas_version == 3 and not hasattr(sl3, "_u_bw")
+    monkeypatch.delenv("QUDA_TPU_PALLAS_VERSION")
+    qconf.reset_cache()
+    # default is v2 BY MEASUREMENT (utils/config.py: chip A/B 2026-07-31)
+    sl = dpk.pairs(jnp.float32, use_pallas=True, pallas_interpret=True)
+    assert sl._pallas_version == 2 and sl._u_bw is not None
     with pytest.raises(ValueError, match="pallas_version"):
         dpk.pairs(jnp.float32, use_pallas=True, pallas_version=1)
 
