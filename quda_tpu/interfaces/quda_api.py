@@ -683,14 +683,13 @@ def _mg_level_params(mp: "MultigridParamAPI"):
 
 
 def _mg_pairs_enabled(d, param: InvertParam, on_tpu: bool) -> bool:
-    """Pair-hierarchy gate: Wilson or plain staggered (the improved
-    operator's MG is fat-only — the complex route documents the same
-    restriction but can at least defect-correct), and — like every
-    other pair gate in this file — never silently degrade an f64 solve
-    to f32 pairs."""
-    family_ok = (type(d).__name__ == "DiracWilson"
-                 or (type(d).__name__ == "DiracStaggered"
-                     and not getattr(d, "improved", False)))
+    """Pair-hierarchy gate: Wilson or staggered — including IMPROVED
+    staggered, where the hierarchy is fat-only and mg_solve_pairs runs
+    the outer Krylov on the full fat+Naik operator (defect correction;
+    mg/pair.PairStaggeredLevelOp.M_std_full) — and, like every other
+    pair gate in this file, never silently degrade an f64 solve to f32
+    pairs."""
+    family_ok = type(d).__name__ in ("DiracWilson", "DiracStaggered")
     return (_packed_enabled(on_tpu) and family_ok
             and (param.cuda_prec == "single" or on_tpu))
 
@@ -731,8 +730,11 @@ def _solve_mg(d_full, b, param: InvertParam, mg_param=None):
                                  mg=mg)
         _ctx["mg"] = mg
         _ctx["mg_epoch"] = _ctx["gauge_epoch"]
-        # true residual in pair arithmetic (no complex op on device)
-        r_pairs = b_pairs - mg.adapter.M_std(res.x)
+        # true residual in pair arithmetic (no complex op on device) —
+        # measured against the operator the outer solve targeted
+        # (M_std_full = fat+Naik for improved staggered)
+        outer_m = getattr(mg.adapter, "M_std_full", mg.adapter.M_std)
+        r_pairs = b_pairs - outer_m(res.x)
         true_res = float(jnp.sqrt(blas.norm2(r_pairs)
                                   / blas.norm2(b_pairs)))
         x_np = np.asarray(res.x)

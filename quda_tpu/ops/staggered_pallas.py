@@ -92,14 +92,7 @@ def _make_stag_kernel(X: int, nhop: int, bz: int, eo: tuple | None = None):
 
     def kernel(psi_c, psi_tp, psi_tm, psi_zp, psi_zm, u, u_bw, out_ref):
         def psi_at(ref, c):
-            # center blocks are (3,2,1,bz,YX); boundary-ROW inputs carry
-            # one extra singleton z axis (3,2,1,1,nhop,YX) — an nhop-
-            # extent block on the sublane axis of a Z-extent array is
-            # illegal on hardware, so rows arrive as separate arrays
-            # whose z extent IS nhop (block == dim is legal)
-            pad = (0,) * (len(ref.shape) - 5)
-            return (ref[(c, 0, 0) + pad].astype(F32),
-                    ref[(c, 1, 0) + pad].astype(F32))
+            return (ref[c, 0, 0].astype(F32), ref[c, 1, 0].astype(F32))
 
         if eo is not None:
             parity, Xh = eo
@@ -121,9 +114,8 @@ def _make_stag_kernel(X: int, nhop: int, bz: int, eo: tuple | None = None):
                              nhop)
 
         def link(ref, mu, a, b):
-            pad = (0,) * (len(ref.shape) - 7)
-            return (ref[(mu, a, b, 0, 0) + pad].astype(F32),
-                    ref[(mu, a, b, 1, 0) + pad].astype(F32))
+            return (ref[mu, a, b, 0, 0].astype(F32),
+                    ref[mu, a, b, 1, 0].astype(F32))
 
         acc = [(jnp.zeros(psi_c.shape[-2:], F32),
                 jnp.zeros(psi_c.shape[-2:], F32)) for _ in range(3)]
@@ -491,9 +483,13 @@ def _pick_bz_v3(Z, YX, dtype, with_long: bool, eo: bool = False):
     bz = _pick_bz(Z, YX, dtype, planes=planes,
                   min_bz=3 if (with_long and Z > 3) else 1)
     if with_long and bz != Z and bz % 3 != 0:
-        # Naik boundary inputs need bz % 3 == 0 (or a single z-block)
+        # Naik boundary inputs need bz % 3 == 0 (or a single z-block);
+        # candidates must ALSO satisfy the hardware block-legality rule
+        # (divide by 8 or equal Z — same filter as _pick_bz, else this
+        # fallback reintroduces the illegal-block compile failure)
         cands = [d for d in range(3, bz + 1)
-                 if Z % d == 0 and d % 3 == 0]
+                 if Z % d == 0 and d % 3 == 0
+                 and (d % 8 == 0 or d == Z)]
         if cands:
             bz = max(cands)
         else:

@@ -212,13 +212,8 @@ def _make_kernel(X: int, bz: int, eo: tuple | None = None):
 
         # loads cast storage dtype (f32 or bf16) to f32 compute
         def psi_at(ref, s, c):
-            # center blocks are (4,3,2,1,bz,YX); boundary-ROW inputs
-            # carry one extra singleton z axis (…,1,1,YX) because a
-            # 1-extent block on the sublane axis of a Z-extent array is
-            # illegal on hardware — index the extra axis away
-            pad = (0,) * (len(ref.shape) - 6)
-            return (ref[(s, c, 0, 0) + pad].astype(F32),
-                    ref[(s, c, 1, 0) + pad].astype(F32))
+            return (ref[s, c, 0, 0].astype(F32),
+                    ref[s, c, 1, 0].astype(F32))
 
         def psi_row(ref, s, c, rows):
             return (ref[s, c, 0, 0][rows].astype(F32),
