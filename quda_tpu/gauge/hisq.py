@@ -19,6 +19,7 @@ TPU-native notes:
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Tuple
 
 import jax
@@ -57,8 +58,19 @@ def _staple_pair(x_mu: jnp.ndarray, u_nu: jnp.ndarray, mu: int, nu: int):
     return up + dn
 
 
+@functools.partial(jax.checkpoint, static_argnums=(1,))
 def fat_links(gauge: jnp.ndarray, c: HisqCoeffs) -> jnp.ndarray:
     """Generalised fattening for one coefficient set.
+
+    ``jax.checkpoint``: the nested staple sums hold O(100) link-sized
+    intermediates alive under AD (the HISQ force differentiates through
+    two fattening levels); at 16^4 that peak drove XLA:TPU into its
+    compression-remat pass, whose bf16 copies of (…,3,3,2) temps pick a
+    (4,128)-tiled layout with 56.9x padding — OOM (measured 2026-07-31).
+    Checkpointing stores only (gauge, output) and recomputes staples in
+    the backward pass: the same FLOPs-for-HBM trade the reference makes
+    by re-deriving staples in hisq_force_quda.cu rather than caching
+    every level.
 
     3-staple: sum_nu staple_nu(U_mu);
     5-staple: sum_{nu != rho} staple_nu(staple_rho(U_mu));
@@ -94,6 +106,7 @@ def fat_links(gauge: jnp.ndarray, c: HisqCoeffs) -> jnp.ndarray:
     return jnp.stack(fat)
 
 
+@jax.checkpoint
 def naik_links(gauge: jnp.ndarray) -> jnp.ndarray:
     """Straight 3-link (Naik) field: U_mu(x) U_mu(x+mu) U_mu(x+2mu)."""
     out = []
@@ -110,6 +123,7 @@ def two_link(gauge: jnp.ndarray) -> jnp.ndarray:
                       for mu in range(4)])
 
 
+@jax.checkpoint
 def unitarize_links(v: jnp.ndarray) -> jnp.ndarray:
     """U(3) projection W = V (V^dag V)^{-1/2} via batched eigh.
 

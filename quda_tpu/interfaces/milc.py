@@ -669,15 +669,15 @@ def qudaAsqtadForce(mass: float, phi, tol: float = 1e-10):
     """qudaAsqtadForce (quda_milc_interface.h:1147): asqtad fermion force
     (fat7 + Naik chain, NO reunitarisation) via AD through the fattening."""
     from ..gauge.fermion_force import pseudofermion_force
-    from ..gauge.hisq import HisqCoeffs, fat_links, naik_links
+    from ..gauge.hisq import ASQTAD_COEFFS, fat_links, naik_links
     from ..models.staggered import DiracStaggeredPC
     from ..solvers.cg import cg
     gauge = api._ctx["gauge"]
     geom = api._ctx["geom"]
 
     def make_op(u):
-        fat = fat_links(u, HisqCoeffs())
-        lng = naik_links(u)
+        fat = fat_links(u, ASQTAD_COEFFS)
+        lng = ASQTAD_COEFFS.naik * naik_links(u)
         return DiracStaggeredPC(fat, geom, mass, improved=True,
                                 long_links=lng).M
 

@@ -640,7 +640,10 @@ def invert_quda(source, param: InvertParam):
     param.true_res = float(jnp.sqrt(blas.norm2(r) / blas.norm2(b)))
     flops = getattr(d, "flops_per_site_M", lambda: 0)()
     vol = _ctx["geom"].volume
-    param.gflops = (param.iter_count * 2.0 * flops * vol) / 1e9
+    # Hermitian PC (staggered): the solver applies M once per iteration;
+    # otherwise CGNR applies M and Mdag (2 mat-vecs per iteration)
+    mv_per_iter = 1.0 if getattr(d, "hermitian", False) else 2.0
+    param.gflops = (param.iter_count * mv_per_iter * flops * vol) / 1e9
     qlog.printq(
         f"invert_quda[{param.dslash_type}/{inv}]: {param.iter_count} iters,"
         f" true_res {param.true_res:.2e}, {param.secs:.2f} s")
@@ -794,12 +797,14 @@ def invert_multishift_quda(source, param: InvertParam):
 
     def _account(n_extra_mv: int = 0):
         """Populate param.gflops like invert_quda does (monitor parity,
-        lib/monitor.cpp solver fields): each multishift iteration costs
-        one MdagM = 2 operator applies; polish solves add their own."""
+        lib/monitor.cpp solver fields).  Hermitian PC (staggered): the
+        shifted solves apply M once per iteration; otherwise the normal
+        equations cost MdagM = 2 applies.  Polish solves add their own."""
         flops = getattr(d, "flops_per_site_M", lambda: 0)()
         vol = _ctx["geom"].volume
-        param.gflops = ((param.iter_count * 2.0 + n_extra_mv) * flops
-                        * vol) / 1e9
+        mv_per_iter = 1.0 if getattr(d, "hermitian", False) else 2.0
+        param.gflops = ((param.iter_count * mv_per_iter + n_extra_mv)
+                        * flops * vol) / 1e9
 
     on_tpu = jax.default_backend() == "tpu"
     if (param.dslash_type in ("staggered", "asqtad", "hisq")

@@ -91,6 +91,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "mid: structural coverage of the heavy files; "
                    "'smoke or mid' is the ~10-minute review tier")
+    config.addinivalue_line(
+        "markers", "slow: multi-minute end-to-end runs (production-"
+                   "volume harnesses); included in the default full run")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -228,3 +228,17 @@ def test_phased_update_and_fixing_and_handles(ctx):
     assert api._ctx["gauge"] is None
     # restore the resident gauge for any later module tests
     api._set_resident_gauge(g0)
+
+
+def test_asqtad_force_finite(ctx):
+    """qudaAsqtadForce end-to-end (quda_milc_interface.h:1147): the
+    asqtad fattening chain (fat7+Naik, no reunitarisation) must produce
+    a finite, antihermitian-shaped force.  Regression: the coefficient
+    set was constructed as HisqCoeffs() with no arguments, which raises
+    TypeError before the fattening runs."""
+    from quda_tpu.fields.spinor import even_odd_split
+    milc.qudaLoadGauge(ctx, GEOM.dims)
+    be, _ = even_odd_split(_stag_source(77), GEOM)
+    f = milc.qudaAsqtadForce(MASS, be, tol=1e-5)
+    fn = np.asarray(f)
+    assert fn.shape[0] == 4 and np.isfinite(fn).all()
