@@ -156,13 +156,23 @@ def _time_marginal(make_chain, args, n1: int, n2: int, reps: int):
     """Marginal per-application seconds between chain lengths n1 < n2.
 
     make_chain(n) -> jitted f(*args, eps) returning an f32 scalar.
-    Returns (seconds_per_apply, checksum)."""
+    Returns (seconds_per_apply, checksum).
+
+    A marginal that is not clearly positive means the measurement is
+    NOISE (a contended host can inflate the short-chain total past the
+    long one — observed 2026-07-31: blas rows claiming 0.0 s/call and
+    1e11 "GFLOPS" while another process shared the chip).  One re-measure
+    of the long chain is attempted; if the marginal is still
+    indistinguishable from zero the result is NaN so no caller can
+    mistake it for a throughput."""
     import jax.numpy as jnp
 
     totals = {}
     checksum = None
-    for n in (n1, n2):
+
+    def measure(n):
         f = make_chain(n)
+        nonlocal checksum
         checksum = _fetch(f(*args, jnp.float32(0.01)))  # compile + warm
         best = float("inf")
         for i in range(reps):
@@ -170,9 +180,20 @@ def _time_marginal(make_chain, args, n1: int, n2: int, reps: int):
             t0 = time.perf_counter()
             checksum = _fetch(f(*args, eps))
             best = min(best, time.perf_counter() - t0)
-        totals[n] = best
+        return best
+
+    for n in (n1, n2):
+        totals[n] = measure(n)
+    if totals[n2] - totals[n1] <= 0.02 * totals[n1]:
+        # degenerate marginal — usually a contention spike inflating the
+        # SHORT chain's best.  Re-measure BOTH chains and keep the min
+        # (the consistent estimator); never keep a slower sample.
+        for n in (n1, n2):
+            totals[n] = min(totals[n], measure(n))
     sec = (totals[n2] - totals[n1]) / (n2 - n1)
-    return max(sec, 1e-12), checksum
+    if sec <= 0.02 * totals[n1] / (n2 - n1):
+        return float("nan"), checksum
+    return sec, checksum
 
 
 def main():
@@ -340,8 +361,12 @@ def main():
     def run_path(name, fn, args):
         try:
             s, _ = _time_marginal(chain_of(fn), args, n1, n2, reps)
-            secs[name] = s
-            paths[name] = round(flops / s / 1e9, 1)
+            if not (s > 0):              # NaN marginal — noise, not data
+                paths[name + "_error"] = ("non-positive marginal "
+                                          "(contended host?)")
+            else:
+                secs[name] = s
+                paths[name] = round(flops / s / 1e9, 1)
         except Exception as e:
             paths[name + "_error"] = str(e)[:160]
         _refresh_headline()
@@ -490,8 +515,12 @@ def main():
         try:
             s, _ = _time_marginal(make_canon, (gauge_d, psi_d), n1, n2,
                                   reps)
-            secs["xla_canonical"] = s
-            paths["xla_canonical"] = round(flops / s / 1e9, 1)
+            if not (s > 0):          # NaN marginal — noise, not data
+                paths["xla_canonical_error"] = ("non-positive marginal "
+                                                "(contended host?)")
+            else:
+                secs["xla_canonical"] = s
+                paths["xla_canonical"] = round(flops / s / 1e9, 1)
         except Exception as e:
             paths["xla_canonical_error"] = str(e)[:160]
         _refresh_headline()

@@ -168,8 +168,9 @@ def run(lat, n_vec, kappa, csw, tol, setup_iters, emit=print):
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--lat", type=int, nargs=4, default=[64, 32, 32, 32],
-                    help="T Z Y X (default 32^3x64)")
+    ap.add_argument("--lat", type=int, nargs=4, default=[32, 32, 32, 64],
+                    help="X Y Z T — LatticeGeometry dims order "
+                         "(default 32^3 spatial, T=64)")
     ap.add_argument("--nvec", type=int, default=12)
     ap.add_argument("--kappa", type=float, default=0.124)
     ap.add_argument("--csw", type=float, default=1.0)

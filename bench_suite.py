@@ -29,6 +29,14 @@ from bench import _conf, _fetch, _probe_subprocess, _time_marginal
 
 
 def _emit(suite, name, secs, flops, bytes_, platform, lattice, **extra):
+    if not (secs > 0):                   # NaN marginal: see _time_marginal
+        print(json.dumps({
+            "suite": suite, "name": name,
+            "error": "non-positive marginal (contended host?); "
+                     "re-run on an idle machine",
+            "platform": platform, "lattice": list(lattice), **extra,
+        }), flush=True)
+        return
     print(json.dumps({
         "suite": suite, "name": name,
         "gflops": round(flops / secs / 1e9, 2),

@@ -503,14 +503,17 @@ def invert_quda(source, param: InvertParam):
         mv = d.M
         sys_rhs = rhs
         back = lambda x: x
+        mv_applies = 1.0
     elif normop:
         mv = lambda v: d.Mdag(d.M(v))
         sys_rhs = d.Mdag(rhs)
         back = lambda x: x
+        mv_applies = 2.0
     else:
         mv = d.M
         sys_rhs = rhs
         back = lambda x: x
+        mv_applies = 1.0
 
     inv = param.inv_type
     if inv == "cg" and not (hermitian_pc or normop):
@@ -521,6 +524,7 @@ def invert_quda(source, param: InvertParam):
                       "(normal-residual) semantics")
         mv = lambda v: d.Mdag(d.M(v))
         sys_rhs = d.Mdag(rhs)
+        mv_applies = 2.0
 
     if mixed and inv == "cg":
         if pair_sloppy:
@@ -640,10 +644,9 @@ def invert_quda(source, param: InvertParam):
     param.true_res = float(jnp.sqrt(blas.norm2(r) / blas.norm2(b)))
     flops = getattr(d, "flops_per_site_M", lambda: 0)()
     vol = _ctx["geom"].volume
-    # Hermitian PC (staggered): the solver applies M once per iteration;
-    # otherwise CGNR applies M and Mdag (2 mat-vecs per iteration)
-    mv_per_iter = 1.0 if getattr(d, "hermitian", False) else 2.0
-    param.gflops = (param.iter_count * mv_per_iter * flops * vol) / 1e9
+    # mv_applies follows the SOLVE ROUTE (1 for direct/Hermitian-PC
+    # operators, 2 for the normal-equation forms), set where mv is built
+    param.gflops = (param.iter_count * mv_applies * flops * vol) / 1e9
     qlog.printq(
         f"invert_quda[{param.dslash_type}/{inv}]: {param.iter_count} iters,"
         f" true_res {param.true_res:.2e}, {param.secs:.2f} s")

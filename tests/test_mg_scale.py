@@ -22,7 +22,8 @@ def test_mg_scale_harness_small():
     import bench_mg_scale as bms
 
     # serialise collective programs for the sharded step (1-core hosts;
-    # restore afterwards so the rest of the suite keeps async dispatch)
+    # restore the PRIOR value afterwards, whatever it was)
+    prev = jax.config.jax_cpu_enable_async_dispatch
     jax.config.update("jax_cpu_enable_async_dispatch", False)
     records = []
     try:
@@ -30,7 +31,7 @@ def test_mg_scale_harness_small():
             (16, 8, 8, 8), n_vec=4, kappa=0.124, csw=1.0, tol=1e-6,
             setup_iters=8, emit=lambda s: records.append(json.loads(s)))
     finally:
-        jax.config.update("jax_cpu_enable_async_dispatch", True)
+        jax.config.update("jax_cpu_enable_async_dispatch", prev)
 
     by_name = {r["name"]: r for r in records}
     assert by_name["setup"]["levels"] == 3
