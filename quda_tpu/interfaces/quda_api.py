@@ -526,6 +526,14 @@ def invert_quda(source, param: InvertParam):
         sys_rhs = d.Mdag(rhs)
         mv_applies = 2.0
 
+    # direct-route solvers that internally apply the operator more than
+    # once per counted iteration (cgne/cgnr compose Mdag themselves,
+    # BiCGStab does two mat-vecs per iteration; bicgstab-l is charged the
+    # same 2 as an under-approximation of its l+1 applies)
+    if mv_applies == 1.0 and inv in ("cgne", "cgnr", "cg3", "bicgstab",
+                                     "bicgstab-l"):
+        mv_applies = 2.0
+
     if mixed and inv == "cg":
         if pair_sloppy:
             sl = d.sloppy(sloppy_prec)

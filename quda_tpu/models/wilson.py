@@ -209,8 +209,15 @@ class _PackedHopMixin:
         self._tb_sign = tb_sign
         from ..utils import config as qconf
         if pallas_version is None:
-            pallas_version = qconf.get("QUDA_TPU_PALLAS_VERSION",
-                                       fresh=True)
+            if mesh is not None:
+                # the sharded eo policy exists only in scatter (v3) form
+                # (parallel/pallas_dslash.dslash_eo_pallas_sharded_v3);
+                # the measured v2-wins default is a SINGLE-chip verdict
+                # (PERF.md round 5) and must not disable multi-chip
+                pallas_version = 3
+            else:
+                pallas_version = qconf.get("QUDA_TPU_PALLAS_VERSION",
+                                           fresh=True)
         if pallas_version not in (2, 3):
             raise ValueError(f"pallas_version must be 2 or 3, got "
                              f"{pallas_version}")

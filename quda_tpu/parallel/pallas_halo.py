@@ -74,11 +74,22 @@ def _make_fused_kernel(axis_name: str):
         m, tb = _zbwd_math(psi_at, link_of)
 
         # 2. pack the top boundary row and start the remote copy — the
-        #    +z neighbour's z=0 output needs OUR last row's product
+        #    +z neighbour's z=0 output needs OUR last row's product.
+        #    BARRIER first: my write lands in the +z neighbour's ghost
+        #    scratch, which is only live once IT has entered this kernel
+        #    — so each device signals its -z neighbour "my buffers are
+        #    ready" and waits for the same signal from its +z neighbour
+        #    (the canonical neighbour-barrier; collective_id pins the
+        #    shared barrier semaphore across devices)
         for s in range(2):
             for c in range(3):
                 sendbuf[s, c, 0] = m[s][c][0][-1:]
                 sendbuf[s, c, 1] = m[s][c][1][-1:]
+        bsem = pltpu.get_barrier_semaphore()
+        prv = (my - 1) % n
+        pltpu.semaphore_signal(bsem, inc=1, device_id=(prv,),
+                               device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_wait(bsem, 1)
         rdma = pltpu.make_async_remote_copy(
             src_ref=sendbuf, dst_ref=ghost,
             send_sem=send_sem, recv_sem=recv_sem,

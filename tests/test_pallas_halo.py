@@ -19,7 +19,10 @@ from quda_tpu.parallel.pallas_halo import (wilson_zbwd_composed,
 
 @pytest.mark.mid
 def test_fused_halo_matches_composed():
-    Z, YX = 16, 8 * 8
+    # small on purpose: the Mosaic interpreter with cross-device DMA
+    # emulation costs minutes at Z=16/YX=64 on a 1-core host, and the
+    # seam it verifies is size-independent (mid-tier budget contract)
+    Z, YX = 8, 4 * 4
     key = jax.random.PRNGKey(3)
     k1, k2 = jax.random.split(key)
     psi = jax.random.normal(k1, (4, 3, 2, Z, YX), jnp.float32)

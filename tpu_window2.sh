@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-5 second-window queue: probe until the tunnel returns, then run
+# the remaining phases on the idle chip (the first window measured
+# dslash; solver/gauge/blas were lost to contention or the pre-fix
+# kernels).  One phase at a time; everything appended to the log.
+set -u
+cd "$(dirname "$0")"
+LOG=measurements_tpu.log
+for i in $(seq 1 90); do
+  probe=$(timeout 90 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+  echo "[$(date -u +%FT%TZ)] window2 probe: ${probe:-none}" >> tpu_probe.log
+  if [ "${probe:-}" = "tpu" ]; then
+    echo "[$(date -u +%FT%TZ)] == window2 open ==" | tee -a "$LOG"
+    for phase in "bench_suite.py solver" "bench_suite.py gauge" \
+                 "bench_suite.py blas" "bench_suite.py dslash" "bench.py"; do
+      echo "[$(date -u +%FT%TZ)] == python $phase" >> "$LOG"
+      timeout 1800 python $phase 2>&1 | grep -a "suite\|metric\|Error\|error" | tail -30 >> "$LOG"
+      echo "[$(date -u +%FT%TZ)] phase done" >> "$LOG"
+    done
+    echo "[$(date -u +%FT%TZ)] window2 queue complete" >> "$LOG"
+    exit 0
+  fi
+  sleep 100
+done
+echo "[$(date -u +%FT%TZ)] window2: tunnel never returned" >> "$LOG"
