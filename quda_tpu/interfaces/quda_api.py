@@ -529,9 +529,11 @@ def invert_quda(source, param: InvertParam):
     # direct-route solvers that internally apply the operator more than
     # once per counted iteration (cgne/cgnr compose Mdag themselves,
     # BiCGStab does two mat-vecs per iteration; bicgstab-l is charged the
-    # same 2 as an under-approximation of its l+1 applies)
-    if mv_applies == 1.0 and inv in ("cgne", "cgnr", "cg3", "bicgstab",
-                                     "bicgstab-l"):
+    # same 2 as an under-approximation of its l+1 applies).  Hermitian-PC
+    # systems run these as plain one-apply CG — no bump.  cg3's recursion
+    # is one apply per counted iteration.
+    if (mv_applies == 1.0 and not hermitian_pc
+            and inv in ("cgne", "cgnr", "bicgstab", "bicgstab-l")):
         mv_applies = 2.0
 
     if mixed and inv == "cg":

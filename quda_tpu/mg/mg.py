@@ -48,10 +48,13 @@ class MGLevelParam:
     coarse_solver_cycles: int = 2
     # Coarse-level latency strategy (SURVEY hard-part #1; QUDA runs
     # coarse levels on subset communicators, lib/multigrid.cpp:358).
-    # True = all-gather the tiny coarsest-level fields and solve them
-    # REPLICATED on every device (redundant flops, zero collectives in
-    # the bottom solve — the ICI-latency trade that wins when the
-    # coarsest lattice is a handful of sites per device).
+    # True = all-gather this level's COARSE rhs and run everything below
+    # it REPLICATED on every device (redundant flops, zero collectives
+    # below the seam — the ICI-latency trade that wins when the coarse
+    # lattice is a handful of sites per device).  Set on the coarsest
+    # level's param for the classic bottom-solve gather, or on an
+    # INTERMEDIATE level to take whole sub-hierarchies off the mesh —
+    # the TPU analog of the reference's subset communicators.
     coarse_replicate: bool = False
 
 
@@ -296,46 +299,58 @@ class MG:
             x = smooth(b, p.pre_smooth, x)
         r = b - op.M(x)
         rc = tr.restrict(r)
+        if p.coarse_replicate:
+            # Gather the coarse rhs onto every device BEFORE descending:
+            # the level below (and, by GSPMD propagation, everything
+            # under it) then runs collective-free and redundantly, and
+            # the prolong's input resharding is a single scatter.  On
+            # the COARSEST level this is the bottom-solve latency trade;
+            # on an INTERMEDIATE level it is the TPU analog of QUDA's
+            # subset communicators (lib/multigrid.cpp:185,
+            # lib/communicator_stack.cpp:49 — SURVEY §7 hard part #1):
+            # small grids whose halo latency dominates their compute run
+            # replicated instead of latency-bound on the full mesh.
+            rc = self._replicate(rc)
         if level + 1 < len(self.levels):
             ec = self.vcycle(level + 1, rc)
         else:
-            if p.coarse_replicate:
-                # gather the coarsest rhs onto every device; the bottom
-                # GCR then runs collective-free and redundantly, and the
-                # prolong's input resharding is a single scatter.  Needs
-                # an active mesh: either the new-style abstract mesh
-                # (jax.sharding.use_mesh) or a concrete ``with mesh:``
-                # context (whose mesh get_abstract_mesh does NOT see).
-                from jax.sharding import NamedSharding
-                from jax.sharding import PartitionSpec as P
-                spec = P(*([None] * rc.ndim))
-                amesh = jax.sharding.get_abstract_mesh()
-                pmesh = None
-                try:
-                    from jax._src.mesh import thread_resources
-                    pm = thread_resources.env.physical_mesh
-                    if pm is not None and not pm.empty:
-                        pmesh = pm
-                except Exception:
-                    pass
-                if amesh is not None and amesh.shape_tuple:
-                    rc = jax.lax.with_sharding_constraint(rc, spec)
-                elif pmesh is not None:
-                    rc = jax.lax.with_sharding_constraint(
-                        rc, NamedSharding(pmesh, spec))
-                elif not getattr(self, "_warned_replicate", False):
-                    import warnings
-                    warnings.warn(
-                        "coarse_replicate=True has no effect without an "
-                        "active mesh context (wrap the jit in `with "
-                        "mesh:` or jax.sharding.use_mesh)", stacklevel=2)
-                    self._warned_replicate = True
             ec = gcr_fixed(coarse.M, rc, nkrylov=p.coarse_solver_iters,
                            cycles=p.coarse_solver_cycles)
         x = x + tr.prolong(ec)
         if p.post_smooth:
             x = smooth(b, p.post_smooth, x)
         return x
+
+    def _replicate(self, rc):
+        """Constrain ``rc`` to a fully-replicated sharding under the
+        active mesh (abstract `jax.sharding.use_mesh` or a concrete
+        ``with mesh:`` context); no-op with a one-time warning when no
+        mesh is active."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        spec = P(*([None] * rc.ndim))
+        amesh = jax.sharding.get_abstract_mesh()
+        pmesh = None
+        try:
+            from jax._src.mesh import thread_resources
+            pm = thread_resources.env.physical_mesh
+            if pm is not None and not pm.empty:
+                pmesh = pm
+        except Exception:
+            pass
+        if amesh is not None and amesh.shape_tuple:
+            return jax.lax.with_sharding_constraint(rc, spec)
+        if pmesh is not None:
+            return jax.lax.with_sharding_constraint(
+                rc, NamedSharding(pmesh, spec))
+        if not getattr(self, "_warned_replicate", False):
+            import warnings
+            warnings.warn(
+                "coarse_replicate=True has no effect without an "
+                "active mesh context (wrap the jit in `with "
+                "mesh:` or jax.sharding.use_mesh)", stacklevel=2)
+            self._warned_replicate = True
+        return rc
 
     def precondition(self, r_std):
         """K(r) for an outer solver in STANDARD layout (spin for

@@ -15,7 +15,8 @@ for i in $(seq 1 90); do
                  "bench_suite.py blas" "bench_suite.py dslash" "bench.py"; do
       echo "[$(date -u +%FT%TZ)] == python $phase" >> "$LOG"
       timeout 1800 python $phase 2>&1 | grep -a "suite\|metric\|Error\|error" | tail -30 >> "$LOG"
-      echo "[$(date -u +%FT%TZ)] phase done" >> "$LOG"
+      rc=("${PIPESTATUS[@]}")
+      echo "[$(date -u +%FT%TZ)] phase done rc=${rc[0]} (124=timeout)" >> "$LOG"
     done
     echo "[$(date -u +%FT%TZ)] window2 queue complete" >> "$LOG"
     exit 0
