@@ -327,7 +327,9 @@ def _pick_bz(Z: int, YX: int, dtype=jnp.float32, planes: int = 288,
     sub = 16 if jnp.dtype(dtype).itemsize < 4 else 8
     nbytes = jnp.dtype(dtype).itemsize
     yx_pad = -(-YX // 128) * 128
-    budget = 6 * 2 ** 20
+    from ..utils import config as qconf
+    budget = int(float(qconf.get("QUDA_TPU_PALLAS_VMEM_MB",
+                                 fresh=True)) * 2 ** 20)
     fitting = []
     for bz in sorted({d for d in range(min_bz, Z + 1)
                       if Z % d == 0}):

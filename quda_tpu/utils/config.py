@@ -106,6 +106,13 @@ _register("QUDA_TPU_PALLAS_VERSION", "int", 2,
           "autotuner can still select v3 per-shape when it wins)",
           reference="dslash policy selection; tune.cpp:862 — policies "
                     "are timed, never assumed")
+_register("QUDA_TPU_PALLAS_VMEM_MB", "float", 6.0,
+          "single-buffer VMEM budget (MB) for pallas z-block selection "
+          "(_pick_bz).  Default 6 leaves half the 16 MB scoped limit "
+          "for Mosaic's double buffering; raise it to admit bz=Z "
+          "blocks (e.g. the bf16 full-Z 'equal-to-dim' experiment at "
+          "Z=24 needs ~12) — measure before pinning",
+          reference="tune.cpp shared-bytes tuning axis")
 _register("QUDA_TPU_DF64", "choice", "",
           "extended-precision (float32-pair) precise path for deep-tol "
           "Wilson CG: '1' = force, '0' = off, empty = auto (engaged when "

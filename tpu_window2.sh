@@ -18,6 +18,12 @@ for i in $(seq 1 90); do
       rc=("${PIPESTATUS[@]}")
       echo "[$(date -u +%FT%TZ)] phase done rc=${rc[0]} (124=timeout)" >> "$LOG"
     done
+    # bf16 full-Z block experiment: 13 MB budget admits bz=Z=24 (the
+    # legal 'equal-to-dim' block, 0.75 sublane util vs bz=8's 0.5)
+    echo "[$(date -u +%FT%TZ)] == bench.py QUDA_TPU_PALLAS_VMEM_MB=13 (bf16 bz=Z)" >> "$LOG"
+    QUDA_TPU_PALLAS_VMEM_MB=13 timeout 1800 python bench.py 2>&1 | grep -a "metric\|Error\|error" | tail -5 >> "$LOG"
+    rc=("${PIPESTATUS[@]}")
+    echo "[$(date -u +%FT%TZ)] phase done rc=${rc[0]}" >> "$LOG"
     echo "[$(date -u +%FT%TZ)] window2 queue complete" >> "$LOG"
     exit 0
   fi
