@@ -12,7 +12,8 @@ for i in $(seq 1 90); do
   if [ "${probe:-}" = "tpu" ]; then
     echo "[$(date -u +%FT%TZ)] == window2 open ==" | tee -a "$LOG"
     for phase in "bench_suite.py solver" "bench_suite.py gauge" \
-                 "bench_suite.py blas" "bench_suite.py dslash" "bench.py"; do
+                 "bench_suite.py blas" "bench_suite.py mg" \
+                 "bench_suite.py dslash" "bench.py"; do
       echo "[$(date -u +%FT%TZ)] == python $phase" >> "$LOG"
       timeout 1800 python $phase 2>&1 | grep -a "suite\|metric\|Error\|error" | tail -30 >> "$LOG"
       rc=("${PIPESTATUS[@]}")
