@@ -50,7 +50,8 @@ def _rss_mb():
         return int(fh.read().split()[1]) * os.sysconf("SC_PAGE_SIZE") / 2**20
 
 
-def run(lat, n_vec, kappa, csw, tol, setup_iters, emit=print):
+def run(lat, n_vec, kappa, csw, tol, setup_iters, emit=print,
+        gauge_scale=None, nkrylov=16):
     from quda_tpu.fields.geometry import LatticeGeometry
     from quda_tpu.fields.gauge import GaugeField
     from quda_tpu.mg.mg import MG, MGLevelParam, mg_solve
@@ -63,7 +64,12 @@ def run(lat, n_vec, kappa, csw, tol, setup_iters, emit=print):
     rss0 = _rss_mb()
 
     t0 = time.perf_counter()
-    U = GaugeField.random(jax.random.PRNGKey(11), geom).data.astype(
+    # gauge_scale < full disorder gives a SMOOTH configuration — the
+    # regime MG is for (coherent near-null modes; physical ensembles are
+    # smooth).  Fully random links destroy the low-mode structure and
+    # make plain CG artificially easy AND MG setup useless.
+    gkw = {} if gauge_scale is None else {"scale": gauge_scale}
+    U = GaugeField.random(jax.random.PRNGKey(11), geom, **gkw).data.astype(
         jnp.complex64)
     d = DiracClover(U, geom, kappa=kappa, csw=csw)
     b = jax.random.normal(
@@ -115,8 +121,8 @@ def run(lat, n_vec, kappa, csw, tol, setup_iters, emit=print):
 
     # outer MG-GCR solve
     t0 = time.perf_counter()
-    res_mg, _ = mg_solve(d, geom, b, None, tol=tol, nkrylov=16,
-                         max_restarts=40, mg=mg)
+    res_mg, _ = mg_solve(d, geom, b, None, tol=tol, nkrylov=nkrylov,
+                         max_restarts=80, mg=mg)
     jax.block_until_ready(res_mg.x)
     mg_solve_s = time.perf_counter() - t0
     r = b - d.M(res_mg.x)
@@ -176,7 +182,12 @@ if __name__ == "__main__":
     ap.add_argument("--csw", type=float, default=1.0)
     ap.add_argument("--tol", type=float, default=1e-8)
     ap.add_argument("--setup-iters", type=int, default=60)
+    ap.add_argument("--scale", type=float, default=None,
+                    help="gauge disorder scale (None = fully random; "
+                         "~0.15 = smooth, the MG regime)")
+    ap.add_argument("--nkrylov", type=int, default=16)
     a = ap.parse_args()
     _configure()
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    run(a.lat, a.nvec, a.kappa, a.csw, a.tol, a.setup_iters)
+    run(a.lat, a.nvec, a.kappa, a.csw, a.tol, a.setup_iters,
+        gauge_scale=a.scale, nkrylov=a.nkrylov)
