@@ -126,6 +126,196 @@ def _make_fused_kernel(axis_name: str):
     return kernel
 
 
+def _make_fused_kernel_bidir(axis_name: str):
+    """Both z hops in one launch: two RDMAs in flight behind one
+    neighbour barrier — the full per-direction shape of the
+    dslash_shmem uber-kernel, for the z axis.
+
+    The backward-hop body repeats `_make_fused_kernel` (pack / interior
+    roll / z=0 splice / recon): the unidirectional kernel is kept as the
+    minimal teaching form of the seam, and the two must evolve together
+    — change either hop's packing or splice in BOTH places (or retire
+    the unidirectional kernel once a production path adopts this one)."""
+    def kernel(psi_ref, uz_ref, out_ref, sb_bwd, gh_bwd, sb_fwd, gh_fwd,
+               send_b, recv_b, send_f, recv_f):
+        my = jax.lax.axis_index(axis_name)
+        n = jax.lax.axis_size(axis_name)
+        nxt = (my + 1) % n
+        prv = (my - 1) % n
+
+        def psi_at(s, c):
+            return (psi_ref[s, c, 0].astype(F32),
+                    psi_ref[s, c, 1].astype(F32))
+
+        def link_of(a, b):
+            return (uz_ref[a, b, 0].astype(F32),
+                    uz_ref[a, b, 1].astype(F32))
+
+        # local products/half-spinors for both hops
+        m, tb = _zbwd_math(psi_at, link_of)      # bwd: U^dag P^{+z} psi
+        tf = TABLES[(2, +1)]
+        h = _project(psi_at, tf)                 # fwd: P^{-z} psi
+
+        # pack both boundary strips
+        for s in range(2):
+            for c in range(3):
+                sb_bwd[s, c, 0] = m[s][c][0][-1:]   # my top product
+                sb_bwd[s, c, 1] = m[s][c][1][-1:]
+                sb_fwd[s, c, 0] = h[s][c][0][:1]    # my bottom half-spinor
+                sb_fwd[s, c, 1] = h[s][c][1][:1]
+
+        # neighbour barrier both ways, then both RDMAs in flight
+        bsem = pltpu.get_barrier_semaphore()
+        for dst in (prv, nxt):
+            pltpu.semaphore_signal(bsem, inc=1, device_id=(dst,),
+                                   device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_wait(bsem, 2)
+        rdma_b = pltpu.make_async_remote_copy(
+            src_ref=sb_bwd, dst_ref=gh_bwd, send_sem=send_b,
+            recv_sem=recv_b, device_id=(nxt,),
+            device_id_type=pltpu.DeviceIdType.MESH)
+        rdma_f = pltpu.make_async_remote_copy(
+            src_ref=sb_fwd, dst_ref=gh_fwd, send_sem=send_f,
+            recv_sem=recv_f, device_id=(prv,),
+            device_id_type=pltpu.DeviceIdType.MESH)
+        rdma_b.start()
+        rdma_f.start()
+
+        # interior work overlaps both transfers
+        int_b = [[(jnp.roll(m[s][c][0], 1, axis=0),
+                   jnp.roll(m[s][c][1], 1, axis=0))
+                  for c in range(3)] for s in range(2)]
+        int_f = [[(jnp.roll(h[s][c][0], -1, axis=0),
+                   jnp.roll(h[s][c][1], -1, axis=0))
+                  for c in range(3)] for s in range(2)]
+
+        rdma_b.wait()
+        rdma_f.wait()
+        row = jax.lax.broadcasted_iota(jnp.int32, psi_ref.shape[-2:], 0)
+        zl = psi_ref.shape[-2]
+        uh_b = [[None] * 3 for _ in range(2)]
+        h_sp = [[None] * 3 for _ in range(2)]
+        for s in range(2):
+            for c in range(3):
+                uh_b[s][c] = (
+                    jnp.where(row == 0, gh_bwd[s, c, 0].astype(F32),
+                              int_b[s][c][0]),
+                    jnp.where(row == 0, gh_bwd[s, c, 1].astype(F32),
+                              int_b[s][c][1]))
+                h_sp[s][c] = (
+                    jnp.where(row == zl - 1, gh_fwd[s, c, 0].astype(F32),
+                              int_f[s][c][0]),
+                    jnp.where(row == zl - 1, gh_fwd[s, c, 1].astype(F32),
+                              int_f[s][c][1]))
+        # fwd: multiply the SPLICED half-spinor by the local link U(x)
+        uh_f = _color_mul(h_sp, link_of, False)
+
+        acc = [[(jnp.zeros(psi_ref.shape[-2:], F32),
+                 jnp.zeros(psi_ref.shape[-2:], F32))
+                for _ in range(3)] for _ in range(4)]
+        _recon_acc(acc, uh_b, tb)
+        _recon_acc(acc, uh_f, tf)
+        for s in range(4):
+            for c in range(3):
+                out_ref[s, c, 0] = acc[s][c][0]
+                out_ref[s, c, 1] = acc[s][c][1]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis_name",
+                                             "interpret"))
+def wilson_z_fused_halo(psi_pl: jnp.ndarray, uz_pl: jnp.ndarray,
+                        mesh, axis_name: str = "z",
+                        interpret: bool = False) -> jnp.ndarray:
+    """BOTH z hops with their halos exchanged inside one kernel launch
+    (two concurrent RDMAs behind one neighbour barrier); layouts as
+    `wilson_zbwd_fused_halo`.  Matches `wilson_z_composed`."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    kern = _make_fused_kernel_bidir(axis_name)
+    ip = pltpu.InterpretParams() if interpret else False
+
+    def local(psi, uz):
+        yx = psi.shape[-1]
+        strip = pltpu.VMEM((2, 3, 2, 1, yx), F32)
+        return pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct(psi.shape, psi.dtype),
+            scratch_shapes=[strip, strip, strip, strip,
+                            pltpu.SemaphoreType.DMA,
+                            pltpu.SemaphoreType.DMA,
+                            pltpu.SemaphoreType.DMA,
+                            pltpu.SemaphoreType.DMA],
+            compiler_params=pltpu.CompilerParams(collective_id=0),
+            interpret=ip,
+        )(psi, uz)
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, None, None, axis_name, None),
+                  P(None, None, None, axis_name, None)),
+        out_specs=P(None, None, None, axis_name, None),
+        check_vma=False,
+    )(psi_pl, uz_pl)
+
+
+def _composed_hop(psi_pl: jnp.ndarray, uz_pl: jnp.ndarray,
+                  sign: int) -> jnp.ndarray:
+    """One z hop on GLOBAL arrays (jnp.roll = the GSPMD-composed
+    exchange).  sign=-1: backward (adjoint link, product rolled down);
+    sign=+1: forward (half-spinor rolled up, then local link)."""
+    pr, pi = psi_pl[:, :, 0], psi_pl[:, :, 1]
+    t = TABLES[(2, sign)]
+    hs = []
+    for a in (0, 1):
+        cr, ci = np.real(t[f"c{a}"]), np.imag(t[f"c{a}"])
+        j = t[f"j{a}"]
+        hr = pr[a] + cr * pr[j] - ci * pi[j]
+        hi = pi[a] + cr * pi[j] + ci * pr[j]
+        if sign > 0:                         # shift psi BEFORE the link
+            hr = jnp.roll(hr, -1, axis=-2)
+            hi = jnp.roll(hi, -1, axis=-2)
+        hs.append((hr, hi))
+    ur, ui = uz_pl[:, :, 0], uz_pl[:, :, 1]
+    m = []
+    for a in (0, 1):
+        if sign > 0:                         # U[a,b] h[b]
+            mr = jnp.einsum("ab...,b...->a...", ur, hs[a][0]) \
+                - jnp.einsum("ab...,b...->a...", ui, hs[a][1])
+            mi = jnp.einsum("ab...,b...->a...", ur, hs[a][1]) \
+                + jnp.einsum("ab...,b...->a...", ui, hs[a][0])
+        else:                                # conj(U)[b,a] h[b]
+            mr = jnp.einsum("bc...,b...->c...", ur, hs[a][0]) \
+                + jnp.einsum("bc...,b...->c...", ui, hs[a][1])
+            mi = jnp.einsum("bc...,b...->c...", ur, hs[a][1]) \
+                - jnp.einsum("bc...,b...->c...", ui, hs[a][0])
+        m.append((mr, mi))
+    if sign < 0:                             # shift the product down
+        m = [(jnp.roll(a, 1, axis=-2), jnp.roll(b, 1, axis=-2))
+             for (a, b) in m]
+    out = jnp.zeros_like(psi_pl)
+    for a in (0, 1):
+        out = out.at[a, :, 0].set(m[a][0]).at[a, :, 1].set(m[a][1])
+    d2, k2 = np.real(t["d2"]), t["k2"]
+    d2i = np.imag(t["d2"])
+    d3, k3 = np.real(t["d3"]), t["k3"]
+    d3i = np.imag(t["d3"])
+    out = out.at[2, :, 0].set(d2 * m[k2][0] - d2i * m[k2][1])
+    out = out.at[2, :, 1].set(d2 * m[k2][1] + d2i * m[k2][0])
+    out = out.at[3, :, 0].set(d3 * m[k3][0] - d3i * m[k3][1])
+    out = out.at[3, :, 1].set(d3 * m[k3][1] + d3i * m[k3][0])
+    return out
+
+
+def wilson_z_composed(psi_pl: jnp.ndarray,
+                      uz_pl: jnp.ndarray) -> jnp.ndarray:
+    """XLA-composed reference for BOTH z hops on global arrays."""
+    return (_composed_hop(psi_pl, uz_pl, -1)
+            + _composed_hop(psi_pl, uz_pl, +1))
+
+
 @functools.partial(jax.jit, static_argnames=("mesh", "axis_name",
                                              "interpret"))
 def wilson_zbwd_fused_halo(psi_pl: jnp.ndarray, uz_pl: jnp.ndarray,
@@ -175,38 +365,7 @@ def wilson_zbwd_fused_halo(psi_pl: jnp.ndarray, uz_pl: jnp.ndarray,
 
 def wilson_zbwd_composed(psi_pl: jnp.ndarray,
                          uz_pl: jnp.ndarray) -> jnp.ndarray:
-    """XLA-composed reference for the same term on GLOBAL arrays: the
-    exchange is a jnp.roll (which GSPMD lowers to CollectivePermute
+    """XLA-composed reference for the backward term on GLOBAL arrays:
+    the exchange is a jnp.roll (which GSPMD lowers to CollectivePermute
     around the local compute) — today's production path."""
-    pr, pi = psi_pl[:, :, 0], psi_pl[:, :, 1]
-    t = TABLES[(2, -1)]
-    # project: h[a] = psi[a] + c_a * psi[j_a]  (complex scale on pairs)
-    hs = []
-    for a in (0, 1):
-        cr, ci = np.real(t[f"c{a}"]), np.imag(t[f"c{a}"])
-        j = t[f"j{a}"]
-        hs.append((pr[a] + cr * pr[j] - ci * pi[j],
-                   pi[a] + cr * pi[j] + ci * pr[j]))
-    ur, ui = uz_pl[:, :, 0], uz_pl[:, :, 1]
-    m = []
-    for a in (0, 1):
-        mr = jnp.einsum("bc...,b...->c...", ur, hs[a][0]) \
-            + jnp.einsum("bc...,b...->c...", ui, hs[a][1])
-        mi = jnp.einsum("bc...,b...->c...", ur, hs[a][1]) \
-            - jnp.einsum("bc...,b...->c...", ui, hs[a][0])
-        m.append((mr, mi))
-    # shift the product down one global z row (the halo exchange)
-    m = [(jnp.roll(a, 1, axis=-2), jnp.roll(b, 1, axis=-2))
-         for (a, b) in m]
-    out = jnp.zeros_like(psi_pl)
-    for a in (0, 1):
-        out = out.at[a, :, 0].set(m[a][0]).at[a, :, 1].set(m[a][1])
-    d2, k2 = np.real(t["d2"]), t["k2"]
-    d2i = np.imag(t["d2"])
-    d3, k3 = np.real(t["d3"]), t["k3"]
-    d3i = np.imag(t["d3"])
-    out = out.at[2, :, 0].set(d2 * m[k2][0] - d2i * m[k2][1])
-    out = out.at[2, :, 1].set(d2 * m[k2][1] + d2i * m[k2][0])
-    out = out.at[3, :, 0].set(d3 * m[k3][0] - d3i * m[k3][1])
-    out = out.at[3, :, 1].set(d3 * m[k3][1] + d3i * m[k3][0])
-    return out
+    return _composed_hop(psi_pl, uz_pl, -1)
