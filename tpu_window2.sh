@@ -6,7 +6,7 @@
 set -u
 cd "$(dirname "$0")"
 LOG=measurements_tpu.log
-for i in $(seq 1 90); do
+for i in $(seq 1 75); do
   probe=$(timeout 90 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
   echo "[$(date -u +%FT%TZ)] window2 probe: ${probe:-none}" >> tpu_probe.log
   if [ "${probe:-}" = "tpu" ]; then
