@@ -45,6 +45,7 @@ Paths benchmarked (best f32 path wins; bf16-storage sloppy reported too):
 from __future__ import annotations
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -52,6 +53,88 @@ import threading
 import time
 
 BASELINE_GFLOPS = 1400.0
+
+
+# -- roofline / noise gating ------------------------------------------------
+# Round 5 recorded physically impossible rows into the measurement log
+# (triple_update_norm2 at 1.27e11 GFLOPS / secs 0.0, xpay_redot at
+# 31.8 TB/s — measurements_tpu.log), and the mg suite silently fell back
+# to CPU under a TPU banner.  Every recorded row now passes ``gate_row``:
+# a marginal-seconds floor, a per-suite roofline bound, and a
+# platform==banner assertion.  Rejections are printed LOUDLY into the log
+# (an error row), never silently recorded as data.  The bounds are pure
+# numbers unit-tested in tests/test_bench_gate.py.
+
+MIN_MARGINAL_SECS = 1e-6      # below this a marginal is noise, not data
+
+SUITE_ROOFLINES = {
+    # {"gflops", "gbps"} upper bounds per suite, deliberately generous
+    # (~10x the best credible chip measurement) — they reject the
+    # impossible, not the surprising:
+    #  * dslash/solver: best measured 5,673 GFLOPS (PERF.md round 5); an
+    #    order of magnitude above sits far past the v5p VPU envelope for
+    #    a stencil, and effective bandwidth beyond ~25 TB/s exceeds even
+    #    the VMEM-resident regime (<= 23 TB/s measured).
+    #  * blas: bandwidth-bound bundles at ~0.67 flops/byte against the
+    #    same <= 23 TB/s VMEM ceiling -> < 16 TFLOPS real.
+    "dslash": {"gflops": 60.0e3, "gbps": 25.0e3},
+    "solver": {"gflops": 60.0e3, "gbps": 25.0e3},
+    "blas": {"gflops": 30.0e3, "gbps": 25.0e3},
+    "mg": {"gflops": 60.0e3, "gbps": 25.0e3},
+    "gauge": {"gflops": 60.0e3, "gbps": 25.0e3},
+}
+_DEFAULT_ROOFLINE = {"gflops": 60.0e3, "gbps": 25.0e3}
+
+
+def gate_row(suite: str, row: dict, banner_platform: str = None):
+    """(ok, reason) for a measurement row.
+
+    Pure function (no jax) so the round-5 failure modes are unit-testable:
+    rejects rows whose platform does not match the banner they would be
+    recorded under, rows with a ~zero/negative time, and rows whose
+    gflops/gbps exceed the per-suite roofline bound."""
+    if banner_platform is not None and row.get("platform") != banner_platform:
+        return False, (f"platform mismatch: row measured on "
+                       f"{row.get('platform')!r} cannot be recorded "
+                       f"under a {banner_platform!r} banner")
+    secs = row.get("secs_per_call", row.get("secs"))
+    if secs is not None and not (isinstance(secs, (int, float))
+                                 and math.isfinite(secs)
+                                 and secs > MIN_MARGINAL_SECS):
+        return False, (f"secs={secs!r} at/below the {MIN_MARGINAL_SECS:g}s "
+                       "floor: a zero/negative marginal is noise, not a "
+                       "measurement")
+    lim = SUITE_ROOFLINES.get(suite, _DEFAULT_ROOFLINE)
+    for key, unit in (("gflops", "GFLOPS"), ("gbps", "GB/s")):
+        v = row.get(key)
+        if v is None:
+            continue
+        if not (isinstance(v, (int, float)) and math.isfinite(v)
+                and v >= 0):
+            return False, f"{key}={v!r} is not a finite throughput"
+        if v > lim[key]:
+            return False, (f"{key}={v:g} exceeds the {suite} roofline "
+                           f"bound {lim[key]:g} {unit} — physically "
+                           "impossible; rejected")
+    return True, ""
+
+
+def record_row(suite: str, row: dict, banner_platform: str = None,
+               log=None):
+    """Print ``row`` as one JSON line iff it passes ``gate_row``;
+    otherwise print a loud rejection row so the failure lands IN the log
+    instead of being silently recorded as data.  Returns True iff the
+    row was recorded."""
+    if log is None:
+        log = lambda s: print(s, flush=True)
+    ok, reason = gate_row(suite, row, banner_platform)
+    if ok:
+        log(json.dumps(dict({"suite": suite}, **row)))
+    else:
+        log(json.dumps({"suite": suite, "name": row.get("name"),
+                        "rejected": reason,
+                        "platform": row.get("platform")}))
+    return ok
 
 
 LAST_TPU_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -161,8 +244,9 @@ def _time_marginal(make_chain, args, n1: int, n2: int, reps: int):
     A marginal that is not clearly positive means the measurement is
     NOISE (a contended host can inflate the short-chain total past the
     long one — observed 2026-07-31: blas rows claiming 0.0 s/call and
-    1e11 "GFLOPS" while another process shared the chip).  One re-measure
-    of the long chain is attempted; if the marginal is still
+    1e11 "GFLOPS" while another process shared the chip).  On a
+    degenerate marginal BOTH chains are re-measured, keeping the min of
+    each (the consistent estimator); if the marginal is still
     indistinguishable from zero the result is NaN so no caller can
     mistake it for a throughput."""
     import jax.numpy as jnp
@@ -254,6 +338,20 @@ def main():
 
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
+
+    # banner honesty: the probe's platform answer and THIS process's
+    # backend can disagree (the tunnel drops between probe and init, and
+    # jax then falls back to CPU silently).  A CPU measurement must never
+    # be recorded under a TPU banner — re-derive the platform from the
+    # process that actually runs the timings.
+    actual = jax.default_backend()
+    if platform != actual:
+        _RECORD["platform_note"] = (
+            f"probe reported {platform!r} but the benchmark process "
+            f"initialised {actual!r}; recording under the actual platform")
+        print(json.dumps({"error": _RECORD["platform_note"]}), flush=True)
+        platform = actual
+        _RECORD["platform"] = platform
 
     from quda_tpu.ops import wilson as wops
     from quda_tpu.ops import wilson_packed as wpk
@@ -361,9 +459,15 @@ def main():
     def run_path(name, fn, args):
         try:
             s, _ = _time_marginal(chain_of(fn), args, n1, n2, reps)
+            ok, reason = gate_row("dslash", {
+                "name": name, "secs_per_call": s,
+                "gflops": flops / s / 1e9 if s and s > 0 else float("nan"),
+                "platform": platform})
             if not (s > 0):              # NaN marginal — noise, not data
                 paths[name + "_error"] = ("non-positive marginal "
                                           "(contended host?)")
+            elif not ok:                 # roofline-gated: impossible rate
+                paths[name + "_error"] = reason
             else:
                 secs[name] = s
                 paths[name] = round(flops / s / 1e9, 1)
@@ -515,9 +619,15 @@ def main():
         try:
             s, _ = _time_marginal(make_canon, (gauge_d, psi_d), n1, n2,
                                   reps)
+            ok, reason = gate_row("dslash", {
+                "name": "xla_canonical", "secs_per_call": s,
+                "gflops": flops / s / 1e9 if s and s > 0 else float("nan"),
+                "platform": platform})
             if not (s > 0):          # NaN marginal — noise, not data
                 paths["xla_canonical_error"] = ("non-positive marginal "
                                                 "(contended host?)")
+            elif not ok:
+                paths["xla_canonical_error"] = reason
             else:
                 secs["xla_canonical"] = s
                 paths["xla_canonical"] = round(flops / s / 1e9, 1)

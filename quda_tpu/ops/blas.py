@@ -122,8 +122,22 @@ def axpy_zpbx(a, p, x, r, b):
     return x + a * p, r + b * p
 
 
+def axpy_norm2(a, x, y):
+    """y <- y + a x; return (y, |y|^2) (blas::axpyNorm2).
+
+    Under jit XLA fuses the update with the reduction into one traversal;
+    the explicit single-VMEM-pass pallas version lives in
+    ops/blas_pallas.py (reference include/kernels/reduce_core.cuh:668).
+    """
+    out = y + a * x
+    return out, norm2(out)
+
+
 def triple_cg_update(a, p, Ap, x, r):
-    """x += a p; r -= a Ap; return |r|^2 (blas::axpyNorm-style fused)."""
+    """x += a p; r -= a Ap; return (x, r, |r|^2) — the fused CG-iteration
+    tail (blas::axpyNorm-style): both updates and the residual reduction
+    share one traversal under jit.  Single-pass pallas form:
+    ops/blas_pallas.cg_update_norm2_pallas."""
     xn = x + a * p
     rn = r - a * Ap
     return xn, rn, norm2(rn)

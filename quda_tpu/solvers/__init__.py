@@ -5,6 +5,7 @@ resolves QUDA's QudaInverterType names onto them.
 """
 
 from .cg import cg, cg_fixed_iters, SolverResult  # noqa: F401
+from .fused_iter import fused_cg  # noqa: F401
 from .cg3 import cg3, cgne, cgnr  # noqa: F401
 from .bicgstab import bicgstab, bicgstab_l  # noqa: F401
 from .gcr import gcr, mr, mr_fixed, sd  # noqa: F401

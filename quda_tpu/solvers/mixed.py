@@ -35,39 +35,67 @@ class StorageCodec(NamedTuple):
     ``down``/``up`` convert between the precise representation (complex
     array) and the sloppy storage; ``norm2``/``redot`` reduce in storage;
     ``axpy(a, x, y) = y + a*x`` for REAL scalar a, computed at f32 and
-    rounded back to storage.  Two instances cover the TPU ladder:
-    a plain dtype cast (single sloppy) and bf16/int8 pair storage
-    (half/quarter — see ops/pair.py).
+    rounded back to storage; ``axpy_norm2(a, x, y) = (y + a*x, |..|^2)``
+    is the fused update+reduce tail (one traversal — the
+    reduce_core.cuh:668 axpyNorm2 analog; optionally the single-pass
+    pallas kernel, ops/blas_pallas.py).  Two instances cover the TPU
+    ladder: a plain dtype cast (single sloppy) and bf16/int8 pair
+    storage (half/quarter — see ops/pair.py).
     """
     down: Callable
     up: Callable
     norm2: Callable
     redot: Callable
     axpy: Callable
+    axpy_norm2: Optional[Callable] = None
 
 
 def dtype_codec(sloppy_dtype, precise_dtype) -> StorageCodec:
+    def _axpy_norm2(a, x, y):
+        return blas.axpy_norm2(a.astype(sloppy_dtype), x, y)
     return StorageCodec(
         down=lambda x: x.astype(sloppy_dtype),
         up=lambda x: x.astype(precise_dtype),
         norm2=blas.norm2,
         redot=blas.redot,
-        axpy=lambda a, x, y: y + a.astype(sloppy_dtype) * x)
+        axpy=lambda a, x, y: y + a.astype(sloppy_dtype) * x,
+        axpy_norm2=_axpy_norm2)
 
 
-def _make_pair_codec(down, up, store_dtype) -> StorageCodec:
+def _make_pair_codec(down, up, store_dtype, use_pallas_tail: bool = False,
+                     pallas_interpret: bool = False) -> StorageCodec:
     """Shared reductions/axpy for every pair-storage layout — ONE home
     for the f32-accumulate rounding policy the reliable updates rely on;
-    layouts differ only in their down/up converters."""
+    layouts differ only in their down/up converters.  With
+    ``use_pallas_tail`` the fused update+reduce runs as the single-pass
+    pallas kernel (the norm is taken on the ROUNDED stored value in both
+    forms, so the semantics match bit-for-bit up to the documented
+    block-accumulation order)."""
     from ..ops import pair as pops
     f32 = jnp.float32
+
+    def axpy(a, x, y):
+        return (y.astype(f32) + a.astype(f32) * x.astype(f32)
+                ).astype(store_dtype)
+
+    if use_pallas_tail:
+        from ..ops import blas_pallas as bpl
+
+        def axpy_norm2(a, x, y):
+            out, n2 = bpl.axpy_norm2_pallas(a, x, y,
+                                            interpret=pallas_interpret)
+            return out, n2
+    else:
+        def axpy_norm2(a, x, y):
+            out = axpy(a, x, y)
+            return out, pops.pair_norm2(out)
+
     return StorageCodec(
         down=down, up=up,
         norm2=pops.pair_norm2,
         redot=pops.pair_redot,
-        axpy=lambda a, x, y: (y.astype(f32)
-                              + a.astype(f32) * x.astype(f32)
-                              ).astype(store_dtype))
+        axpy=axpy,
+        axpy_norm2=axpy_norm2)
 
 
 def pair_codec(store_dtype, precise_dtype) -> StorageCodec:
@@ -86,15 +114,31 @@ def packed_pair_codec(store_dtype, precise_dtype) -> StorageCodec:
         lambda x: wpk.from_packed_pairs(x, precise_dtype), store_dtype)
 
 
-def pair_inplace_codec(store_dtype) -> StorageCodec:
+def pair_inplace_codec(store_dtype, use_pallas_tail: Optional[bool] = None,
+                       pallas_interpret: Optional[bool] = None
+                       ) -> StorageCodec:
     """Codec for when the PRECISE representation is itself an f32 pair
     array on the SAME layout as the sloppy storage — the fully
     complex-free solve path (TPU runtimes without complex64 execution;
     also the zero-conversion native-order path).  down/up are plain
-    dtype casts."""
+    dtype casts.  ``use_pallas_tail`` routes the fused update+reduce
+    through the single-pass pallas kernel (ops/blas_pallas.py);
+    ``None`` defers to QUDA_TPU_FUSED_TAIL so the env knob reaches the
+    reliable-update loops of the complex-free API solves too (a knob
+    silently doing nothing is the failure mode utils/config.py exists
+    to kill).  ``pallas_interpret=None`` resolves to interpret mode on
+    non-TPU backends."""
+    if use_pallas_tail is None:
+        from ..utils import config as qconf
+        use_pallas_tail = str(qconf.get("QUDA_TPU_FUSED_TAIL",
+                                        fresh=True)) == "1"
+    if pallas_interpret is None:
+        pallas_interpret = jax.default_backend() != "tpu"
     return _make_pair_codec(
         lambda x: x.astype(store_dtype),
-        lambda x: x.astype(jnp.float32), store_dtype)
+        lambda x: x.astype(jnp.float32), store_dtype,
+        use_pallas_tail=use_pallas_tail,
+        pallas_interpret=pallas_interpret)
 
 
 def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
@@ -132,8 +176,14 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
         pAp = codec.redot(c["p"], Ap).astype(rdt)
         alpha = c["r2_lo"] / jnp.maximum(pAp, jnp.finfo(rdt).tiny)
         x_lo = codec.axpy(alpha, c["p"], c["x_lo"])
-        r_lo = codec.axpy(-alpha, Ap, c["r_lo"])
-        r2_new = codec.norm2(r_lo).astype(rdt)
+        # fused residual update+reduce: one traversal (optionally the
+        # single-pass pallas kernel, see StorageCodec.axpy_norm2)
+        if codec.axpy_norm2 is not None:
+            r_lo, r2_new = codec.axpy_norm2(-alpha, Ap, c["r_lo"])
+            r2_new = r2_new.astype(rdt)
+        else:
+            r_lo = codec.axpy(-alpha, Ap, c["r_lo"])
+            r2_new = codec.norm2(r_lo).astype(rdt)
         beta = r2_new / c["r2_lo"]
         p = codec.axpy(beta, c["p"], r_lo)
         r2max = jnp.maximum(c["r2max"], r2_new)
@@ -222,8 +272,12 @@ def cg_reliable_df(op_df, matvec_lo: Callable, rhs_df, codec: StorageCodec,
         pAp = codec.redot(c["p"], Ap).astype(f32)
         alpha = c["r2_lo"] / jnp.maximum(pAp, jnp.finfo(f32).tiny)
         x_lo = codec.axpy(alpha, c["p"], c["x_lo"])
-        r_lo = codec.axpy(-alpha, Ap, c["r_lo"])
-        r2_new = codec.norm2(r_lo).astype(f32)
+        if codec.axpy_norm2 is not None:
+            r_lo, r2_new = codec.axpy_norm2(-alpha, Ap, c["r_lo"])
+            r2_new = r2_new.astype(f32)
+        else:
+            r_lo = codec.axpy(-alpha, Ap, c["r_lo"])
+            r2_new = codec.norm2(r_lo).astype(f32)
         beta = r2_new / c["r2_lo"]
         p = codec.axpy(beta, c["p"], r_lo)
         r2max = jnp.maximum(c["r2max"], r2_new)

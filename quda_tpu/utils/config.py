@@ -126,6 +126,24 @@ _register("QUDA_TPU_SLOPPY_PRECISION", "choice", "",
           reference="QudaInvertParam::cuda_prec_sloppy")
 
 # -- solvers ----------------------------------------------------------------
+_register("QUDA_TPU_CG_CHECK_EVERY", "int", 1,
+          "fused-iteration CG convergence-check cadence: the while_loop "
+          "body fuses this many CG iterations per convergence check, "
+          "amortising the cond branch and the heavy-quark reduction over "
+          "k dslash applies (solvers/fused_iter.py).  The solve reaches "
+          "the same final residual as cadence 1 but may run up to k-1 "
+          "iterations past convergence — and past maxiter, which is "
+          "also only checked at cadence boundaries",
+          reference="lib/inv_cg_quda.cpp per-iteration convergence check")
+_register("QUDA_TPU_FUSED_TAIL", "choice", "",
+          "route the CG tail (x += a p; r -= a Ap; |r|^2) through the "
+          "fused pallas update+reduce kernel (ops/blas_pallas.py): '1' "
+          "force, '0'/empty = the XLA-fused jnp path (measure on chip "
+          "before pinning).  Covers fused_cg/cg AND the reliable-update "
+          "loops of the complex-free pair routes (pair_inplace_codec); "
+          "complex-dtype solves always use the jnp path",
+          ("", "0", "1"),
+          reference="include/kernels/reduce_core.cuh:668 axpyNorm2")
 _register("QUDA_TPU_MAX_MULTI_RHS", "int", 32,
           "cap on simultaneously batched right-hand sides in block "
           "solvers", reference="QUDA_MAX_MULTI_RHS")
@@ -170,7 +188,11 @@ for _n, _k, _d, _doc in (
          "wall-clock budget: on expiry bench.py prints the best record "
          "accumulated so far and exits 0 (0 disables)"),
         ("QUDA_TPU_BENCH_SOLVER_L", "int", 16,
-         "solver-suite lattice extent")):
+         "solver-suite lattice extent"),
+        ("QUDA_TPU_BENCH_SOLVER_L_CHIP", "int", 24,
+         "chip-sized solver-suite lattice for the TPU-only end-to-end "
+         "rows (pallas-in-solver CG, multishift, bf16-reliable); "
+         "0 disables them")):
     _register(_n, _k, _d, _doc, reference="tests/ benchmark CLI flags")
 
 _register("QUDA_TPU_FORCE_CPU", "bool", False,

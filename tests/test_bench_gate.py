@@ -1,0 +1,96 @@
+"""Bench-harness noise rejection (bench.gate_row / bench.record_row).
+
+Feeds the gate the EXACT round-5 failure modes recorded in
+measurements_tpu.log — ``secs=0.0``, 1.27e11 "GFLOPS", 31.8 TB/s — and
+asserts each is rejected and logged, plus the platform-banner rule: a
+CPU-platform row can never be recorded under a TPU banner (the round-5
+mg-suite silent-fallback failure).
+"""
+
+import json
+
+import bench
+
+
+def test_round5_zero_secs_row_rejected():
+    # measurements_tpu.log: triple_update_norm2 at 0.0 s/call
+    row = {"name": "triple_update_norm2", "gflops": 1.27e11,
+           "gbps": 9.99e3, "secs_per_call": 0.0, "platform": "tpu"}
+    ok, reason = bench.gate_row("blas", row, banner_platform="tpu")
+    assert not ok
+    assert "secs" in reason
+
+
+def test_round5_impossible_gflops_rejected():
+    # 1.27e11 GFLOPS = 127,000 TFLOPS — even with a plausible-looking
+    # time the rate itself must die at the roofline bound
+    row = {"name": "triple_update_norm2", "gflops": 1.27e11,
+           "secs_per_call": 1e-4, "platform": "tpu"}
+    ok, reason = bench.gate_row("blas", row, banner_platform="tpu")
+    assert not ok
+    assert "roofline" in reason
+
+
+def test_round5_impossible_gbps_rejected():
+    # xpay_redot "measured" 31.8 TB/s; the VMEM-resident ceiling is
+    # <= 23 TB/s (PERF.md), so the blas bound sits at 25 TB/s
+    row = {"name": "xpay_redot", "gflops": 50.0, "gbps": 31.8e3,
+           "secs_per_call": 1e-4, "platform": "tpu"}
+    ok, reason = bench.gate_row("blas", row, banner_platform="tpu")
+    assert not ok
+    assert "gbps" in reason and "roofline" in reason
+
+
+def test_nan_and_negative_throughput_rejected():
+    for bad in (float("nan"), float("inf"), -5.0):
+        row = {"name": "x", "gflops": bad, "secs_per_call": 1e-4,
+               "platform": "tpu"}
+        ok, _ = bench.gate_row("dslash", row, banner_platform="tpu")
+        assert not ok, bad
+
+
+def test_cpu_row_refused_under_tpu_banner():
+    # an otherwise-honest CPU measurement must not appear under a TPU
+    # banner (probe said tpu, process fell back to cpu)
+    row = {"name": "cg_wilson_pc_f32pairs", "iters": 14, "secs": 0.5,
+           "gflops": 89.3, "converged": True, "platform": "cpu"}
+    ok, reason = bench.gate_row("solver", row, banner_platform="tpu")
+    assert not ok
+    assert "platform" in reason
+    # the same row under its own (cpu) banner is fine
+    ok2, _ = bench.gate_row("solver", row, banner_platform="cpu")
+    assert ok2
+
+
+def test_honest_chip_rows_pass():
+    # the real round-5 headline numbers must NOT be rejected
+    dslash = {"name": "wilson_pallas_packed", "gflops": 5673.0,
+              "gbps": 4800.0, "secs_per_call": 7.7e-5,
+              "platform": "tpu"}
+    ok, reason = bench.gate_row("dslash", dslash, banner_platform="tpu")
+    assert ok, reason
+    solver = {"name": "cg_wilson_pc_f32pairs_pallas_24", "iters": 200,
+              "secs": 0.8, "gflops": 2500.0, "converged": True,
+              "platform": "tpu"}
+    ok, reason = bench.gate_row("solver", solver, banner_platform="tpu")
+    assert ok, reason
+
+
+def test_record_row_rejects_loudly_and_accepts_quietly():
+    lines = []
+    bad = {"name": "triple_update_norm2", "gflops": 1.27e11,
+           "secs_per_call": 0.0, "platform": "tpu"}
+    assert not bench.record_row("blas", bad, banner_platform="tpu",
+                                log=lines.append)
+    assert len(lines) == 1
+    logged = json.loads(lines[0])
+    assert "rejected" in logged            # the failure is IN the log
+    assert logged["name"] == "triple_update_norm2"
+
+    good = {"name": "axpy_norm2", "gflops": 900.0, "gbps": 1300.0,
+            "secs_per_call": 3e-4, "platform": "tpu"}
+    assert bench.record_row("blas", good, banner_platform="tpu",
+                            log=lines.append)
+    rec = json.loads(lines[1])
+    assert rec["suite"] == "blas" and rec["gflops"] == 900.0
+    assert "rejected" not in rec
