@@ -600,6 +600,48 @@ def main():
                  lambda g, v: wpk.dslash_packed_pairs(g, v, X, Y,
                                                       out_dtype=jnp.bfloat16),
                  (g_bf, p_bf))
+        # multi-RHS amortization (the round-7 tentpole): 8 RHS streamed
+        # through one gauge-tile fetch per (t, z-block).  NOT headline-
+        # eligible (the headline is per-application single-RHS); the
+        # aggregate and per-RHS rates land in "paths" through the same
+        # roofline gate.  Gate: lane 0 of the batch must BIT-match the
+        # single-RHS v2 kernel (same kernel body by construction).
+        try:
+            p8 = jnp.stack([jnp.roll(p_d, i, axis=-1) for i in range(8)])
+            p8.block_until_ready()
+
+            @jax.jit
+            def _gate_mrhs(g, pb):
+                a = wpp.dslash_pallas_packed_mrhs(g, pb, X, gauge_bw=gbw)
+                b = wpp.dslash_pallas_packed(g, pb[0], X, gauge_bw=gbw)
+                return (jnp.max(jnp.abs(a[0] - b)), jnp.max(jnp.abs(b)))
+            dm, mm = _gate_mrhs(g_d, p8)
+            mrhs_rel = _fetch(dm) / _fetch(mm)
+            if mrhs_rel < 1e-6:
+                s8, _ = _time_marginal(
+                    chain_of(lambda g, v: wpp.dslash_pallas_packed_mrhs(
+                        g, v, X, gauge_bw=gbw)), (g_d, p8), n1, n2, reps)
+                row = {"name": "pallas_mrhs_n8", "secs_per_call": s8,
+                       "gflops": (8 * flops / s8 / 1e9
+                                  if s8 and s8 > 0 else float("nan")),
+                       "platform": platform}
+                ok, reason = gate_row("dslash", row)
+                if not (s8 > 0):
+                    paths["pallas_mrhs_n8_error"] = (
+                        "non-positive marginal (contended host?)")
+                elif not ok:
+                    paths["pallas_mrhs_n8_error"] = reason
+                else:
+                    paths["pallas_mrhs_n8"] = round(8 * flops / s8 / 1e9,
+                                                    1)
+                    paths["pallas_mrhs_n8_per_rhs"] = round(
+                        flops / s8 / 1e9, 1)
+            else:
+                paths["pallas_mrhs_n8_error"] = (
+                    f"gate failed: rel err {mrhs_rel:.3e}")
+        except Exception as e:
+            paths["pallas_mrhs_n8_error"] = str(e)[:160]
+        _refresh_headline()
 
     if complex_ok or platform == "cpu":
         gauge_d = jax.device_put(jnp.asarray(gauge))

@@ -255,6 +255,35 @@ def main(argv):
                      g, p, X, gauge_bw=gbw),
                  (g_bf,), p_pairs.astype(jnp.bfloat16), 1320,
                  (gauge_bytes + 2 * spinor_bytes) // 2))
+            # bf16 bz=Z escape (PERF.md round-5 queued lever): a bz=8
+            # block is HALF a bf16 (16,128) tile, so bf16 loads ran at
+            # 50% utilisation and measured SLOWER than f32.  Blocking
+            # the whole Z axis fills the tile (24 -> pad 32, 75%); the
+            # ~11.3 MB single-buffer working set is what
+            # QUDA_TPU_PALLAS_VMEM_MB=12 admits in production
+            # (block_z is pinned explicitly here so the row cannot be
+            # served by the earlier bz-auto compile cache entry)
+            cases.append(
+                ("wilson_pallas_bf16_bzfull",
+                 lambda g, p, gbw=gbw_bf: wpp.dslash_pallas_packed(
+                     g, p, X, gauge_bw=gbw, block_z=Z),
+                 (g_bf,), p_pairs.astype(jnp.bfloat16), 1320,
+                 (gauge_bytes + 2 * spinor_bytes) // 2))
+            # multi-RHS packed-pairs rows: gauge tile loaded once per
+            # (t, z-block), N spinor tiles streamed through it.  The
+            # amortization curve (N=1 -> 8) is the round-7 tentpole
+            # measurement: per-RHS traffic model 576 + 576/N B/site,
+            # so ~1.7x aggregate at N=8 if the HBM bound holds.
+            for nrhs in (1, 4, 8):
+                p_b = jnp.stack([jnp.roll(p_pairs, i, axis=-1)
+                                 for i in range(nrhs)])
+                p_b.block_until_ready()
+                cases.append(
+                    (f"wilson_pallas_mrhs_n{nrhs}",
+                     lambda g, p, gbw=gbw: wpp.dslash_pallas_packed_mrhs(
+                         g, p, X, gauge_bw=gbw),
+                     (g_pairs,), p_b, 1320 * nrhs,
+                     gauge_bytes + nrhs * 2 * spinor_bytes))
             # improved staggered (fat + Naik): the second headline family
             # on its pallas kernel; links reuse the wilson pair gauge
             # draws (phases are folded upstream in real use)
@@ -591,6 +620,29 @@ def main(argv):
                            mv24, mv24_bf, b, tol=1e-6, maxiter=600,
                            codec=codec24)),
                        rhs24, fl_iter_c, Lc, fused_tail="pallas")
+            # batched multi-RHS solve (the invert_multi_src_quda hot
+            # loop): 8 RHS through the MRHS pallas eo stencil — per
+            # iteration ONE batched MdagM whose gauge tiles are read
+            # once for all 8 sources.  iters/gflops report the executed
+            # work: all lanes run until the slowest converges.
+            from quda_tpu.solvers.block import batched_cg_pairs
+            from quda_tpu.solvers.cg import SolverResult
+            nrhs_c = 8
+            rhs24_b = jnp.stack([jnp.roll(rhs24, i, axis=-1)
+                                 for i in range(nrhs_c)])
+            rhs24_b.block_until_ready()
+            mv24_mrhs = op24.MdagM_pairs_mrhs
+
+            def _batched_solve(b):
+                r = batched_cg_pairs(mv24_mrhs, b, tol=1e-6,
+                                     maxiter=600)
+                return SolverResult(r.x, jnp.max(r.iters),
+                                    jnp.max(r.r2),
+                                    jnp.all(r.converged))
+
+            solver_row("batched_cg_wilson_pc_f32pairs_mrhs8_24",
+                       jax.jit(_batched_solve), rhs24_b,
+                       nrhs_c * fl_iter_c, Lc, nrhs=nrhs_c)
 
     if "gauge" in suites:
         # complex-free gauge/HMC sector (pair representation — the only

@@ -106,6 +106,12 @@ class InvertParam:
     iter_count: int = 0
     secs: float = 0.0
     gflops: float = 0.0
+    # multi-source results (invert_multi_src_quda): per-RHS true
+    # residuals and per-RHS iteration counts (QUDA's per-source
+    # true_res[] array on QudaInvertParam); iter_count/gflops then hold
+    # the per-RHS sums with the volume/2 PC flop convention
+    true_res_multi: Sequence[float] = ()
+    iter_count_multi: Sequence[int] = ()
 
     def validate(self):
         _check(self.dslash_type in DSLASH_TYPES,

@@ -170,6 +170,37 @@ class _PairSloppyBase:
     def MdagM_pairs(self, x):
         return self.Mdag_pairs(self.M_pairs(x))
 
+    # -- multi-RHS (leading batch axis) forms --------------------------
+    # One home for the batched Schur composition so the MRHS solve path
+    # (solvers/block.py, invert_multi_src_quda) cannot diverge from the
+    # single-RHS math.  ``_d_to_mrhs`` defaults to a vmap of the
+    # single-RHS stencil; representations with a hand-tuned batched
+    # kernel (the packed pallas v2 hop) override it.
+
+    def _d_to_mrhs(self, psi_b, target_parity, out_dtype):
+        return jax.vmap(
+            lambda p: self._d_to(p, target_parity, out_dtype))(psi_b)
+
+    def _g5_pairs_mrhs(self, x):
+        # vmap over the batch axis reuses _g5_pairs verbatim (each
+        # per-example view has the single-RHS ndim), so the gamma-5
+        # sign logic exists exactly once
+        return jax.vmap(self._g5_pairs)(x)
+
+    def M_pairs_mrhs(self, x):
+        p = self.matpc
+        tmp = self._d_to_mrhs(x, 1 - p, self.store_dtype)
+        dd = self._d_to_mrhs(tmp, p, jnp.float32)
+        out = x.astype(jnp.float32) - (self.kappa ** 2) * dd
+        return out.astype(self.store_dtype)
+
+    def Mdag_pairs_mrhs(self, x):
+        return self._g5_pairs_mrhs(
+            self.M_pairs_mrhs(self._g5_pairs_mrhs(x)))
+
+    def MdagM_pairs_mrhs(self, x):
+        return self.Mdag_pairs_mrhs(self.M_pairs_mrhs(x))
+
     # -- complex in/out path -------------------------------------------
     def M(self, x):
         return self._from_pairs(self.M_pairs(self._to_pairs(x)), x.dtype)
@@ -313,6 +344,22 @@ class _PackedHopMixin:
         return wpk.dslash_eo_packed_pairs(self.gauge_eo_pp, psi_pp,
                                           self.dims, target_parity,
                                           out_dtype=out_dtype)
+
+    def _d_to_mrhs(self, psi_b, target_parity, out_dtype):
+        """Batched packed eo hop: psi_b (N,4,3,2,T,Z,Y*Xh).  The v2
+        pallas path routes the MRHS kernel (one gauge-tile fetch per
+        (t, z-block), N spinor tiles streamed through it); everything
+        else falls back to the vmapped single-RHS stencil."""
+        if (self.use_pallas and getattr(self, "_mesh", None) is None
+                and self._pallas_version == 2):
+            from ..ops import wilson_pallas_packed as wpp
+            return wpp.dslash_eo_pallas_packed_mrhs(
+                self.gauge_eo_pp[target_parity],
+                self._u_bw[target_parity], psi_b, tuple(self.dims),
+                target_parity, interpret=self._pallas_interpret,
+                out_dtype=out_dtype)
+        return jax.vmap(
+            lambda p: self._d_to(p, target_parity, out_dtype))(psi_b)
 
     def _sharded_d_to(self, target_parity, out_dtype):
         """Memoized shard_map of the sharded eo pallas policy (a fresh
@@ -555,6 +602,39 @@ class DiracWilsonPCPackedSloppy(_PackedHopMixin, _PairSloppyBase):
         xq_pp = to_pp(b_q).astype(jnp.float32) + self.kappa * t
         x_p = _PackedHopMixin._from_pairs(self, x_pp, b_q.dtype)
         x_q = _PackedHopMixin._from_pairs(self, xq_pp, b_q.dtype)
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+    # -- multi-RHS boundary helpers (the invert_multi_src_quda route) --
+    def prepare_pairs_mrhs(self, b_even_b, b_odd_b):
+        """Batched canonical complex parity sources (N, T,Z,Y,Xh,4,3) ->
+        batched pair-form PC rhs (N,4,3,2,T,Z,Y*Xh): prepare_pairs with
+        the batched hop, so the MRHS stencil serves source preparation
+        too (gauge read once for all N)."""
+        from ..fields.geometry import EVEN
+        p = self.matpc
+        b_p, b_q = ((b_even_b, b_odd_b) if p == EVEN
+                    else (b_odd_b, b_even_b))
+        to_pp = jax.vmap(lambda x: _PackedHopMixin._to_pairs(self, x))
+        rhs = (to_pp(b_p).astype(jnp.float32)
+               + self.kappa * self._d_to_mrhs(to_pp(b_q), p,
+                                              jnp.float32))
+        return rhs
+
+    def solution_from_pairs_mrhs(self, x_b, dtype=jnp.complex64):
+        return jax.vmap(
+            lambda x: _PackedHopMixin._from_pairs(self, x, dtype))(x_b)
+
+    def reconstruct_pairs_mrhs(self, x_b, b_even_b, b_odd_b):
+        """Batched reconstruct_pairs: x_q = b_q + kappa D x_p with the
+        MRHS hop.  Returns canonical complex (even, odd) batches."""
+        from ..fields.geometry import EVEN
+        p = self.matpc
+        b_q = b_odd_b if p == EVEN else b_even_b
+        to_pp = jax.vmap(lambda x: _PackedHopMixin._to_pairs(self, x))
+        t = self._d_to_mrhs(x_b, 1 - p, jnp.float32)
+        xq_b = to_pp(b_q).astype(jnp.float32) + self.kappa * t
+        x_p = self.solution_from_pairs_mrhs(x_b, b_q.dtype)
+        x_q = self.solution_from_pairs_mrhs(xq_b, b_q.dtype)
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
 
 

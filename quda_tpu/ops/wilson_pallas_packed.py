@@ -400,6 +400,172 @@ def dslash_pallas_packed(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
     )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, gauge_pl, gauge_bw)
 
 
+# -- multi-RHS (MRHS) variants of the v2 kernels ---------------------------
+#
+# Production workloads (propagator inversions, RHMC pseudofermions, MG
+# setup solves) apply the SAME gauge field to many right-hand sides; the
+# single-RHS v2 kernel re-reads 576 B/site of links per RHS — half its
+# ~1,152 B/site traffic (QUDA's multi-RHS batching motivation,
+# arXiv:1408.5925 §5 / the src_idx kernel dimension).  The MRHS form
+# keeps the v2 kernel body BIT-IDENTICAL per RHS and changes only the
+# pipeline: grid (T, Z/bz, N) with the RHS axis INNERMOST, psi/out
+# BlockSpecs carrying a leading size-1 RHS block, and gauge BlockSpecs
+# whose index map ignores n — consecutive grid steps then present the
+# same gauge block index, so the Mosaic pipeline keeps the tile resident
+# instead of re-fetching it, and N spinor tiles stream through one gauge
+# load.  Projected per-RHS traffic: psi 480 + out 96 + gauge 1152/(2N)
+# B/site -> ~648 B/site at N=8, ~1.7x per-RHS throughput if the HBM
+# bound holds (measure on chip: bench_suite MRHS rows).
+#
+# The per-step VMEM working set is UNCHANGED (one RHS's tiles + the two
+# gauge tiles), so _pick_bz and the z-block legality rules carry over
+# as-is.
+
+
+class _LeadAxisRef:
+    """Trace-time view of a pallas Ref whose block carries one extra
+    LEADING singleton axis (the RHS block of the MRHS kernels): indexing
+    is forwarded with a 0 prepended, so the single-RHS kernel body reads
+    and writes it unchanged (bit-identical math by construction)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    @property
+    def shape(self):
+        return self._ref.shape[1:]
+
+    @property
+    def dtype(self):
+        return self._ref.dtype
+
+    def __getitem__(self, idx):
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        return self._ref[(0,) + idx]
+
+    def __setitem__(self, idx, val):
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        self._ref[(0,) + idx] = val
+
+
+def _mrhs_wrap(kernel, n_psi: int = 5):
+    """Adapt a single-RHS kernel to MRHS blocks: the first ``n_psi`` refs
+    and the output ref carry a leading size-1 RHS axis; gauge refs pass
+    through untouched."""
+    def wrapped(*refs):
+        psi = [_LeadAxisRef(r) for r in refs[:n_psi]]
+        rest = list(refs[n_psi:-1])
+        out = _LeadAxisRef(refs[-1])
+        kernel(*psi, *rest, out)
+    return wrapped
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("X", "interpret", "block_z"))
+def dslash_pallas_packed_mrhs(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
+                              X: int, interpret: bool = False,
+                              block_z: int | None = None,
+                              gauge_bw: jnp.ndarray | None = None
+                              ) -> jnp.ndarray:
+    """Multi-RHS Wilson hop sum on pallas-layout pair arrays.
+
+    gauge_pl: (4,3,3,2,T,Z,YX); psi_pl: (N,4,3,2,T,Z,YX) — a leading
+    RHS axis over the ``dslash_pallas_packed`` layout.  Returns the same
+    batched layout.  Per-RHS results bit-match the single-RHS v2 kernel
+    (same kernel body per grid step); the gauge tiles are loaded once
+    per (t, z-block) and amortised over all N RHS by grid ordering.
+    """
+    from jax.experimental import pallas as pl
+
+    N, _, _, _, T, Z, YX = psi_pl.shape
+    bz = block_z if block_z is not None else _pick_bz(Z, YX, psi_pl.dtype)
+    if Z % bz != 0:
+        raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    nzb = Z // bz
+    if gauge_bw is None:
+        gauge_bw = backward_gauge(gauge_pl, X)
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (1, 4, 3, 2, 1, bz, YX),
+            lambda t, zb, n, dt=dt, dz=dz: (n, 0, 0, 0, (t + dt) % T,
+                                            (zb + dz) % nzb, 0))
+
+    # gauge index maps ignore n: the block index repeats across the
+    # innermost RHS loop, so the pipeline re-uses the resident tile
+    gauge_spec = pl.BlockSpec(
+        (4, 3, 3, 2, 1, bz, YX), lambda t, zb, n: (0, 0, 0, 0, t, zb, 0))
+
+    kernel = _mrhs_wrap(_make_kernel(X, bz))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(T, nzb, N),
+        in_specs=[psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
+                  psi_spec(0, +1), psi_spec(0, -1), gauge_spec,
+                  gauge_spec],
+        out_specs=pl.BlockSpec((1, 4, 3, 2, 1, bz, YX),
+                               lambda t, zb, n: (n, 0, 0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape, psi_pl.dtype),
+        interpret=interpret,
+    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, gauge_pl, gauge_bw)
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "target_parity",
+                                             "interpret", "block_z",
+                                             "out_dtype"))
+def dslash_eo_pallas_packed_mrhs(u_here_pl: jnp.ndarray,
+                                 u_bw_pl: jnp.ndarray,
+                                 psi_pl: jnp.ndarray, dims,
+                                 target_parity: int,
+                                 interpret: bool = False,
+                                 block_z: int | None = None,
+                                 out_dtype=None) -> jnp.ndarray:
+    """Multi-RHS checkerboarded Wilson hop — the batched-solver hot path
+    (``dslash_eo_pallas_packed`` with a leading RHS axis on psi).
+
+    u_here_pl/u_bw_pl as in the single-RHS eo kernel; psi_pl:
+    (N,4,3,2,T,Z,Y*Xh) of parity 1-p.  Gauge tiles are fetched once per
+    (t, z-block) and shared by all N RHS (RHS-innermost grid)."""
+    from jax.experimental import pallas as pl
+
+    T, Z, Y, X = dims
+    Xh = X // 2
+    N = psi_pl.shape[0]
+    YXh = psi_pl.shape[-1]
+    bz = block_z if block_z is not None else _pick_bz(Z, YXh, psi_pl.dtype)
+    if Z % bz != 0:
+        raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    nzb = Z // bz
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (1, 4, 3, 2, 1, bz, YXh),
+            lambda t, zb, n, dt=dt, dz=dz: (n, 0, 0, 0, (t + dt) % T,
+                                            (zb + dz) % nzb, 0))
+
+    gauge_spec = pl.BlockSpec(
+        (4, 3, 3, 2, 1, bz, YXh),
+        lambda t, zb, n: (0, 0, 0, 0, t, zb, 0))
+
+    kernel = _mrhs_wrap(_make_kernel(X, bz, eo=(target_parity, Xh)))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(T, nzb, N),
+        in_specs=[psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
+                  psi_spec(0, +1), psi_spec(0, -1), gauge_spec,
+                  gauge_spec],
+        out_specs=pl.BlockSpec((1, 4, 3, 2, 1, bz, YXh),
+                               lambda t, zb, n: (n, 0, 0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape,
+                                       out_dtype or psi_pl.dtype),
+        interpret=interpret,
+    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, u_here_pl, u_bw_pl)
+
+
 # -- v3: scatter-form backward hops (no backward-gauge copy) ----------------
 #
 # The v2 kernel above reads 1152 B/site: psi five times (center + two full

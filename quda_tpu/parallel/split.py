@@ -23,6 +23,30 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import SRC_AXIS, gauge_pspec, make_lattice_mesh, spinor_pspec
 
 
+def auto_split_mesh(n_src: int, devices=None):
+    """Mesh for split-grid multi-source solving, or None when batching
+    on one device is the better route.
+
+    The QUDA analog decides the sub-grid count from the rank grid
+    (callMultiSrcQuda's split_key); here the decision is by mesh size:
+    with a single device there is nothing to split (the batched MRHS
+    pipeline amortises gauge reads instead), and with D devices the
+    largest divisor of n_src that is <= D becomes the src axis so every
+    sub-grid gets an equal share of the sources.  The lattice axes stay
+    unsplit (each sub-grid holds the full replicated gauge — QUDA's
+    split_field semantics, include/split_grid.h:18)."""
+    devs = list(devices if devices is not None else jax.devices())
+    if len(devs) < 2 or n_src < 2:
+        return None
+    n = min(len(devs), n_src)
+    while n > 1 and n_src % n:
+        n -= 1
+    if n < 2:
+        return None
+    return make_lattice_mesh(grid=(1, 1, 1, 1), n_src=n,
+                             devices=devs[:n])
+
+
 def split_grid_solve(solve_one: Callable, gauge, B: jnp.ndarray,
                      mesh: Mesh):
     """Run `solve_one(gauge, b) -> x` for a batch B of sources, with the

@@ -14,7 +14,7 @@ fly — communication-optimal for small nrhs.
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -93,6 +93,168 @@ def block_cg(matvec: Callable, B: jnp.ndarray, tol: float = 1e-10,
         P = R + jnp.einsum("ij,i...->j...", beta, P)
         return dict(X=X, R=R, P=P,
                     r2=jnp.real(jnp.einsum("...ii->...i", rr_new[None]))[0],
+                    k=c["k"] + 1)
+
+    state = dict(X=X, R=R, P=P, r2=b2, k=jnp.int32(0))
+    out = jax.lax.while_loop(cond, body, state)
+    return BlockCGResult(out["X"], out["k"], out["r2"],
+                         out["r2"] <= stop)
+
+
+# ---------------------------------------------------------------------------
+# Pair-form (complex-free) multi-RHS solvers — the packed MRHS pipeline
+# ---------------------------------------------------------------------------
+#
+# The batched invert path (interfaces/quda_api.invert_multi_src_quda)
+# keeps every Krylov iterate on packed PAIR arrays (N, 4, 3, 2, T, Z,
+# Y*Xh) so the MRHS pallas eo stencil runs INSIDE the compiled batch
+# solve.  CG coefficients on the (realified) Hermitian normal operator
+# are real, so the pair representation is exact — the same argument as
+# the single-RHS pair routes.  Both solvers take a matvec over the FULL
+# batch (models/wilson.MdagM_pairs_mrhs or any (N, ...) -> (N, ...)
+# callable), not a per-RHS matvec: batching the stencil is the whole
+# point (one gauge fetch amortised over N).
+
+
+class BatchedCGResult(NamedTuple):
+    x: jnp.ndarray
+    iters: jnp.ndarray       # (nrhs,) iterations to convergence per RHS
+    r2: jnp.ndarray          # (nrhs,) final |r|^2
+    converged: jnp.ndarray   # (nrhs,)
+
+
+def _per_rhs_dot(u: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """(N,) real per-RHS inner products — one fused traversal."""
+    n = u.shape[0]
+    return jnp.sum((u * v).reshape(n, -1), axis=1)
+
+
+def _bcast(s: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
+    """(N,) scalars broadcast over the per-RHS field axes."""
+    return s.reshape((s.shape[0],) + (1,) * (like.ndim - 1))
+
+
+def batched_cg_pairs(matvec_batch: Callable, B: jnp.ndarray,
+                     tol: float = 1e-10, maxiter: int = 1000,
+                     check_every: Optional[int] = None
+                     ) -> BatchedCGResult:
+    """Batched CG on pair arrays with the fused-iteration tail.
+
+    Independent CG recurrences in (N,)-vector scalar lanes — each RHS
+    follows EXACTLY the trajectory of a solo fused_cg solve — but every
+    iteration issues ONE batched matvec, so the MRHS stencil amortises
+    the gauge reads.  The fused tail (x += a p; r -= a Ap; per-RHS
+    |r|^2 in one traversal) and the ``check_every`` convergence-check
+    cadence mirror solvers/fused_iter.py; the loop runs until ALL RHS
+    converge (converged lanes keep iterating harmlessly, like
+    batched_cg's vmap), and ``iters`` records each RHS's first cadence
+    boundary at convergence (unconverged lanes report the total).
+    """
+    from .fused_iter import _resolve_check_every
+    n = B.shape[0]
+    _check_nrhs(n)
+    check_every = _resolve_check_every(check_every)
+    rdt = jnp.float32 if B.dtype == jnp.bfloat16 else B.dtype
+    b2 = _per_rhs_dot(B.astype(rdt), B.astype(rdt))
+    stop = (tol ** 2) * b2
+    tiny = jnp.asarray(jnp.finfo(rdt).tiny, rdt)
+
+    x = jnp.zeros_like(B)
+    r = B
+    p = B
+    rz = b2
+
+    def one_iter(x, r, p, rz):
+        Ap = matvec_batch(p)
+        pAp = _per_rhs_dot(p.astype(rdt), Ap.astype(rdt))
+        alpha = rz / jnp.maximum(pAp, tiny)
+        a = _bcast(alpha, x).astype(x.dtype)
+        x = x + a * p
+        r = r - a * Ap
+        r2 = _per_rhs_dot(r.astype(rdt), r.astype(rdt))
+        beta = r2 / jnp.maximum(rz, tiny)
+        p = r + _bcast(beta, p).astype(p.dtype) * p
+        return x, r, p, r2
+
+    def cond(carry):
+        x, r, p, rz, k, it_conv = carry
+        return jnp.logical_and(jnp.any(rz > stop), k < maxiter)
+
+    def body(carry):
+        x, r, p, rz, k, it_conv = carry
+        for _ in range(check_every):
+            x, r, p, rz = one_iter(x, r, p, rz)
+        k = k + check_every
+        it_conv = jnp.where((it_conv < 0) & (rz <= stop), k, it_conv)
+        return (x, r, p, rz, k, it_conv)
+
+    it_conv0 = jnp.full((n,), -1, jnp.int32)
+    x, r, p, rz, k, it_conv = jax.lax.while_loop(
+        cond, body, (x, r, p, rz, jnp.int32(0), it_conv0))
+    it_conv = jnp.where(it_conv < 0, k, it_conv)
+    return BatchedCGResult(x, it_conv, rz, rz <= stop)
+
+
+def block_cg_pairs(matvec_batch: Callable, B: jnp.ndarray,
+                   tol: float = 1e-10, maxiter: int = 1000
+                   ) -> BlockCGResult:
+    """Block CG (O'Leary) on pair arrays: one shared Krylov space.
+
+    The realified Hermitian system is real SPD, so block CG runs in
+    PURE real arithmetic: the (nrhs x nrhs) Gram matrices are real
+    matmuls over the flattened site axis — exactly the MXU-friendly
+    shape (QUDA's multi_reduce blocks, lib/multi_reduce_quda.cu).  RHS
+    sharing spectral content converge in fewer iterations than the
+    independent-lane batched solve; the iteration count is shared
+    (one Krylov space).
+
+    Breakdown: linearly DEPENDENT sources (e.g. duplicates) make the
+    Gram matrices singular — the classic block-CG breakdown, which
+    QUDA handles by deflating the block.  Here the loop stops as soon
+    as any residual norm goes non-finite and reports those lanes
+    unconverged (never garbage-as-success); dedupe the batch or use
+    batched_cg_pairs (independent lanes are immune) for such inputs.
+    """
+    n = B.shape[0]
+    _check_nrhs(n)
+    rdt = jnp.float32 if B.dtype == jnp.bfloat16 else B.dtype
+    b2 = _per_rhs_dot(B.astype(rdt), B.astype(rdt))
+    stop = (tol ** 2) * b2
+
+    def gram(U, V):
+        # real (N, D) @ (D, N) matmul — the MXU shape
+        return jnp.matmul(U.reshape(n, -1).astype(rdt),
+                          V.reshape(n, -1).astype(rdt).T)
+
+    def comb(M, U):
+        # X_j <- sum_i M[i, j] U_i over the flattened site axis
+        return jnp.matmul(M.T.astype(rdt),
+                          U.reshape(n, -1).astype(rdt)).reshape(U.shape)
+
+    X = jnp.zeros_like(B)
+    R = B
+    P = B
+
+    def cond(c):
+        # the finiteness guard turns a Gram-breakdown NaN into a clean
+        # exit with converged=False instead of silent NaN solutions
+        return jnp.logical_and(
+            jnp.logical_and(jnp.any(c["r2"] > stop),
+                            jnp.all(jnp.isfinite(c["r2"]))),
+            c["k"] < maxiter)
+
+    def body(c):
+        X, R, P = c["X"], c["R"], c["P"]
+        AP = matvec_batch(P)
+        pap = gram(P, AP)
+        rr = gram(R, R)
+        alpha = jnp.linalg.solve(pap, gram(P, R))
+        X = X + comb(alpha, P)
+        R = R - comb(alpha, AP)
+        rr_new = gram(R, R)
+        beta = jnp.linalg.solve(rr, rr_new)
+        P = R + comb(beta, P)
+        return dict(X=X, R=R, P=P, r2=jnp.diagonal(rr_new),
                     k=c["k"] + 1)
 
     state = dict(X=X, R=R, P=P, r2=b2, k=jnp.int32(0))

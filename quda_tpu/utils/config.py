@@ -147,6 +147,23 @@ _register("QUDA_TPU_FUSED_TAIL", "choice", "",
 _register("QUDA_TPU_MAX_MULTI_RHS", "int", 32,
           "cap on simultaneously batched right-hand sides in block "
           "solvers", reference="QUDA_MAX_MULTI_RHS")
+_register("QUDA_TPU_MULTI_SRC_SPLIT", "choice", "",
+          "invert_multi_src_quda routing: '1' = force the split-grid "
+          "path (sources sharded over the mesh src axis, gauge "
+          "replicated), '0' = force the single-device batched MRHS "
+          "pipeline, empty = auto by mesh size (split when >1 device "
+          "divides the batch)",
+          ("", "0", "1"),
+          reference="callMultiSrcQuda split_key "
+                    "(lib/interface_quda.cpp:3064)")
+_register("QUDA_TPU_MULTI_SRC_BLOCK", "choice", "",
+          "batched multi-source solver: '1' = true block CG (shared "
+          "Krylov space, real Gram matmuls), empty/'0' = independent "
+          "per-RHS lanes (batched CG) — the default matches QUDA's "
+          "per-source multi-RHS solves",
+          ("", "0", "1"),
+          reference="QUDA block-CG solver family (inv_cg_quda.cpp "
+                    "block variants)")
 _register("QUDA_TPU_DETERMINISTIC_REDUCE", "bool", True,
           "accepted for compatibility: XLA reductions are deterministic "
           "per compiled executable already",

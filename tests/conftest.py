@@ -108,6 +108,39 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.mid)
 
 
+# -- slow-marker audit --------------------------------------------------------
+# Tier-1 runs `-m "not slow"` under a hard wall clock (ROADMAP); the
+# recurring budget leak is an interpret-mode pallas test (a ~20-60 s
+# interpreter compile per kernel shape) landing in the fast tier
+# unmarked.  Any non-slow test whose call phase exceeds the budget is
+# listed in the terminal summary so the next PR marks it — an audit
+# aid, not a failure.
+SLOW_AUDIT_BUDGET_S = float(os.environ.get("QUDA_TPU_TEST_SLOW_BUDGET_S",
+                                           "30"))
+_SLOW_AUDIT: list = []
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    import time
+    t0 = time.perf_counter()
+    yield
+    dt = time.perf_counter() - t0
+    if dt > SLOW_AUDIT_BUDGET_S and "slow" not in item.keywords:
+        _SLOW_AUDIT.append((item.nodeid, dt))
+
+
+def pytest_terminal_summary(terminalreporter):
+    if _SLOW_AUDIT:
+        terminalreporter.section("slow-marker audit")
+        terminalreporter.write_line(
+            f"non-slow tests over the {SLOW_AUDIT_BUDGET_S:.0f}s budget "
+            "(mark slow or shrink; tier-1 runs -m 'not slow' under a "
+            "hard timeout):")
+        for nodeid, dt in sorted(_SLOW_AUDIT, key=lambda x: -x[1]):
+            terminalreporter.write_line(f"  {dt:7.1f}s  {nodeid}")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)

@@ -25,6 +25,15 @@ from quda_tpu.solvers.cg import cg
 
 GEOM = LatticeGeometry((8, 8, 8, 8))
 
+# jax.shard_map (top-level, jax >= 0.6) is absent in the seed image's
+# jax 0.4.x — the same capability guard as test_pallas_sharded.py, so
+# tier-1 output stays clean and a red here means a real regression.
+needs_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="jax.shard_map not available in this jax version "
+           "(pre-existing environment limitation at seed)")
+
+
 
 @pytest.fixture(scope="module")
 def data():
@@ -63,6 +72,7 @@ def test_gspmd_dslash_matches_single_device(data):
     assert np.allclose(got, want, atol=1e-12)
 
 
+@needs_shard_map
 def test_shard_map_dslash_matches_single_device(data):
     """Explicit ppermute halo path == single-device result."""
     gauge, psi = data
@@ -108,6 +118,7 @@ def test_sharded_cg_converges(data):
     assert rel < 1e-7
 
 
+@needs_shard_map
 def test_psum_scalar_inside_shard_map(data):
     gauge, psi = data
     mesh = make_lattice_mesh()
@@ -226,6 +237,7 @@ def test_mg_vcycle_sharded_matches(data):
     assert np.allclose(got, want, atol=1e-10)
 
 
+@needs_shard_map
 def test_mg_vcycle_replicated_coarsest(data):
     """coarse_replicate=True (replicated collective-free bottom solves,
     the QUDA subset-communicator analog) still bit-matches."""
