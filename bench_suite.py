@@ -168,7 +168,25 @@ def main(argv):
     platform = actual
 
     suites = set(a for a in argv if not a.startswith("-")) or {
-        "blas", "dslash", "solver"}
+        "blas", "dslash", "solver", "sharded"}
+
+    def suite_guard(suite: str) -> bool:
+        """Window hygiene (VERDICT r7 #10): every suite re-checks the
+        backend it is ABOUT to measure on against the banner it records
+        under.  A tunnel death between suites silently drops jax to CPU
+        — the round-5 mg/gauge failure mode — so a mismatch emits a
+        loud SKIPPED row and the suite runs zero measurements (gate_row
+        would refuse the rows anyway; this says WHY, up front)."""
+        actual = jax.default_backend()
+        if actual == banner:
+            return True
+        print(json.dumps({
+            "suite": suite, "skipped": True,
+            "error": f"SKIPPED: backend is {actual!r} but the banner "
+                     f"is {banner!r} (platform fell back mid-run); "
+                     "no rows recorded for this suite",
+        }), flush=True)
+        return False
 
     from quda_tpu.fields.geometry import LatticeGeometry
     from quda_tpu.ops import wilson_packed as wpk
@@ -202,7 +220,7 @@ def main(argv):
     spinor_bytes = vol * 24 * 8          # c64-equivalent (f32 pairs)
     gauge_bytes = 4 * vol * 18 * 8
 
-    if "blas" in suites:
+    if "blas" in suites and suite_guard("blas"):
         # Fused update+reduce bundles — QUDA's actual hot BLAS shapes
         # (axpyNorm2, xpayDotzy-style, blas_test.cpp).  A bare elementwise
         # chain is NOT measurable under XLA: the compiler loop-interchanges
@@ -229,7 +247,7 @@ def main(argv):
             _emit("blas", name, secs, flops, bts, platform, lat,
                   banner=banner, bundle="update+reduce")
 
-    if "dslash" in suites:
+    if "dslash" in suites and suite_guard("dslash"):
         cases = [
             ("wilson_xla_pairs",
              lambda g, p: wpk.dslash_packed_pairs(g, p, X, Y),
@@ -352,7 +370,7 @@ def main(argv):
                 print(json.dumps({"suite": "dslash", "name": name,
                                   "error": str(e)[:140]}), flush=True)
 
-    if "solver" in suites:
+    if "solver" in suites and suite_guard("solver"):
         from quda_tpu.fields.spinor import even_odd_split
         from quda_tpu.models.wilson import DiracWilsonPC
         from quda_tpu.solvers.cg import cg
@@ -421,7 +439,9 @@ def main(argv):
 
         def solver_row(name, solve, b, fl_per_iter, lattice_l, **extra):
             """Time one solve and record it THROUGH the gate (platform
-            banner + roofline); failures print an error row."""
+            banner + roofline); failures print an error row.  Returns
+            the measured seconds (None on failure) so later rows can
+            quote cost ratios against this one."""
             try:
                 res, secs = time_solve(solve, b)
                 it = int(_fetch(res.iters))
@@ -433,9 +453,11 @@ def main(argv):
                     "converged": conv, "platform": platform,
                     "lattice": [lattice_l] * 4, **extra},
                     banner_platform=banner)
+                return secs
             except Exception as e:
                 print(json.dumps({"suite": "solver", "name": name,
                                   "error": str(e)[:140]}), flush=True)
+                return None
 
         solver_row("cg_wilson_pc_f32pairs",
                    jax.jit(lambda b: cg(mv_f32, b, tol=1e-6,
@@ -589,10 +611,10 @@ def main(argv):
 
             op24 = pairs_op(jnp.float32, use_pallas=True, dpk=dpk_c)
             mv24 = op24.MdagM_pairs
-            solver_row("cg_wilson_pc_f32pairs_pallas_24",
-                       jax.jit(lambda b: cg(mv24, b, tol=1e-6,
-                                            maxiter=600)),
-                       rhs24, fl_iter_c, Lc)
+            secs_f32_cg = solver_row(
+                "cg_wilson_pc_f32pairs_pallas_24",
+                jax.jit(lambda b: cg(mv24, b, tol=1e-6, maxiter=600)),
+                rhs24, fl_iter_c, Lc)
             # the fused-iteration pipeline: check cadence 10 + the
             # single-pass pallas update+reduce tail
             solver_row("cg_wilson_pc_f32pairs_pallas_fused_24",
@@ -644,7 +666,197 @@ def main(argv):
                        jax.jit(_batched_solve), rhs24_b,
                        nrhs_c * fl_iter_c, Lc, nrhs=nrhs_c)
 
-    if "gauge" in suites:
+            # --- df64 chip rows (VERDICT r7 #6): the 1e-10 contract's
+            # first hardware evidence.  (a) the df64 MdagM apply next to
+            # the f32 apply at identical NOMINAL flop accounting, so the
+            # extended-precision arithmetic overhead is one division;
+            # (b) the df64-reliable CG (deep tolerance) with its cost
+            # ratio vs the plain f32 CG row above.
+            try:
+                from quda_tpu.ops import df64 as dfm
+                from quda_tpu.ops import wilson_df64 as wdf
+                from quda_tpu.solvers.mixed import (cg_reliable_df,
+                                                    pair_inplace_codec)
+                with jax.default_device(cpu0):
+                    op_df = wdf.WilsonPCDF64(dpk_c)
+                op_df.gauge_eo_pp = tuple(
+                    jax.device_put(np.asarray(g))
+                    for g in op_df.gauge_eo_pp)
+                fl_mdagm = 2 * (2 * 1320 + 48) * (vol_c // 2)
+                secs_f32_apply = _bench_op(
+                    lambda b: mv24(b), rhs24, n1=4, n2=40)
+                _emit("solver", "f32_mdagm_24", secs_f32_apply,
+                      fl_mdagm, 0, platform, (Lc,) * 4, banner=banner,
+                      arith="f32", kind="apply")
+                secs_df = _bench_op(
+                    lambda b: op_df.MdagM(dfm.promote(b))[0], rhs24,
+                    n1=4, n2=40)
+                _emit("solver", "df64_mdagm_24", secs_df, fl_mdagm, 0,
+                      platform, (Lc,) * 4, banner=banner, arith="df64",
+                      kind="apply",
+                      cost_ratio_vs_f32=(round(secs_df
+                                               / secs_f32_apply, 2)
+                                         if secs_f32_apply > 0
+                                         else None))
+                # deep-tolerance reliable solve: df64 precise side,
+                # f32 pallas sloppy loop
+                rhs24_df = dfm.promote(rhs24)
+                codec_df = pair_inplace_codec(jnp.float32)
+                secs_df_cg = solver_row(
+                    "cg_reliable_df64_f32pallas_24",
+                    jax.jit(lambda b: cg_reliable_df(
+                        op_df, mv24, b, codec_df, tol=1e-10,
+                        maxiter=1200)),
+                    rhs24_df, fl_iter_c, Lc, tol=1e-10,
+                    precise="df64", sloppy="f32_pallas")
+                if secs_df_cg and secs_f32_cg:
+                    record_row("solver", {
+                        "name": "df64_reliable_cg_cost_ratio_24",
+                        "df64_secs": round(secs_df_cg, 3),
+                        "f32_secs": round(secs_f32_cg, 3),
+                        "ratio": round(secs_df_cg / secs_f32_cg, 2),
+                        "note": "tol 1e-10 (df64) vs 1e-6 (f32): the "
+                                "contract price, not an iso-tol ratio",
+                        "platform": platform, "lattice": [Lc] * 4},
+                        banner_platform=banner)
+            except Exception as e:
+                print(json.dumps({"suite": "solver",
+                                  "name": "df64_rows_24",
+                                  "error": str(e)[:140]}), flush=True)
+
+    if "sharded" in suites and suite_guard("sharded"):
+        # Multi-chip dslash policy A/B at 24^4 (round-8 tentpole): the
+        # rows the next multi-chip window needs to settle (a) v2-sharded
+        # vs v3-sharded kernel form and (b) fused-halo vs xla-facefix
+        # halo transport with NUMBERS (VERDICT r7 #5/#7).  GATED: these
+        # are only meaningful compiled on >= 2 real chips — a 1-device
+        # mesh exchanges nothing and an interpret-mode timing is noise —
+        # so anything else logs a loud SKIPPED row instead of silence.
+        from quda_tpu.parallel import compat as qcompat
+
+        n_dev = len(jax.devices())
+        if platform != "tpu" or n_dev < 2 or not qcompat.has_shard_map():
+            print(json.dumps({
+                "suite": "sharded", "skipped": True,
+                "error": f"SKIPPED: needs >=2 TPU devices + shard_map "
+                         f"(platform={platform!r}, devices={n_dev}); "
+                         "the policy A/B is a multi-chip measurement",
+            }), flush=True)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from quda_tpu.ops import wilson_pallas_packed as wpp
+            from quda_tpu.parallel.mesh import (factor_devices,
+                                                make_lattice_mesh)
+            from quda_tpu.parallel.pallas_dslash import (
+                dslash_eo_pallas_sharded, dslash_eo_pallas_sharded_v3)
+
+            Lsh = _conf("QUDA_TPU_BENCH_SOLVER_L_CHIP") or 24
+            # t/z device grid whose product is GUARANTEED to be n_dev
+            # (factor_devices), then validated against the lattice: odd
+            # device counts or non-dividing extents get a loud SKIPPED
+            # row, never an uncaught abort mid-bench
+            n_t, n_z = factor_devices(n_dev, 2)
+            ok_grid = (Lsh % n_t == 0 and Lsh % n_z == 0
+                       and (Lsh // n_t) % 2 == 0
+                       and (Lsh // n_z) % 2 == 0)
+            if not ok_grid:
+                print(json.dumps({
+                    "suite": "sharded", "skipped": True,
+                    "error": f"SKIPPED: no even-local-extent (t,z) grid "
+                             f"for {n_dev} devices at L={Lsh} "
+                             f"(tried {(n_t, n_z)})",
+                }), flush=True)
+            try:
+                if not ok_grid:
+                    raise StopIteration     # handled above, skip body
+                mesh_sh = make_lattice_mesh(grid=(n_t, n_z, 1, 1),
+                                            n_src=1)
+                dims_sh = (Lsh, Lsh, Lsh, Lsh)
+                vol_sh = Lsh ** 4
+                YXh = Lsh * Lsh // 2
+                # random eo pair arrays drawn directly (timing rows: the
+                # stencil cost is link-value independent)
+                k = jax.random.PRNGKey(17)
+                gspec = NamedSharding(
+                    mesh_sh, P(None, None, None, None, "t", "z", None))
+                pspec = NamedSharding(
+                    mesh_sh, P(None, None, None, "t", "z", None))
+                uh = jax.device_put(jax.random.normal(
+                    k, (4, 3, 3, 2, Lsh, Lsh, YXh), jnp.float32), gspec)
+                ut = jax.device_put(jax.random.normal(
+                    jax.random.fold_in(k, 1),
+                    (4, 3, 3, 2, Lsh, Lsh, YXh), jnp.float32), gspec)
+                psi_sh = jax.device_put(jax.random.normal(
+                    jax.random.fold_in(k, 2), (4, 3, 2, Lsh, Lsh, YXh),
+                    jnp.float32), pspec)
+                u_bw = jax.device_put(jax.jit(
+                    lambda u: wpp.backward_gauge_eo(u, dims_sh, 0))(ut),
+                    gspec)
+                for a in (uh, ut, psi_sh, u_bw):
+                    a.block_until_ready()
+                sharded_ready = True
+            except StopIteration:
+                sharded_ready = False
+            except Exception as e:
+                print(json.dumps({
+                    "suite": "sharded", "name": "setup",
+                    "error": str(e)[:140]}), flush=True)
+                sharded_ready = False
+
+            if sharded_ready:
+                # eo hop: 1320 flops per updated site over vol/2 sites;
+                # bytes keep the c64-equivalent convention of the dslash
+                # suite, halved for the half lattice
+                fl_sh = 1320 * (vol_sh // 2)
+                bts_sh = (4 * vol_sh * 18 * 8 + 2 * vol_sh * 24 * 8) // 2
+
+                pspec_p = P(None, None, None, "t", "z", None)
+                gspec_p = P(None, None, None, None, "t", "z", None)
+
+                def sharded_case(name, form, policy):
+                    if form == "v2":
+                        def local(a, b, p):
+                            return dslash_eo_pallas_sharded(
+                                a, b, p, dims_sh, 0, mesh_sh,
+                                policy=policy)
+                        args = (uh, u_bw)
+                    else:
+                        def local(a, b, p):
+                            return dslash_eo_pallas_sharded_v3(
+                                a, b, p, dims_sh, 0, mesh_sh,
+                                policy=policy)
+                        args = (uh, ut)
+                    fn = qcompat.shard_map(
+                        local, mesh=mesh_sh,
+                        in_specs=(gspec_p, gspec_p, pspec_p),
+                        out_specs=pspec_p)
+                    try:
+                        secs = _bench_op(lambda a, b, p: fn(a, b, p),
+                                         psi_sh, consts=args, n1=4, n2=40)
+                        _emit("sharded", name, secs, fl_sh, bts_sh,
+                              platform, (Lsh,) * 4, banner=banner,
+                              mesh=[n_t, n_z], form=form, policy=policy,
+                              devices=n_dev)
+                    except Exception as e:
+                        print(json.dumps({
+                            "suite": "sharded", "name": name,
+                            "error": str(e)[:140]}), flush=True)
+
+                # A/B 1: kernel form at fixed (facefix) transport
+                sharded_case("wilson_eo_sharded_v2_facefix_24", "v2",
+                             "xla_facefix")
+                sharded_case("wilson_eo_sharded_v3_facefix_24", "v3",
+                             "xla_facefix")
+                # A/B 2: halo transport at fixed (v2, the expected winner)
+                # kernel form — fused_halo needs real multi-chip RDMA, and
+                # a failure here is a loud error row, not silence
+                sharded_case("wilson_eo_sharded_v2_fused_halo_24", "v2",
+                             "fused_halo")
+                sharded_case("wilson_eo_sharded_v3_fused_halo_24", "v3",
+                             "fused_halo")
+
+    if "gauge" in suites and suite_guard("gauge"):
         # complex-free gauge/HMC sector (pair representation — the only
         # form the axon TPU executes; gauge/pair tests pin it against the
         # complex implementation).  Times the HISQ fattening chain and a
@@ -721,7 +933,7 @@ def main(argv):
             "platform": platform, "lattice": [Lg] * 4},
             banner_platform=banner)
 
-    if "mg" in suites:
+    if "mg" in suites and suite_guard("mg"):
         # complex-free multigrid V-cycle (mg/pair.py): setup once (host
         # rate), then time the jitted preconditioner apply — the MG
         # number the judge's executability question asks for.  Both

@@ -214,25 +214,28 @@ class _PairSloppyBase:
                                 x.dtype)
 
 
-_MESH_V3_NOTICED = False
+_SHARDED_NOTICED = False
 
 
-def _notice_mesh_forces_v3():
-    """One-time qlog notice when QUDA_TPU_PALLAS_VERSION is set but the
-    multi-device mesh path overrides it to v3 — an env knob must never
-    lose effect without a trace (utils/config.py fail-fast model)."""
-    global _MESH_V3_NOTICED
-    import os
-    raw = os.environ.get("QUDA_TPU_PALLAS_VERSION", "").strip()
-    if _MESH_V3_NOTICED or raw in ("", "3"):
+def _notice_sharded_policy(version: int, policy: str, raced: bool):
+    """One-time provenance notice naming the mesh dslash configuration
+    actually selected (kernel form + halo policy + how it was chosen) —
+    a policy must never take effect without a trace (utils/config.py
+    fail-fast model; successor of the retired _notice_mesh_forces_v3,
+    which existed because the sharded path could only run the v3
+    scatter form — round 8 ported the measured-best v2 form, so the
+    override it reported is gone)."""
+    global _SHARDED_NOTICED
+    if _SHARDED_NOTICED:
         return
-    _MESH_V3_NOTICED = True
+    _SHARDED_NOTICED = True
     from ..utils import logging as qlog
+    src = ("raced+cached (QUDA_TPU_SHARDED_POLICY=auto)" if raced
+           else "pinned")
     qlog.printq(
-        f"QUDA_TPU_PALLAS_VERSION={raw} is overridden to 3 on the "
-        "multi-device mesh path (the sharded eo policy exists only in "
-        "scatter form); single-chip solves and 1-device meshes honor "
-        "the knob", qlog.SUMMARIZE)
+        f"mesh dslash: pallas v{version} eo interior, halo policy "
+        f"{policy} ({src}); pin via QUDA_TPU_PALLAS_VERSION / "
+        "QUDA_TPU_SHARDED_POLICY", qlog.SUMMARIZE)
 
 
 class _PackedHopMixin:
@@ -245,11 +248,14 @@ class _PackedHopMixin:
 
     def _setup_hop(self, geom, gauge_eo_packed, store_dtype,
                    use_pallas, pallas_interpret, pallas_version=None,
-                   tb_sign: bool = True, mesh=None):
+                   tb_sign: bool = True, mesh=None,
+                   sharded_policy: str | None = None):
         """gauge_eo_packed: (even, odd) complex packed (4,3,3,T,Z,Y*Xh)
         links (wilson_packed.pack_gauge_eo output).  ``tb_sign``: whether
         the links carry a folded antiperiodic-t phase (drives the
-        reconstruct-12 row-2 sign; see wilson_pallas_packed)."""
+        reconstruct-12 row-2 sign; see wilson_pallas_packed).
+        ``sharded_policy`` pins the mesh halo policy programmatically
+        (else QUDA_TPU_SHARDED_POLICY decides; 'auto' races)."""
         from ..ops import wilson_packed as wpk
         self.geom = geom
         self.dims = tuple(geom.lattice_shape)
@@ -261,65 +267,71 @@ class _PackedHopMixin:
         self._tb_sign = tb_sign
         from ..utils import config as qconf
         if mesh is not None and getattr(mesh, "size", 2) == 1:
-            # single-chip escape: a 1-device mesh shards nothing, so the
-            # v3-only sharded policy must not handicap it with the
-            # 3.2x-slower scatter kernel (PERF.md round 5) — resolve the
-            # kernel form exactly like the unsharded path and drop the
-            # trivial mesh unless v3 was genuinely requested
-            v = (pallas_version if pallas_version is not None
-                 else qconf.get("QUDA_TPU_PALLAS_VERSION", fresh=True))
-            if v != 3:
-                mesh = None
+            # single-chip escape: a 1-device mesh shards nothing — drop
+            # it and resolve the kernel form exactly like the unsharded
+            # path (no exterior fix passes on a trivial mesh)
+            mesh = None
         if pallas_version is None:
-            if mesh is not None:
-                # the sharded eo policy exists only in scatter (v3) form
-                # (parallel/pallas_dslash.dslash_eo_pallas_sharded_v3);
-                # the measured v2-wins default is a SINGLE-chip verdict
-                # (PERF.md round 5) and must not disable multi-chip
-                pallas_version = 3
-                _notice_mesh_forces_v3()
-            else:
-                pallas_version = qconf.get("QUDA_TPU_PALLAS_VERSION",
-                                           fresh=True)
+            # mesh and single-chip resolve the SAME way now that the
+            # sharded eo policy exists in both kernel forms: the
+            # measured-best v2 default (PERF.md round 5) finally serves
+            # multi-chip too, and env/kwarg can still pin v3
+            pallas_version = qconf.get("QUDA_TPU_PALLAS_VERSION",
+                                       fresh=True)
         if pallas_version not in (2, 3):
             raise ValueError(f"pallas_version must be 2 or 3, got "
                              f"{pallas_version}")
         self._pallas_version = pallas_version
-        # in-kernel gauge compression (QUDA reconstruct-12 analog): v3
-        # pallas only; the resident link arrays shrink 288 -> 192 B/site
-        if (use_pallas and pallas_version == 3
-                and str(qconf.get("QUDA_TPU_RECONSTRUCT",
-                                  fresh=True)) == "12"):
+        # in-kernel gauge compression (QUDA reconstruct-12 analog), both
+        # kernel generations and the sharded path (round 8 lifted the
+        # v3-only and recon-18-only restrictions): resident link arrays
+        # shrink 288 -> 192 B/site
+        if (use_pallas and str(qconf.get("QUDA_TPU_RECONSTRUCT",
+                                         fresh=True)) == "12"):
             from ..ops import wilson_pallas_packed as wpp
             self.gauge_eo_pp = tuple(wpp.to_recon12(g)
                                      for g in self.gauge_eo_pp)
         # v2 pallas path only: resident pre-shifted backward links (the
         # v3 scatter-form kernel reads the unshifted opposite-parity
-        # links directly — no resident copy)
+        # links directly — no resident copy).  Computed on the GLOBAL
+        # arrays: under a mesh the shifts then already carry the
+        # cross-shard links, so the sharded exterior exchanges only psi
+        # slabs (parallel/pallas_dslash.dslash_eo_pallas_sharded).
         if use_pallas and pallas_version == 2:
             from ..ops import wilson_pallas_packed as wpp
             self._u_bw = tuple(
                 wpp.backward_gauge_eo(self.gauge_eo_pp[1 - p],
                                       tuple(self.dims), p)
                 for p in (0, 1))
-        # multi-chip: run the sharded eo pallas policy under shard_map
-        # (parallel/pallas_dslash.dslash_eo_pallas_sharded_v3); the
-        # resident links move onto the mesh once here
+        # multi-chip: run the sharded eo pallas policy under shard_map;
+        # the resident links move onto the mesh once here
         self._mesh = mesh
         if mesh is not None:
-            if not (use_pallas and self._pallas_version == 3):
+            if not use_pallas:
                 raise ValueError(
-                    "mesh-sharded packed hops need the v3 pallas path "
-                    "(use_pallas=True, pallas_version=3)")
-            if self.gauge_eo_pp[0].shape[1] == 2:
-                raise ValueError(
-                    "mesh-sharded packed hops need full 18-real links "
-                    "(set QUDA_TPU_RECONSTRUCT=18)")
+                    "mesh-sharded packed hops need use_pallas=True "
+                    "(the XLA pair stencil shards via GSPMD instead)")
+            self._sharded_policy = (
+                sharded_policy
+                or str(qconf.get("QUDA_TPU_SHARDED_POLICY", fresh=True))
+                or "auto")
             from jax.sharding import NamedSharding, PartitionSpec as P
             gspec = NamedSharding(
                 mesh, P(None, None, None, None, "t", "z", None))
             self.gauge_eo_pp = tuple(jax.device_put(g, gspec)
                                      for g in self.gauge_eo_pp)
+            if getattr(self, "_u_bw", None) is not None:
+                self._u_bw = tuple(jax.device_put(g, gspec)
+                                   for g in self._u_bw)
+            if self._sharded_policy == "auto":
+                # race EAGERLY, at construction: the first hop usually
+                # fires inside a solver trace, where timing concrete
+                # candidates is impossible (tune would stage pjit calls
+                # into the surrounding trace instead of executing them)
+                self._resolve_sharded_policy(0, None)
+            else:
+                _notice_sharded_policy(self._pallas_version,
+                                       self._sharded_policy, False)
 
     def _d_to(self, psi_pp, target_parity, out_dtype):
         from ..ops import wilson_packed as wpk
@@ -327,6 +339,9 @@ class _PackedHopMixin:
             from ..ops import wilson_pallas_packed as wpp
             if getattr(self, "_mesh", None) is not None:
                 fn = self._sharded_d_to(target_parity, out_dtype)
+                if self._pallas_version == 2:
+                    return fn(self.gauge_eo_pp[target_parity],
+                              self._u_bw[target_parity], psi_pp)
                 return fn(self.gauge_eo_pp[target_parity],
                           self.gauge_eo_pp[1 - target_parity], psi_pp)
             if self._pallas_version == 3:
@@ -340,7 +355,7 @@ class _PackedHopMixin:
                 self.gauge_eo_pp[target_parity],
                 self._u_bw[target_parity], psi_pp, tuple(self.dims),
                 target_parity, interpret=self._pallas_interpret,
-                out_dtype=out_dtype)
+                out_dtype=out_dtype, tb_sign=self._tb_sign)
         return wpk.dslash_eo_packed_pairs(self.gauge_eo_pp, psi_pp,
                                           self.dims, target_parity,
                                           out_dtype=out_dtype)
@@ -357,9 +372,85 @@ class _PackedHopMixin:
                 self.gauge_eo_pp[target_parity],
                 self._u_bw[target_parity], psi_b, tuple(self.dims),
                 target_parity, interpret=self._pallas_interpret,
-                out_dtype=out_dtype)
+                out_dtype=out_dtype, tb_sign=self._tb_sign)
         return jax.vmap(
             lambda p: self._d_to(p, target_parity, out_dtype))(psi_b)
+
+    def _build_sharded_fn(self, target_parity, out_dtype, policy: str):
+        """jitted shard_map of the sharded eo pallas policy for one
+        (parity, out_dtype, halo policy) configuration."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel import compat
+        from ..parallel.pallas_dslash import (dslash_eo_pallas_sharded,
+                                              dslash_eo_pallas_sharded_v3)
+        pspec = P(None, None, None, "t", "z", None)
+        gspec = P(None, None, None, None, "t", "z", None)
+        if self._pallas_version == 2:
+            def local(uh, ub, p):
+                return dslash_eo_pallas_sharded(
+                    uh, ub, p, tuple(self.dims), target_parity,
+                    self._mesh, interpret=self._pallas_interpret,
+                    out_dtype=out_dtype, tb_sign=self._tb_sign,
+                    policy=policy)
+        else:
+            def local(uh, ut, p):
+                return dslash_eo_pallas_sharded_v3(
+                    uh, ut, p, tuple(self.dims), target_parity,
+                    self._mesh, interpret=self._pallas_interpret,
+                    out_dtype=out_dtype, tb_sign=self._tb_sign,
+                    policy=policy)
+        return jax.jit(compat.shard_map(
+            local, mesh=self._mesh, in_specs=(gspec, gspec, pspec),
+            out_specs=pspec))
+
+    def _resolve_sharded_policy(self, target_parity, out_dtype) -> str:
+        """The policy engine: a pinned policy passes through; 'auto'
+        races every registered policy on REAL shard-resident operands
+        via utils.tune (QUDA's tune.cpp:862 rule — policies are timed,
+        never assumed) and caches the winner per (volume, mesh, kernel
+        form) in the tunecache.  A candidate that cannot run here (the
+        fused RDMA path off-chip without the distributed interpreter)
+        simply loses the race — tune skips failing candidates."""
+        pol = self._sharded_policy
+        if pol != "auto":
+            _notice_sharded_policy(self._pallas_version, pol, False)
+            return pol
+        won = getattr(self, "_sharded_policy_winner", None)
+        if won is not None:
+            return won
+        from ..parallel.pallas_dslash import SHARDED_POLICIES
+        from ..utils import tune as qtune
+        cands = {p: self._build_sharded_fn(target_parity, out_dtype, p)
+                 for p in SHARDED_POLICIES}
+        # concrete dummy operands at the solve shapes/shardings (the
+        # race may be triggered from inside a solver trace, where psi is
+        # a tracer — the links are resident concrete arrays already)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        uh = self.gauge_eo_pp[target_parity]
+        ub = (self._u_bw[target_parity] if self._pallas_version == 2
+              else self.gauge_eo_pp[1 - target_parity])
+        T, Z, _, _ = self.dims
+        psi0 = jax.device_put(
+            jnp.zeros((4, 3, 2, T, Z, uh.shape[-1]), self.store_dtype),
+            NamedSharding(self._mesh,
+                          P(None, None, None, "t", "z", None)))
+        mesh_shape = tuple(int(self._mesh.shape[a])
+                           for a in self._mesh.axis_names)
+        won = qtune.tune(
+            "wilson_eo_sharded_policy", tuple(self.dims), cands,
+            (uh, ub, psi0),
+            aux=f"v{self._pallas_version}|mesh{mesh_shape}|"
+                f"{jnp.dtype(self.store_dtype).name}")
+        self._sharded_policy_winner = won
+        # the winning candidate is already traced+compiled — seed the
+        # hop cache with it so the first real application does not pay
+        # an identical second XLA compilation of the distributed dslash
+        key = (target_parity, jnp.dtype(out_dtype).name if out_dtype
+               else None)
+        self.__dict__.setdefault("_sharded_fns", {})[key] = cands[won]
+        _notice_sharded_policy(self._pallas_version, won, True)
+        return won
 
     def _sharded_d_to(self, target_parity, out_dtype):
         """Memoized shard_map of the sharded eo pallas policy (a fresh
@@ -369,18 +460,10 @@ class _PackedHopMixin:
         key = (target_parity, jnp.dtype(out_dtype).name if out_dtype
                else None)
         if key not in cache:
-            from jax.sharding import PartitionSpec as P
-
-            from ..parallel.pallas_dslash import dslash_eo_pallas_sharded_v3
-            pspec = P(None, None, None, "t", "z", None)
-            gspec = P(None, None, None, None, "t", "z", None)
-            cache[key] = jax.jit(jax.shard_map(
-                lambda uh, ut, p: dslash_eo_pallas_sharded_v3(
-                    uh, ut, p, tuple(self.dims), target_parity,
-                    self._mesh, interpret=self._pallas_interpret,
-                    out_dtype=out_dtype),
-                mesh=self._mesh, in_specs=(gspec, gspec, pspec),
-                out_specs=pspec, check_vma=False))
+            policy = self._resolve_sharded_policy(target_parity,
+                                                  out_dtype)
+            cache[key] = self._build_sharded_fn(target_parity,
+                                                out_dtype, policy)
         return cache[key]
 
     def _to_pairs(self, x):
@@ -516,7 +599,9 @@ class DiracWilsonPCPacked:
     def pairs(self, store_dtype=jnp.bfloat16, use_pallas: bool = False,
               pallas_interpret: bool = False,
               pallas_version: int | None = None,
-              mesh=None) -> "DiracWilsonPCPackedSloppy":
+              mesh=None,
+              sharded_policy: str | None = None
+              ) -> "DiracWilsonPCPackedSloppy":
         """Pair-storage companion at an arbitrary storage dtype.
 
         With f32 storage this is the PRECISE operator in a fully
@@ -525,15 +610,20 @@ class DiracWilsonPCPacked:
         native-order analog of QUDA keeping solver fields in float2/
         float4 orders (no complex type on the device either).
         ``use_pallas`` swaps the stencil for the hand-tuned pallas eo
-        kernel; ``pallas_version`` 3 (default) uses the scatter-form
-        kernel that needs no resident pre-shifted backward links, 2 the
-        round-2 gather kernel.  ``mesh``: a jax.sharding.Mesh with t/z
-        axes partitioning the lattice T/Z — the stencil then runs the
-        sharded eo pallas policy under shard_map (multi-chip CG hot
-        loop, lib/dslash_policy.hpp:522 analog)."""
+        kernel; ``pallas_version`` 2 (the measured single-chip winner,
+        PERF.md round 5 — the env default) uses the gather kernel with
+        resident pre-shifted backward links, 3 the scatter-form kernel
+        that needs none.  ``mesh``: a jax.sharding.Mesh with t/z axes
+        partitioning the lattice T/Z — the stencil then runs the
+        sharded eo pallas policy under shard_map in the SAME kernel
+        form (multi-chip CG hot loop, lib/dslash_policy.hpp:522
+        analog), with ``sharded_policy`` (or QUDA_TPU_SHARDED_POLICY)
+        selecting the halo transport: xla_facefix, fused_halo, or auto
+        (raced via utils.tune)."""
         return DiracWilsonPCPackedSloppy(self, store_dtype, use_pallas,
                                          pallas_interpret, pallas_version,
-                                         mesh=mesh)
+                                         mesh=mesh,
+                                         sharded_policy=sharded_policy)
 
     def codec(self, precise_dtype, store_dtype=None):
         """StorageCodec matching this operator's sloppy representation
@@ -553,11 +643,12 @@ class DiracWilsonPCPackedSloppy(_PackedHopMixin, _PairSloppyBase):
 
     def __init__(self, dpk: "DiracWilsonPCPacked", store_dtype=jnp.bfloat16,
                  use_pallas: bool = False, pallas_interpret: bool = False,
-                 pallas_version: int | None = None, mesh=None):
+                 pallas_version: int | None = None, mesh=None,
+                 sharded_policy: str | None = None):
         self._setup_hop(dpk.geom, dpk.gauge_eo_p, store_dtype,
                         use_pallas, pallas_interpret, pallas_version,
                         tb_sign=getattr(dpk._dpc, "antiperiodic_t", True),
-                        mesh=mesh)
+                        mesh=mesh, sharded_policy=sharded_policy)
         self.kappa = float(dpk.kappa)
         self.matpc = dpk.matpc
 

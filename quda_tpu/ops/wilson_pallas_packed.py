@@ -181,16 +181,21 @@ def _shift_z(v, v_row, sign: int):
                  for c, n in zip(v, v_row))
 
 
-def _make_kernel(X: int, bz: int, eo: tuple | None = None):
+def _make_kernel(X: int, bz: int, eo: tuple | None = None,
+                 T: int | None = None, tb_sign: bool = True):
     """Kernel over one (t, z-block) tile.  Ref shapes (leading block dims
-    of 1 squeezed by indexing):
+    of 1 squeezed by indexing; R = 3 link rows for full storage, 2 for
+    reconstruct-12):
       psi refs:            (4, 3, 2, 1, BZ, YX) x5 (c, t+1, t-1, z+1, z-1)
-      g_c / g_m refs:      (4, 3, 3, 2, 1, BZ, YX)  (forward / pre-shifted
+      g_c / g_m refs:      (4, R, 3, 2, 1, BZ, YX)  (forward / pre-shifted
                            backward links)
     With ``eo = (target_parity, Xh)`` the tile is a checkerboarded half
     lattice (fused axis Y*Xh) and x shifts use the slot-parity select of
     wilson_packed.shift_eo_packed; g_c/g_m are then the target-parity
     forward links and the pre-shifted opposite-parity backward links.
+    ``T``/``tb_sign`` drive the reconstruct-12 t-boundary row-2 sign
+    (see _link_getter): the forward t-link boundary plane is t = T-1 on
+    g_c, the PRE-SHIFTED backward one is t = 0 on g_m.
     """
     from jax.experimental import pallas as pl
 
@@ -219,9 +224,14 @@ def _make_kernel(X: int, bz: int, eo: tuple | None = None):
             return (ref[s, c, 0, 0][rows].astype(F32),
                     ref[s, c, 1, 0][rows].astype(F32))
 
-        def link(ref, mu, a, b):
-            return (ref[mu, a, b, 0, 0].astype(F32),
-                    ref[mu, a, b, 1, 0].astype(F32))
+        # reconstruct-12 t-boundary sign planes (None for full storage /
+        # periodic t; see _make_kernel_v3 for the v3 analog)
+        if g_c.shape[1] == 2 and tb_sign:
+            t_idx = pl.program_id(0)
+            s_t_fwd = jnp.where(t_idx == T - 1, -1.0, 1.0).astype(F32)
+            s_t_bwd = jnp.where(t_idx == 0, -1.0, 1.0).astype(F32)
+        else:
+            s_t_fwd = s_t_bwd = None
 
         # accumulators per (spin, color), f32
         acc = [[(jnp.zeros(psi_c.shape[-2:], F32),
@@ -268,8 +278,7 @@ def _make_kernel(X: int, bz: int, eo: tuple | None = None):
                     h = [[_shift_xy(h[a][c], 1, sign,
                                     X if eo is None else eo[1])
                           for c in range(3)] for a in (0, 1)]
-                color_acc(h, lambda a, b, mu=mu, g=gref: link(g, mu, a, b),
-                          t, adjoint)
+                color_acc(h, _link_getter(gref, mu), t, adjoint)
         # z direction: project central + the needed boundary row of the
         # neighbouring z-block, then splice
         for sign, adjoint, gref, nb in ((+1, False, g_c, psi_zp),
@@ -280,14 +289,15 @@ def _make_kernel(X: int, bz: int, eo: tuple | None = None):
             h_row = project(lambda s, c: psi_row(nb, s, c, rows), t)
             h = [[_shift_z(h[a][c], h_row[a][c], sign) for c in range(3)]
                  for a in (0, 1)]
-            color_acc(h, lambda a, b, g=gref: link(g, 2, a, b), t, adjoint)
+            color_acc(h, _link_getter(gref, 2), t, adjoint)
         # t direction: whole neighbour tiles (index maps did the wrap),
         # no shift at all
-        for sign, adjoint, gref, nb in ((+1, False, g_c, psi_tp),
-                                        (-1, True, g_m, psi_tm)):
+        for sign, adjoint, gref, nb, r2s in (
+                (+1, False, g_c, psi_tp, s_t_fwd),
+                (-1, True, g_m, psi_tm, s_t_bwd)):
             t = TABLES[(3, sign)]
             h = project(lambda s, c, nb=nb: psi_at(nb, s, c), t)
-            color_acc(h, lambda a, b, g=gref: link(g, 3, a, b), t, adjoint)
+            color_acc(h, _link_getter(gref, 3, r2s), t, adjoint)
 
         odt = out_ref.dtype
         for s in range(4):
@@ -351,14 +361,18 @@ def _pick_bz(Z: int, YX: int, dtype=jnp.float32, planes: int = 288,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("X", "interpret", "block_z"))
+                   static_argnames=("X", "interpret", "block_z",
+                                    "tb_sign"))
 def dslash_pallas_packed(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
                          X: int, interpret: bool = False,
                          block_z: int | None = None,
-                         gauge_bw: jnp.ndarray | None = None) -> jnp.ndarray:
+                         gauge_bw: jnp.ndarray | None = None,
+                         tb_sign: bool = True) -> jnp.ndarray:
     """Wilson hop sum on pallas-layout pair arrays.
 
-    gauge_pl: (4,3,3,2,T,Z,YX) f32 (phases folded);
+    gauge_pl: (4,R,3,2,T,Z,YX) f32 (phases folded; R = 3 rows, or 2 for
+    reconstruct-12 storage, see ``to_recon12`` — ``tb_sign`` re-applies
+    the folded antiperiodic-t phase to the reconstructed row);
     psi_pl: (4,3,2,T,Z,YX) f32.  Returns the same layout as psi_pl.
     ``block_z`` overrides the auto-chosen z-block size (must divide Z).
     ``gauge_bw`` is the pre-shifted backward gauge from
@@ -369,7 +383,9 @@ def dslash_pallas_packed(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
     from jax.experimental import pallas as pl
 
     _, _, _, T, Z, YX = psi_pl.shape
-    bz = block_z if block_z is not None else _pick_bz(Z, YX, psi_pl.dtype)
+    R = gauge_pl.shape[1]
+    bz = block_z if block_z is not None else _pick_bz(
+        Z, YX, psi_pl.dtype, planes=288 if R == 3 else 240)
     if Z % bz != 0:
         raise ValueError(f"block_z={bz} does not divide Z={Z}")
     nzb = Z // bz
@@ -383,9 +399,9 @@ def dslash_pallas_packed(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
                                          (zb + dz) % nzb, 0))
 
     gauge_spec = pl.BlockSpec(
-        (4, 3, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+        (4, R, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
 
-    kernel = _make_kernel(X, bz)
+    kernel = _make_kernel(X, bz, T=T, tb_sign=tb_sign)
 
     return pl.pallas_call(
         kernel,
@@ -463,12 +479,13 @@ def _mrhs_wrap(kernel, n_psi: int = 5):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("X", "interpret", "block_z"))
+                   static_argnames=("X", "interpret", "block_z",
+                                    "tb_sign"))
 def dslash_pallas_packed_mrhs(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
                               X: int, interpret: bool = False,
                               block_z: int | None = None,
-                              gauge_bw: jnp.ndarray | None = None
-                              ) -> jnp.ndarray:
+                              gauge_bw: jnp.ndarray | None = None,
+                              tb_sign: bool = True) -> jnp.ndarray:
     """Multi-RHS Wilson hop sum on pallas-layout pair arrays.
 
     gauge_pl: (4,3,3,2,T,Z,YX); psi_pl: (N,4,3,2,T,Z,YX) — a leading
@@ -480,7 +497,9 @@ def dslash_pallas_packed_mrhs(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
     from jax.experimental import pallas as pl
 
     N, _, _, _, T, Z, YX = psi_pl.shape
-    bz = block_z if block_z is not None else _pick_bz(Z, YX, psi_pl.dtype)
+    R = gauge_pl.shape[1]
+    bz = block_z if block_z is not None else _pick_bz(
+        Z, YX, psi_pl.dtype, planes=288 if R == 3 else 240)
     if Z % bz != 0:
         raise ValueError(f"block_z={bz} does not divide Z={Z}")
     nzb = Z // bz
@@ -496,9 +515,9 @@ def dslash_pallas_packed_mrhs(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
     # gauge index maps ignore n: the block index repeats across the
     # innermost RHS loop, so the pipeline re-uses the resident tile
     gauge_spec = pl.BlockSpec(
-        (4, 3, 3, 2, 1, bz, YX), lambda t, zb, n: (0, 0, 0, 0, t, zb, 0))
+        (4, R, 3, 2, 1, bz, YX), lambda t, zb, n: (0, 0, 0, 0, t, zb, 0))
 
-    kernel = _mrhs_wrap(_make_kernel(X, bz))
+    kernel = _mrhs_wrap(_make_kernel(X, bz, T=T, tb_sign=tb_sign))
 
     return pl.pallas_call(
         kernel,
@@ -515,14 +534,15 @@ def dslash_pallas_packed_mrhs(gauge_pl: jnp.ndarray, psi_pl: jnp.ndarray,
 
 @functools.partial(jax.jit, static_argnames=("dims", "target_parity",
                                              "interpret", "block_z",
-                                             "out_dtype"))
+                                             "out_dtype", "tb_sign"))
 def dslash_eo_pallas_packed_mrhs(u_here_pl: jnp.ndarray,
                                  u_bw_pl: jnp.ndarray,
                                  psi_pl: jnp.ndarray, dims,
                                  target_parity: int,
                                  interpret: bool = False,
                                  block_z: int | None = None,
-                                 out_dtype=None) -> jnp.ndarray:
+                                 out_dtype=None,
+                                 tb_sign: bool = True) -> jnp.ndarray:
     """Multi-RHS checkerboarded Wilson hop — the batched-solver hot path
     (``dslash_eo_pallas_packed`` with a leading RHS axis on psi).
 
@@ -534,8 +554,10 @@ def dslash_eo_pallas_packed_mrhs(u_here_pl: jnp.ndarray,
     T, Z, Y, X = dims
     Xh = X // 2
     N = psi_pl.shape[0]
+    R = u_here_pl.shape[1]
     YXh = psi_pl.shape[-1]
-    bz = block_z if block_z is not None else _pick_bz(Z, YXh, psi_pl.dtype)
+    bz = block_z if block_z is not None else _pick_bz(
+        Z, YXh, psi_pl.dtype, planes=288 if R == 3 else 240)
     if Z % bz != 0:
         raise ValueError(f"block_z={bz} does not divide Z={Z}")
     nzb = Z // bz
@@ -547,10 +569,11 @@ def dslash_eo_pallas_packed_mrhs(u_here_pl: jnp.ndarray,
                                             (zb + dz) % nzb, 0))
 
     gauge_spec = pl.BlockSpec(
-        (4, 3, 3, 2, 1, bz, YXh),
+        (4, R, 3, 2, 1, bz, YXh),
         lambda t, zb, n: (0, 0, 0, 0, t, zb, 0))
 
-    kernel = _mrhs_wrap(_make_kernel(X, bz, eo=(target_parity, Xh)))
+    kernel = _mrhs_wrap(_make_kernel(X, bz, eo=(target_parity, Xh),
+                                     T=T, tb_sign=tb_sign))
 
     return pl.pallas_call(
         kernel,
@@ -897,27 +920,33 @@ def backward_gauge_eo(u_there_pl: jnp.ndarray, dims,
 
 @functools.partial(jax.jit, static_argnames=("dims", "target_parity",
                                              "interpret", "block_z",
-                                             "out_dtype"))
+                                             "out_dtype", "tb_sign"))
 def dslash_eo_pallas_packed(u_here_pl: jnp.ndarray, u_bw_pl: jnp.ndarray,
                             psi_pl: jnp.ndarray, dims,
                             target_parity: int, interpret: bool = False,
                             block_z: int | None = None,
-                            out_dtype=None) -> jnp.ndarray:
+                            out_dtype=None,
+                            tb_sign: bool = True) -> jnp.ndarray:
     """Checkerboarded Wilson hop on pallas-layout half-lattice pair
     arrays (the pallas analog of wilson_packed.dslash_eo_packed_pairs —
     the solver hot loop's stencil).
 
-    u_here_pl: (4,3,3,2,T,Z,Y*Xh) forward links at target-parity sites;
-    u_bw_pl: pre-shifted backward links from ``backward_gauge_eo``;
-    psi_pl: (4,3,2,T,Z,Y*Xh) parity-(1-p) spinor.  Returns the hop sum
-    indexed by parity-``target_parity`` sites, same layout as psi_pl.
+    u_here_pl: (4,R,3,2,T,Z,Y*Xh) forward links at target-parity sites
+    (R = 2 selects in-kernel reconstruct-12, see ``to_recon12``;
+    ``tb_sign`` re-applies the folded antiperiodic-t phase to the
+    reconstructed row); u_bw_pl: pre-shifted backward links from
+    ``backward_gauge_eo``; psi_pl: (4,3,2,T,Z,Y*Xh) parity-(1-p)
+    spinor.  Returns the hop sum indexed by parity-``target_parity``
+    sites, same layout as psi_pl.
     """
     from jax.experimental import pallas as pl
 
     T, Z, Y, X = dims
     Xh = X // 2
+    R = u_here_pl.shape[1]
     _, _, _, _, _, YXh = psi_pl.shape
-    bz = block_z if block_z is not None else _pick_bz(Z, YXh, psi_pl.dtype)
+    bz = block_z if block_z is not None else _pick_bz(
+        Z, YXh, psi_pl.dtype, planes=288 if R == 3 else 240)
     if Z % bz != 0:
         raise ValueError(f"block_z={bz} does not divide Z={Z}")
     nzb = Z // bz
@@ -929,9 +958,10 @@ def dslash_eo_pallas_packed(u_here_pl: jnp.ndarray, u_bw_pl: jnp.ndarray,
                                          (zb + dz) % nzb, 0))
 
     gauge_spec = pl.BlockSpec(
-        (4, 3, 3, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+        (4, R, 3, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
 
-    kernel = _make_kernel(X, bz, eo=(target_parity, Xh))
+    kernel = _make_kernel(X, bz, eo=(target_parity, Xh), T=T,
+                          tb_sign=tb_sign)
 
     return pl.pallas_call(
         kernel,

@@ -23,14 +23,19 @@ rolls — fusing Y*X is what makes the kernel fast, so those axes are
 the natural local ones).  This matches how 4-d lattices are usually
 decomposed (outer axes first).
 
+Round 8: the Wilson policies exist in BOTH kernel forms — v2 (gather,
+globally pre-shifted backward links; the measured single-chip winner)
+and v3 (scatter) — accept reconstruct-12 storage (face slabs rebuilt by
+``_full_rows``), and route every face transfer through the
+``exchange`` policy seam (``QUDA_TPU_SHARDED_POLICY``: ppermute
+face-fix vs in-kernel RDMA slab exchange, auto-raced via utils.tune).
+
 All arrays are the packed PAIR layout: psi (4,3,2,T,Z,YX) storage,
 gauge/gauge_bw (4,3,3,2,T,Z,YX) — per-shard LOCAL blocks inside
 shard_map.
 """
 
 from __future__ import annotations
-
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -65,9 +70,9 @@ def _add_face_n(out, corr, axis, lo: bool, n: int = 1):
 
 
 def _fix_hi_face_n(out, gauge_pl, psi_pl, axis, name, n, mu):
-    """Forward-hop fix on the HIGH face (shared by both policies):
-    psi(x+mu) must come from the next shard's first plane — the kernel
-    used the local first plane."""
+    """Forward-hop fix on the HIGH face (ppermute form, kept for the
+    staggered policies): psi(x+mu) must come from the next shard's first
+    plane — the kernel used the local first plane."""
     u_fwd_hi = _face_n(gauge_pl[mu], axis, lo=False)
     halo_hi = _nbr(_face_n(psi_pl, axis, lo=True), name,
                    towards_lower=True, n=n)
@@ -77,8 +82,136 @@ def _fix_hi_face_n(out, gauge_pl, psi_pl, axis, name, n, mu):
     return _add_face_n(out, corr_hi, axis, lo=False)
 
 
+# -- halo-exchange policies (QUDA_TPU_SHARDED_POLICY) -----------------------
+#
+# Every Wilson face fix needs exactly two slab transfers per partitioned
+# direction: one slab travelling towards the LOWER shard (the receiver
+# splices it into its HIGH face) and one towards the UPPER shard (spliced
+# into the LOW face).  ``exchange(send_down, send_up, name, n)`` returns
+# (from_up, from_down) and is the single seam where the policy engine
+# plugs in:
+#   * xla_facefix — two lax.ppermute calls (GSPMD CollectivePermute,
+#     scheduled/overlapped by XLA — today's production path);
+#   * fused_halo — ONE pallas launch with both RDMAs in flight behind a
+#     single neighbour barrier (parallel/pallas_halo.slab_exchange_bidir,
+#     the include/dslash_shmem.h analog).
+
+SHARDED_POLICIES = ("xla_facefix", "fused_halo")
+
+
+def _exchange_xla(send_down, send_up, name, n):
+    return (_nbr(send_down, name, towards_lower=True, n=n),
+            _nbr(send_up, name, towards_lower=False, n=n))
+
+
+def _make_exchange(policy: str, mesh, interpret: bool):
+    if policy == "xla_facefix":
+        return _exchange_xla
+    if policy == "fused_halo":
+        from .pallas_halo import slab_exchange_bidir
+
+        def exchange(send_down, send_up, name, n):
+            return slab_exchange_bidir(send_down, send_up, name,
+                                       tuple(mesh.axis_names),
+                                       interpret=interpret)
+        return exchange
+    raise ValueError(f"unknown sharded halo policy {policy!r}; "
+                     f"known: {SHARDED_POLICIES}")
+
+
+# -- reconstruct-12 face slabs ----------------------------------------------
+
+def _full_rows(u_slab, row2_sign=None):
+    """Full 3x3 link slab from a face slab of either storage: row extent
+    3 passes through; extent 2 (reconstruct-12, see
+    wilson_pallas_packed.to_recon12) rebuilds row 2 = conj(row0 x row1)
+    in f32 — O(surface) XLA work, the exterior analog of the in-kernel
+    reconstruction.  ``row2_sign`` re-applies the folded antiperiodic-t
+    phase (a +-1 scalar/plane; the two -1s of V = -U cancel in the cross
+    product, so the boundary-plane row must be re-negated)."""
+    if u_slab.shape[0] == 3:
+        return u_slab
+    u = u_slab.astype(jnp.float32)
+    r0, r1 = u[0], u[1]                     # (3, 2, ...) each
+    rows2 = []
+    for b in range(3):
+        b1, b2 = (b + 1) % 3, (b + 2) % 3
+        re = ((r0[b1, 0] * r1[b2, 0] - r0[b1, 1] * r1[b2, 1])
+              - (r0[b2, 0] * r1[b1, 0] - r0[b2, 1] * r1[b1, 1]))
+        im = ((r0[b1, 0] * r1[b2, 1] + r0[b1, 1] * r1[b2, 0])
+              - (r0[b2, 0] * r1[b1, 1] + r0[b2, 1] * r1[b1, 0]))
+        re, im = re, -im                    # conjugate the cross product
+        if row2_sign is not None:
+            re, im = re * row2_sign, im * row2_sign
+        rows2.append(jnp.stack([re, im]))
+    return jnp.concatenate([u, jnp.stack(rows2)[None]], axis=0)
+
+
+def _face_links(u_mu_slab, edge_sign):
+    """(true, kernel) full-row slabs for one face: ``true`` carries the
+    physically correct reconstructed row (edge_sign applied on the
+    global-boundary shard), ``kernel`` reproduces the interior kernel's
+    convention — the sharded wrappers run the in-kernel reconstruction
+    UNSIGNED along a partitioned t axis (interior tb_sign=False), so the
+    wrong-wrap term being subtracted must be rebuilt the same way."""
+    true = _full_rows(u_mu_slab, edge_sign)
+    if u_mu_slab.shape[0] == 3 or edge_sign is None:
+        return true, true
+    return true, _full_rows(u_mu_slab, None)
+
+
+def _t_edge_signs(axis_idx_name: str, n: int, mu: int, R: int,
+                  tb_sign: bool):
+    """(sign_hi, sign_lo) for the reconstruct-12 t-boundary row on the
+    two faces of a partitioned direction: the HIGH face of the last
+    shard holds the global t = T-1 link plane; the pre-shifted backward
+    LOW face of shard 0 holds the same plane.  None everywhere except
+    recon-12 t-links with a folded boundary."""
+    if mu != 3 or R == 3 or not tb_sign:
+        return None, None
+    idx = lax.axis_index(axis_idx_name)
+    one = jnp.float32(1.0)
+    sign_hi = jnp.where(idx == n - 1, -one, one)
+    sign_lo = jnp.where(idx == 0, -one, one)
+    return sign_hi, sign_lo
+
+
+def _wilson_fix_faces_v2(out, links_fwd, links_bwd_sh, psi_pl, axis,
+                         name, n, mu, exchange, sign_hi=None,
+                         sign_lo=None):
+    """Both slab fixes for one partitioned direction, v2 gather-form
+    conventions (pre-shifted backward links resident per shard):
+
+    * forward hop, HIGH face: psi(x+mu) from the next shard's first
+      plane against ``links_fwd`` (local forward links — already
+      correct);
+    * backward hop, LOW face: ``links_bwd_sh`` is the LOCAL block of the
+      GLOBALLY pre-shifted backward gauge, so its low face already holds
+      the correct cross-shard link U_mu(x-mu) — only psi(x-mu) must come
+      from the previous shard's last plane.
+
+    Both halos ride ONE ``exchange`` call (the policy seam)."""
+    lo_first = _face_n(psi_pl, axis, lo=True)
+    hi_last = _face_n(psi_pl, axis, lo=False)
+    halo_hi, halo_lo = exchange(lo_first, hi_last, name, n)
+
+    u_hi_true, u_hi_kern = _face_links(_face_n(links_fwd[mu], axis,
+                                               lo=False), sign_hi)
+    tf = TABLES[(mu, +1)]
+    corr_hi = (_hop_term(halo_hi, u_hi_true, tf, False)
+               - _hop_term(lo_first, u_hi_kern, tf, False))
+    out = _add_face_n(out, corr_hi, axis, lo=False)
+
+    u_lo_true, u_lo_kern = _face_links(_face_n(links_bwd_sh[mu], axis,
+                                               lo=True), sign_lo)
+    tb = TABLES[(mu, -1)]
+    corr_lo = (_hop_term(halo_lo, u_lo_true, tb, True)
+               - _hop_term(hi_last, u_lo_kern, tb, True))
+    return _add_face_n(out, corr_lo, axis, lo=True)
+
+
 def _wilson_fix_faces_v3(out, links_fwd, links_bwd, psi_pl, axis, name,
-                         n, mu):
+                         n, mu, exchange=_exchange_xla, sign_hi=None):
     """Both slab fixes for one partitioned direction, v3 scatter-form
     conventions (one home for the full-lattice AND eo policies):
 
@@ -87,23 +220,34 @@ def _wilson_fix_faces_v3(out, links_fwd, links_bwd, psi_pl, axis, name,
     * backward hop, LOW face: the kernel wrapped the locally-computed
       product U^dag psi of the last plane (built from ``links_bwd``);
       permute the product itself — linear in the face, no link exchange.
-    """
-    out = _fix_hi_face_n(out, links_fwd, psi_pl, axis, name, n, mu)
-    prod = _hop_term(_face_n(psi_pl, axis, lo=False),
-                     _face_n(links_bwd[mu], axis, lo=False),
-                     TABLES[(mu, -1)], True)
-    corr_lo = _nbr(prod, name, towards_lower=False, n=n) - prod
-    return _add_face_n(out, corr_lo, axis, lo=True)
+
+    Both transfers ride ONE ``exchange`` call (the policy seam)."""
+    lo_first = _face_n(psi_pl, axis, lo=True)
+    hi_last = _face_n(psi_pl, axis, lo=False)
+    u_bwd_true, u_bwd_kern = _face_links(_face_n(links_bwd[mu], axis,
+                                                 lo=False), sign_hi)
+    tb = TABLES[(mu, -1)]
+    # the slab SENT upward must be the physically correct product (the
+    # receiver splices it in as-is); the slab SUBTRACTED locally must be
+    # the interior kernel's own wrong-wrap product
+    prod_true = _hop_term(hi_last, u_bwd_true, tb, True)
+    prod_kern = (prod_true if u_bwd_kern is u_bwd_true
+                 else _hop_term(hi_last, u_bwd_kern, tb, True))
+    halo_hi, prod_in = exchange(lo_first, prod_true, name, n)
+
+    u_fwd_true, u_fwd_kern = _face_links(_face_n(links_fwd[mu], axis,
+                                                 lo=False), sign_hi)
+    tf = TABLES[(mu, +1)]
+    corr_hi = (_hop_term(halo_hi, u_fwd_true, tf, False)
+               - _hop_term(lo_first, u_fwd_kern, tf, False))
+    out = _add_face_n(out, corr_hi, axis, lo=False)
+    return _add_face_n(out, prod_in - prod_kern, axis, lo=True)
 
 
 def _check_sharded_mesh(name: str, links, mesh):
-    """Shared guards of the v3 sharded Wilson policies."""
-    if links.shape[1] == 2:
-        raise ValueError(
-            "sharded pallas policies need full 18-real link storage: "
-            "the exterior face fixes read 3x3 link slabs "
-            "(reconstruct-12 faces are a planned follow-up; pass the "
-            "uncompressed gauge here)")
+    """Shared guards of the sharded Wilson policies (reconstruct-12 row
+    extent 2 is accepted: the face fixes rebuild full rows on the
+    O(surface) slabs, see _full_rows)."""
     if mesh.shape["y"] != 1 or mesh.shape["x"] != 1:
         raise ValueError(
             f"{name} shards t/z only (y/x mesh axes must be 1)")
@@ -111,7 +255,8 @@ def _check_sharded_mesh(name: str, links, mesh):
 
 
 def dslash_pallas_sharded(gauge_pl, gauge_bw_pl, psi_pl, X: int, mesh,
-                          interpret: bool = False):
+                          interpret: bool = False, tb_sign: bool = True,
+                          policy: str = "xla_facefix"):
     """Wilson hop sum on per-shard local packed pair blocks — call
     INSIDE shard_map over ``mesh`` with the t/z mesh axes partitioning
     the T/Z array axes (y and x mesh axes must be size 1).
@@ -120,44 +265,33 @@ def dslash_pallas_sharded(gauge_pl, gauge_bw_pl, psi_pl, X: int, mesh,
     the GLOBAL field (compute wilson_pallas_packed.backward_gauge on
     the global array before sharding — its t/z shifts then already
     carry the cross-shard links, and only psi halos plus the wrong
-    local wraps remain to fix).
+    local wraps remain to fix).  Row extent 2 selects reconstruct-12
+    (in-kernel interior + _full_rows face slabs); ``policy`` selects the
+    halo transport (see SHARDED_POLICIES).
     """
     from ..ops.wilson_pallas_packed import dslash_pallas_packed
 
-    if gauge_pl.shape[1] == 2:
-        raise ValueError(
-            "sharded pallas policies need full 18-real link storage: "
-            "the exterior face fixes read 3x3 link slabs "
-            "(reconstruct-12 faces are a planned follow-up; pass the "
-            "uncompressed gauge here)")
-    n_t, n_z = mesh.shape["t"], mesh.shape["z"]
-    if mesh.shape["y"] != 1 or mesh.shape["x"] != 1:
-        raise ValueError(
-            "dslash_pallas_sharded shards t/z only (y/x mesh axes must "
-            "be 1; their shifts are in-plane lane rolls)")
+    n_t, n_z = _check_sharded_mesh("dslash_pallas_sharded", gauge_pl,
+                                   mesh)
+    R = gauge_pl.shape[1]
+    exchange = _make_exchange(policy, mesh, interpret)
 
     # interior pass: periodic single-chip kernel on the local block.
     # gauge_bw is exact even on the boundary (pre-shifted globally);
-    # only psi wraps are wrong on the faces.
+    # only psi wraps are wrong on the faces.  Along a partitioned t the
+    # interior reconstruct-12 runs UNSIGNED (its local boundary plane is
+    # not the global one); the face fixes re-apply the true edge sign.
     out = dslash_pallas_packed(gauge_pl, psi_pl, X,
-                               gauge_bw=gauge_bw_pl, interpret=interpret)
+                               gauge_bw=gauge_bw_pl, interpret=interpret,
+                               tb_sign=tb_sign and n_t == 1)
 
-    t_ax, z_ax = -3, -2
-    for axis, name, n, mu in ((t_ax, "t", n_t, 3), (z_ax, "z", n_z, 2)):
+    for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
         if n == 1:
             continue                      # periodic wrap is correct
-        out = _fix_hi_face_n(out, gauge_pl, psi_pl, axis, name, n, mu)
-        # backward hop on the LOW face: psi(x-mu) from the previous
-        # shard's last plane (the backward link u_bwd_lo is already the
-        # correct cross-shard link: backward_gauge ran globally)
-        u_bwd_lo = _face_n(gauge_bw_pl[mu], axis, lo=True)   # U_mu(x-mu) at 0
-        halo_lo = _nbr(_face_n(psi_pl, axis, lo=False), name,
-                       towards_lower=False, n=n)
-        wrong_lo = _face_n(psi_pl, axis, lo=False)
-        corr_lo = (_hop_term(halo_lo, u_bwd_lo, TABLES[(mu, -1)], True)
-                   - _hop_term(wrong_lo, u_bwd_lo, TABLES[(mu, -1)],
-                               True))
-        out = _add_face_n(out, corr_lo, axis, lo=True)
+        sign_hi, sign_lo = _t_edge_signs(name, n, mu, R, tb_sign)
+        out = _wilson_fix_faces_v2(out, gauge_pl, gauge_bw_pl, psi_pl,
+                                   axis, name, n, mu, exchange,
+                                   sign_hi, sign_lo)
     return out
 
 
@@ -302,13 +436,74 @@ def dslash_staggered_eo_pallas_sharded_v3(fat_here_pl, fat_there_pl,
     return out
 
 
+def _check_eo_local_extents(n_t, n_z, psi_pl):
+    t_loc, z_loc = psi_pl.shape[-3], psi_pl.shape[-2]
+    for nn, ext, nm in ((n_t, t_loc, "T"), (n_z, z_loc, "Z")):
+        if nn > 1 and ext % 2 != 0:
+            raise ValueError(
+                f"local {nm} extent {ext} must be even on a partitioned "
+                f"axis (the checkerboard masks use local coordinates)")
+    return t_loc, z_loc
+
+
+def dslash_eo_pallas_sharded(u_here_pl, u_bw_pl, psi_pl, dims,
+                             target_parity: int, mesh,
+                             interpret: bool = False,
+                             out_dtype=None, tb_sign: bool = True,
+                             policy: str = "xla_facefix"):
+    """Checkerboarded Wilson hop under shard_map on the v2 (gather)
+    kernel form — the MEASURED-BEST interior (PERF.md round 5: v2 f32
+    5673 GFLOPS vs v3 1768 single-chip) driving the multi-chip CG hot
+    loop (reference: lib/dslash_policy.hpp:365-560; the round-5 verdict
+    demanded the sharded path stop paying the 3.2x scatter-form tax).
+
+    Interior: ops/wilson_pallas_packed.dslash_eo_pallas_packed on the
+    LOCAL block.  ``u_bw_pl`` is the LOCAL block of the GLOBALLY
+    pre-shifted backward links (backward_gauge_eo on the global arrays
+    BEFORE sharding): its t/z shifts already carry the cross-shard
+    links, so the exterior fixes exchange ONLY psi slabs — the forward
+    hop's HIGH-face psi from the next shard, the backward hop's
+    LOW-face psi from the previous one, both riding one ``exchange``
+    per direction (the policy seam, see SHARDED_POLICIES).
+
+    Row extent 2 on the link arrays selects reconstruct-12 (interior
+    in-kernel + _full_rows face slabs with shard-edge t signs).  t/z
+    hops flip parity but keep the checkerboarded x-slot layout, so slab
+    alignment matches the full-lattice case; partitioned axes need EVEN
+    local extents.  ``dims`` is the GLOBAL (T, Z, Y, X).
+    """
+    from ..ops.wilson_pallas_packed import dslash_eo_pallas_packed
+
+    n_t, n_z = _check_sharded_mesh("dslash_eo_pallas_sharded",
+                                   u_here_pl, mesh)
+    R = u_here_pl.shape[1]
+    t_loc, z_loc = _check_eo_local_extents(n_t, n_z, psi_pl)
+    dims_local = (t_loc, z_loc, dims[2], dims[3])
+    exchange = _make_exchange(policy, mesh, interpret)
+
+    out = dslash_eo_pallas_packed(
+        u_here_pl, u_bw_pl, psi_pl, dims_local, target_parity,
+        interpret=interpret, out_dtype=out_dtype,
+        tb_sign=tb_sign and n_t == 1)
+
+    for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
+        if n == 1:
+            continue
+        sign_hi, sign_lo = _t_edge_signs(name, n, mu, R, tb_sign)
+        out = _wilson_fix_faces_v2(out, u_here_pl, u_bw_pl, psi_pl,
+                                   axis, name, n, mu, exchange,
+                                   sign_hi, sign_lo)
+    return out
+
+
 def dslash_eo_pallas_sharded_v3(u_here_pl, u_there_pl, psi_pl, dims,
                                 target_parity: int, mesh,
                                 interpret: bool = False,
-                                out_dtype=None):
-    """Checkerboarded Wilson hop under shard_map — the CG hot loop's
-    stencil made multi-chip (reference: the eo interior/exterior policies
-    of lib/dslash_policy.hpp:365-560 driving dslash_wilson.cuh).
+                                out_dtype=None, tb_sign: bool = True,
+                                policy: str = "xla_facefix"):
+    """Checkerboarded Wilson hop under shard_map on the v3 scatter
+    kernel form (reference: the eo interior/exterior policies of
+    lib/dslash_policy.hpp:365-560 driving dslash_wilson.cuh).
 
     Interior: the single-chip v3 scatter-form eo kernel
     (ops/wilson_pallas_packed.dslash_eo_pallas_packed_v3) on the LOCAL
@@ -317,7 +512,8 @@ def dslash_eo_pallas_sharded_v3(u_here_pl, u_there_pl, psi_pl, dims,
     next shard's first psi plane; the backward hop permutes the locally
     computed product U^dag psi built from the opposite-parity links
     (u_there).  Both link arrays are already shard-resident: only psi
-    slabs and product slabs ride the ppermute.
+    slabs and product slabs ride the exchange (the policy seam, see
+    SHARDED_POLICIES); row extent 2 selects reconstruct-12.
 
     t/z hops flip parity but keep the checkerboarded x-slot layout, so
     slab alignment matches the full-lattice case; partitioned axes need
@@ -328,49 +524,55 @@ def dslash_eo_pallas_sharded_v3(u_here_pl, u_there_pl, psi_pl, dims,
 
     n_t, n_z = _check_sharded_mesh("dslash_eo_pallas_sharded_v3",
                                    u_here_pl, mesh)
-    t_loc, z_loc = psi_pl.shape[-3], psi_pl.shape[-2]
-    for nn, ext, nm in ((n_t, t_loc, "T"), (n_z, z_loc, "Z")):
-        if nn > 1 and ext % 2 != 0:
-            raise ValueError(
-                f"local {nm} extent {ext} must be even on a partitioned "
-                f"axis (the checkerboard masks use local coordinates)")
+    R = u_here_pl.shape[1]
+    t_loc, z_loc = _check_eo_local_extents(n_t, n_z, psi_pl)
     dims_local = (t_loc, z_loc, dims[2], dims[3])
+    exchange = _make_exchange(policy, mesh, interpret)
 
     out = dslash_eo_pallas_packed_v3(
         u_here_pl, u_there_pl, psi_pl, dims_local, target_parity,
-        interpret=interpret, out_dtype=out_dtype)
+        interpret=interpret, out_dtype=out_dtype,
+        tb_sign=tb_sign and n_t == 1)
 
     for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
         if n == 1:
             continue
+        sign_hi, _ = _t_edge_signs(name, n, mu, R, tb_sign)
         out = _wilson_fix_faces_v3(out, u_here_pl, u_there_pl, psi_pl,
-                                   axis, name, n, mu)
+                                   axis, name, n, mu, exchange, sign_hi)
     return out
 
 
 def dslash_pallas_sharded_v3(gauge_pl, psi_pl, X: int, mesh,
-                             interpret: bool = False):
+                             interpret: bool = False,
+                             tb_sign: bool = True,
+                             policy: str = "xla_facefix"):
     """v3 of the fused manual policy: the scatter-form interior kernel
     needs NO backward-gauge copy anywhere — not per shard, not global.
 
     The v3 kernel's backward hop wraps the locally-computed product
     m = U_mu^dag psi into the low face.  Since that product is
-    elementwise per face site and ppermute is linear, the fix permutes
-    the PRODUCT once — corr = nbr(m_last) - m_last — one f32 spinor
+    elementwise per face site and the exchange is linear, the fix sends
+    the PRODUCT once — corr = recv(m_last) - m_last — one f32 spinor
     face per partitioned direction, half the exterior compute, and no
-    gauge exchange or resident pre-shifted copy anywhere.
+    gauge exchange or resident pre-shifted copy anywhere.  Row extent 2
+    selects reconstruct-12; ``policy`` the halo transport.
     """
     from ..ops.wilson_pallas_packed import dslash_pallas_packed_v3
 
     n_t, n_z = _check_sharded_mesh("dslash_pallas_sharded_v3", gauge_pl,
                                    mesh)
+    R = gauge_pl.shape[1]
+    exchange = _make_exchange(policy, mesh, interpret)
 
     out = dslash_pallas_packed_v3(gauge_pl, psi_pl, X,
-                                  interpret=interpret)
+                                  interpret=interpret,
+                                  tb_sign=tb_sign and n_t == 1)
 
     for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
         if n == 1:
             continue
+        sign_hi, _ = _t_edge_signs(name, n, mu, R, tb_sign)
         out = _wilson_fix_faces_v3(out, gauge_pl, gauge_pl, psi_pl,
-                                   axis, name, n, mu)
+                                   axis, name, n, mu, exchange, sign_hi)
     return out

@@ -106,6 +106,18 @@ _register("QUDA_TPU_PALLAS_VERSION", "int", 2,
           "autotuner can still select v3 per-shape when it wins)",
           reference="dslash policy selection; tune.cpp:862 — policies "
                     "are timed, never assumed")
+_register("QUDA_TPU_SHARDED_POLICY", "choice", "auto",
+          "multi-chip dslash halo policy: 'xla_facefix' = lax.ppermute "
+          "face fixes around the pallas interior (GSPMD collective-"
+          "permute transport); 'fused_halo' = in-kernel RDMA slab "
+          "exchange, both directions behind one neighbour barrier "
+          "(parallel/pallas_halo.slab_exchange_bidir, the NVSHMEM "
+          "analog); 'auto' = race both per (volume, mesh) via "
+          "utils.tune on first application and cache the winner "
+          "(QUDA-policy-engine style)",
+          ("", "auto", "xla_facefix", "fused_halo"),
+          reference="dslash policy engine lib/dslash_policy.hpp:"
+                    "365-560,1566-1675 + QUDA_ENABLE_NVSHMEM")
 _register("QUDA_TPU_PALLAS_VMEM_MB", "float", 6.0,
           "single-buffer VMEM budget (MB) for pallas z-block selection "
           "(_pick_bz).  Default 6 leaves half the 16 MB scoped limit "
@@ -226,7 +238,8 @@ SUBSUMED = {
     "QUDA_ENABLE_P2P": "XLA collectives over ICI",
     "QUDA_ENABLE_GDR": "XLA collectives over ICI",
     "QUDA_ENABLE_GDR_BLACKLIST": "XLA collectives over ICI",
-    "QUDA_ENABLE_NVSHMEM": "GSPMD collective-permute halo path",
+    "QUDA_ENABLE_NVSHMEM": "QUDA_TPU_SHARDED_POLICY=fused_halo "
+                           "(in-kernel RDMA halo)",
     "QUDA_ENABLE_MPS": "single-process PJRT runtime",
     "QUDA_ENABLE_ZERO_COPY": "device_put / donation semantics",
     "QUDA_REORDER_LOCATION": "host<->device packing in fields/",

@@ -7,17 +7,16 @@ import jax.numpy as jnp
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-# The whole file drives jax.shard_map (the top-level API with check_vma,
-# jax >= 0.6); older environments (the seed image ships 0.4.x, where
-# only jax.experimental.shard_map with different kwargs exists) cannot
-# run these paths AT ALL — a capability probe, not a pin, so any jax
-# providing the API runs the tests.  Guarding keeps tier-1 output clean:
-# a red here is a real regression, not environment noise.
+from quda_tpu.parallel import compat
+
+# The file drives shard_map through the compat seam
+# (parallel/compat.py), which resolves either the top-level
+# jax.shard_map (check_vma) or the 0.4.x experimental one (check_rep) —
+# a capability probe, not a version pin; environments with neither skip
+# cleanly so a red here is a real regression, not environment noise.
 pytestmark = pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
-    reason="jax.shard_map not available in this jax version "
-           "(pre-existing environment limitation at seed; the sharded "
-           "pallas policy requires the top-level shard_map API)")
+    not compat.has_shard_map(),
+    reason="no shard_map API in this jax version")
 
 from quda_tpu.fields.geometry import LatticeGeometry
 from quda_tpu.fields.gauge import GaugeField
@@ -29,6 +28,7 @@ from quda_tpu.parallel.mesh import make_lattice_mesh
 from quda_tpu.parallel.pallas_dslash import dslash_pallas_sharded
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("grid", [(4, 2, 1, 1), (2, 4, 1, 1),
                                   (8, 1, 1, 1)])
 def test_sharded_pallas_matches_single_device(grid):
@@ -52,11 +52,11 @@ def test_sharded_pallas_matches_single_device(grid):
     psi_spec = P(None, None, None, "t", "z", None)
     g_spec = P(None, None, None, None, "t", "z", None)
 
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         lambda g, gb, p: dslash_pallas_sharded(g, gb, p, X, mesh,
                                                interpret=True),
         mesh=mesh, in_specs=(g_spec, g_spec, psi_spec),
-        out_specs=psi_spec, check_vma=False)
+        out_specs=psi_spec)
 
     gp_s = jax.device_put(gp, NamedSharding(mesh, g_spec))
     gbw_s = jax.device_put(gbw, NamedSharding(mesh, g_spec))
@@ -67,6 +67,7 @@ def test_sharded_pallas_matches_single_device(grid):
     assert err < 1e-6
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("grid", [(4, 2, 1, 1), (2, 4, 1, 1),
                                   (8, 1, 1, 1)])
 def test_sharded_pallas_v3_matches_single_device(grid):
@@ -90,11 +91,11 @@ def test_sharded_pallas_v3_matches_single_device(grid):
     psi_spec = P(None, None, None, "t", "z", None)
     g_spec = P(None, None, None, None, "t", "z", None)
 
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         lambda g, p: dslash_pallas_sharded_v3(g, p, X, mesh,
                                               interpret=True),
         mesh=mesh, in_specs=(g_spec, psi_spec),
-        out_specs=psi_spec, check_vma=False)
+        out_specs=psi_spec)
 
     gp_s = jax.device_put(gp, NamedSharding(mesh, g_spec))
     pp_s = jax.device_put(pp, NamedSharding(mesh, psi_spec))
@@ -128,11 +129,10 @@ def test_sharded_staggered_v3_matches_single_device(grid):
     mesh = make_lattice_mesh(grid=grid, n_src=1)
     psi_spec = P(None, None, "t", "z", None)
     g_spec = P(None, None, None, None, "t", "z", None)
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         lambda g, p: dslash_staggered_pallas_sharded_v3(
             g, p, X, mesh, interpret=True),
-        mesh=mesh, in_specs=(g_spec, psi_spec), out_specs=psi_spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(g_spec, psi_spec), out_specs=psi_spec)
     fat_s = jax.device_put(fat_pp, NamedSharding(mesh, g_spec))
     psi_s = jax.device_put(psi_pp, NamedSharding(mesh, psi_spec))
     out = jax.jit(fn)(fat_s, psi_s)
@@ -140,6 +140,7 @@ def test_sharded_staggered_v3_matches_single_device(grid):
     assert err < 1e-6
 
 
+@pytest.mark.slow
 def test_sharded_improved_staggered_v3_matches_single_device():
     """Improved staggered (fat + 3-hop Naik): the 3-plane slab fixes per
     partitioned direction must bit-match the single-device stencil.
@@ -165,11 +166,11 @@ def test_sharded_improved_staggered_v3_matches_single_device():
     mesh = make_lattice_mesh(grid=(4, 2, 1, 1), n_src=1)
     psi_spec = P(None, None, "t", "z", None)
     g_spec = P(None, None, None, None, "t", "z", None)
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         lambda f, l, p: dslash_staggered_pallas_sharded_v3(
             f, p, X, mesh, long_pl=l, interpret=True),
         mesh=mesh, in_specs=(g_spec, g_spec, psi_spec),
-        out_specs=psi_spec, check_vma=False)
+        out_specs=psi_spec)
     fat_s = jax.device_put(fat_pp, NamedSharding(mesh, g_spec))
     long_s = jax.device_put(long_pp, NamedSharding(mesh, g_spec))
     psi_s = jax.device_put(psi_pp, NamedSharding(mesh, psi_spec))
@@ -178,6 +179,7 @@ def test_sharded_improved_staggered_v3_matches_single_device():
     assert err < 1e-6
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("parity", [0, 1])
 def test_sharded_wilson_eo_v3_matches_single_device(parity):
     """Checkerboarded Wilson hop (the CG hot loop) under shard_map == the
@@ -207,11 +209,11 @@ def test_sharded_wilson_eo_v3_matches_single_device(parity):
     mesh = make_lattice_mesh(grid=(4, 2, 1, 1), n_src=1)
     psi_spec = P(None, None, None, "t", "z", None)
     g_spec = P(None, None, None, None, "t", "z", None)
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         lambda uh, ut, p: dslash_eo_pallas_sharded_v3(
             uh, ut, p, dims, parity, mesh, interpret=True),
         mesh=mesh, in_specs=(g_spec, g_spec, psi_spec),
-        out_specs=psi_spec, check_vma=False)
+        out_specs=psi_spec)
     uh_s = jax.device_put(g_eo_pp[parity], NamedSharding(mesh, g_spec))
     ut_s = jax.device_put(g_eo_pp[1 - parity], NamedSharding(mesh, g_spec))
     src_s = jax.device_put(src_pp, NamedSharding(mesh, psi_spec))
@@ -220,6 +222,7 @@ def test_sharded_wilson_eo_v3_matches_single_device(parity):
     assert err < 1e-6
 
 
+@pytest.mark.slow
 def test_sharded_wilson_eo_operator_solve_path():
     """The operator-level wiring: DiracWilsonPCPacked.pairs(mesh=...)
     runs MdagM through the sharded eo pallas policy and matches the
@@ -250,6 +253,7 @@ def test_sharded_wilson_eo_operator_solve_path():
     assert err < 1e-5
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("parity", [0, 1])
 def test_sharded_staggered_eo_v3_matches_single_device(parity):
     """Checkerboarded improved-staggered hop (the complex-free staggered
@@ -288,13 +292,13 @@ def test_sharded_staggered_eo_v3_matches_single_device(parity):
     mesh = make_lattice_mesh(grid=(4, 2, 1, 1), n_src=1)
     psi_spec = P(None, None, "t", "z", None)
     g_spec = P(None, None, None, None, "t", "z", None)
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         lambda fh, ft, lh, lt, p: dslash_staggered_eo_pallas_sharded_v3(
             fh, ft, p, dims, parity, mesh, long_here_pl=lh,
             long_there_pl=lt, interpret=True),
         mesh=mesh,
         in_specs=(g_spec, g_spec, g_spec, g_spec, psi_spec),
-        out_specs=psi_spec, check_vma=False)
+        out_specs=psi_spec)
     args = [jax.device_put(a, NamedSharding(mesh, g_spec))
             for a in (fat_eo_pp[parity], fat_eo_pp[1 - parity],
                       long_eo_pp[parity], long_eo_pp[1 - parity])]
@@ -302,3 +306,196 @@ def test_sharded_staggered_eo_v3_matches_single_device(parity):
     out = jax.jit(fn)(*args, src_s)
     err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
     assert err < 1e-6
+
+
+# -- round 8: v2-form sharded eo policy + the policy engine -----------------
+
+def _eo_fixture(key1=51, key2=52, fold_t=True, shape=(4, 4, 8, 16)):
+    """(dims, g_eo_pp, (pe, po)) on an eo-test geometry (ctor order
+    x,y,z,t; partitioned local extents must come out even); folded
+    antiperiodic t so the reconstruct-12 shard-edge signs are actually
+    exercised."""
+    from quda_tpu.fields.spinor import even_odd_split
+    from quda_tpu.ops.boundary import apply_t_boundary
+    from quda_tpu.ops.wilson import split_gauge_eo
+    geom = LatticeGeometry(shape)
+    dims = geom.lattice_shape
+    gauge = GaugeField.random(jax.random.PRNGKey(key1), geom
+                              ).data.astype(jnp.complex64)
+    if fold_t:
+        gauge = apply_t_boundary(gauge, geom, -1)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(key2), geom
+                                    ).data.astype(jnp.complex64)
+    g_eo = split_gauge_eo(gauge, geom)
+    g_eo_pp = tuple(wpk.to_packed_pairs(wpk.pack_gauge(g), jnp.float32)
+                    for g in g_eo)
+    return dims, g_eo_pp, even_odd_split(psi, geom)
+
+
+def _run_sharded_eo_v2(dims, g_eo_pp, parity, src_pp, policy,
+                       recon12=False, grid=(4, 2, 1, 1), n_dev=8):
+    from quda_tpu.parallel.pallas_dslash import dslash_eo_pallas_sharded
+    mesh = make_lattice_mesh(grid=grid, n_src=1,
+                             devices=jax.devices()[:n_dev])
+    psi_spec = P(None, None, None, "t", "z", None)
+    g_spec = P(None, None, None, None, "t", "z", None)
+    uh, ut = g_eo_pp[parity], g_eo_pp[1 - parity]
+    if recon12:
+        uh, ut = wpp.to_recon12(uh), wpp.to_recon12(ut)
+    # GLOBAL pre-shift of the backward links, THEN shard: the cross-
+    # shard links are then already resident per shard (the v2 design)
+    u_bw = wpp.backward_gauge_eo(ut, dims, parity)
+    fn = compat.shard_map(
+        lambda a, b, p: dslash_eo_pallas_sharded(
+            a, b, p, dims, parity, mesh, interpret=True, policy=policy),
+        mesh=mesh, in_specs=(g_spec, g_spec, psi_spec),
+        out_specs=psi_spec)
+    uh_s = jax.device_put(uh, NamedSharding(mesh, g_spec))
+    ub_s = jax.device_put(u_bw, NamedSharding(mesh, g_spec))
+    src_s = jax.device_put(src_pp, NamedSharding(mesh, psi_spec))
+    return jax.jit(fn)(uh_s, ub_s, src_s)
+
+
+@pytest.mark.parametrize("parity", [0, 1])
+def test_sharded_wilson_eo_v2_matches_single_device(parity):
+    """THE round-8 acceptance test: the v2 (gather, pre-shifted backward
+    links) eo kernel — the measured single-chip winner, PERF.md round 5
+    — under shard_map bit-matches the single-device eo pair stencil for
+    both parities (the sharded path no longer pays the 3.2x scatter-form
+    tax; VERDICT r7 #5)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 virtual devices")
+    # tiny geometry + a 2x2 grid over 4 devices: the interpret-mode
+    # compile dominates, and this test must stay inside the 30s
+    # non-slow budget (tier-1 wall clock) — the 4-shard/edge-sign
+    # coverage lives in the slow recon-12 variants below
+    dims, g_eo_pp, (pe, po) = _eo_fixture(shape=(4, 4, 4, 8))
+    src = pe if parity == 1 else po
+    src_pp = wpk.to_packed_pairs(wpk.pack_spinor(src), jnp.float32)
+    ref = wpk.dslash_eo_packed_pairs(g_eo_pp, src_pp, dims, parity)
+    out = _run_sharded_eo_v2(dims, g_eo_pp, parity, src_pp,
+                             "xla_facefix", grid=(2, 2, 1, 1), n_dev=4)
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-6
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("parity", [0, 1])
+def test_sharded_wilson_eo_v2_recon12_matches_single_device(parity):
+    """recon-18-only restriction lifted: the sharded v2 path accepts
+    reconstruct-12 links (in-kernel interior + _full_rows face slabs
+    with shard-edge t signs) — folded antiperiodic t included, so the
+    boundary-plane row-2 sign logic is live on both the first and last
+    t shards."""
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    dims, g_eo_pp, (pe, po) = _eo_fixture()
+    src = pe if parity == 1 else po
+    src_pp = wpk.to_packed_pairs(wpk.pack_spinor(src), jnp.float32)
+    ref = wpk.dslash_eo_packed_pairs(g_eo_pp, src_pp, dims, parity)
+    out = _run_sharded_eo_v2(dims, g_eo_pp, parity, src_pp,
+                             "xla_facefix", recon12=True)
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-5          # f32 third-row reconstruction floor
+
+
+@pytest.mark.slow
+def test_sharded_wilson_eo_v3_recon12_matches_single_device():
+    """The v3 sharded form accepts reconstruct-12 too (the restriction
+    was on the sharded path as a whole, not one kernel form)."""
+    from quda_tpu.parallel.pallas_dslash import dslash_eo_pallas_sharded_v3
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    parity = 0
+    dims, g_eo_pp, (pe, po) = _eo_fixture()
+    src_pp = wpk.to_packed_pairs(wpk.pack_spinor(po), jnp.float32)
+    ref = wpk.dslash_eo_packed_pairs(g_eo_pp, src_pp, dims, parity)
+    mesh = make_lattice_mesh(grid=(4, 2, 1, 1), n_src=1)
+    psi_spec = P(None, None, None, "t", "z", None)
+    g_spec = P(None, None, None, None, "t", "z", None)
+    uh = wpp.to_recon12(g_eo_pp[parity])
+    ut = wpp.to_recon12(g_eo_pp[1 - parity])
+    fn = compat.shard_map(
+        lambda a, b, p: dslash_eo_pallas_sharded_v3(
+            a, b, p, dims, parity, mesh, interpret=True),
+        mesh=mesh, in_specs=(g_spec, g_spec, psi_spec),
+        out_specs=psi_spec)
+    out = jax.jit(fn)(jax.device_put(uh, NamedSharding(mesh, g_spec)),
+                      jax.device_put(ut, NamedSharding(mesh, g_spec)),
+                      jax.device_put(src_pp,
+                                     NamedSharding(mesh, psi_spec)))
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-5
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not compat.has_dist_interpret(),
+                    reason="fused_halo needs the distributed Mosaic "
+                           "interpreter (pltpu.InterpretParams) off-chip")
+@pytest.mark.parametrize("parity", [0, 1])
+def test_sharded_wilson_eo_v2_fused_halo_matches_facefix(parity):
+    """Policy A/B: the fused in-kernel RDMA slab exchange must be
+    bit-identical to the ppermute face-fix transport (same algebra,
+    different wire)."""
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    dims, g_eo_pp, (pe, po) = _eo_fixture()
+    src = pe if parity == 1 else po
+    src_pp = wpk.to_packed_pairs(wpk.pack_spinor(src), jnp.float32)
+    ref = wpk.dslash_eo_packed_pairs(g_eo_pp, src_pp, dims, parity)
+    out = _run_sharded_eo_v2(dims, g_eo_pp, parity, src_pp,
+                             "fused_halo")
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-6
+
+
+def test_sharded_operator_defaults_to_v2_and_races_policy(tmp_path,
+                                                          monkeypatch):
+    """The model-layer dispatch: a multi-device mesh operator now
+    resolves the kernel form exactly like single-chip (v2 default), and
+    QUDA_TPU_SHARDED_POLICY=auto races the halo policies once per
+    (volume, mesh, form) and caches the winner deterministically in the
+    tunecache (QUDA policy-engine behavior, tune.cpp:862)."""
+    import json
+
+    import quda_tpu.models.wilson as mwil
+    from quda_tpu.models.wilson import DiracWilsonPC
+    from quda_tpu.utils import config as qconf
+    from quda_tpu.utils import tune as qtune
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    monkeypatch.delenv("QUDA_TPU_PALLAS_VERSION", raising=False)
+    monkeypatch.delenv("QUDA_TPU_SHARDED_POLICY", raising=False)
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    qconf.reset_cache()
+    monkeypatch.setattr(qtune, "_cache", {})
+    monkeypatch.setattr(mwil, "_SHARDED_NOTICED", True)
+
+    # smallest legal config (even local extents on a 2x2 t/z grid over
+    # 4 of the virtual devices): the race times ~16 interpret-mode
+    # applications, so the lattice must be tiny to stay in the fast tier
+    geom = LatticeGeometry((4, 4, 4, 4))
+    gauge = GaugeField.random(jax.random.PRNGKey(61), geom
+                              ).data.astype(jnp.complex64)
+    dpk = DiracWilsonPC(gauge, geom, kappa=0.12).packed()
+    mesh = make_lattice_mesh(grid=(2, 2, 1, 1), n_src=1,
+                             devices=jax.devices()[:4])
+    op = dpk.pairs(jnp.float32, use_pallas=True, pallas_interpret=True,
+                   mesh=mesh)
+    assert op._pallas_version == 2          # measured winner, not v3
+    won = op._sharded_policy_winner
+    assert won in ("xla_facefix", "fused_halo")
+    # off-chip without the distributed interpreter the RDMA candidate
+    # cannot run, so the race must settle on the ppermute transport
+    if not compat.has_dist_interpret():
+        assert won == "xla_facefix"
+    # the winner is persisted: the cache file holds exactly one entry
+    # for this (volume, name, aux) and a second operator re-reads it
+    # without re-racing (tune returns the cached param)
+    cache = json.loads((tmp_path / "tunecache.json").read_text())
+    keys = [k for k in cache if "wilson_eo_sharded_policy" in k]
+    assert len(keys) == 1
+    assert cache[keys[0]]["param"] == won
+    op2 = dpk.pairs(jnp.float32, use_pallas=True,
+                    pallas_interpret=True, mesh=mesh)
+    assert op2._sharded_policy_winner == won
