@@ -130,6 +130,23 @@ def record_row(suite: str, row: dict, banner_platform: str = None,
     ok, reason = gate_row(suite, row, banner_platform)
     if ok:
         log(json.dumps(dict({"suite": suite}, **row)))
+        # mirror gated rows into the obs trace stream (bench_suite
+        # --trace) so the chrome artifact carries the measurements next
+        # to the spans/tuner events; scalars only, and never let
+        # observability break a measurement run
+        try:
+            from quda_tpu.obs import trace as _otr
+            if _otr.enabled():
+                # row keys that collide with event()'s own parameters
+                # ('name', 'cat') are prefixed
+                fields = {("row_" + k if k in ("name", "cat") else k): v
+                          for k, v in row.items()
+                          if isinstance(v, (str, int, float, bool))
+                          or v is None}
+                _otr.event("bench_row", cat="bench", suite=suite,
+                           **fields)
+        except Exception:
+            pass
     else:
         log(json.dumps({"suite": suite, "name": row.get("name"),
                         "rejected": reason,

@@ -39,14 +39,19 @@ def _emit(suite, name, secs, flops, bytes_, platform, lattice,
             "platform": platform, "lattice": list(lattice), **extra,
         }), flush=True)
         return
-    # every row passes the roofline/noise/platform gate (bench.gate_row)
-    # — round-5's 1.27e11-GFLOPS rows must die HERE, loudly.  secs is
-    # rounded to 9 digits so a genuine ~1 us marginal cannot quantize
-    # DOWN to the gate's 1e-6 floor and be rejected as noise.
+    # achieved-throughput arithmetic lives in obs/roofline.py (one home
+    # for the flops/secs -> GFLOPS join — the same helper the API solves
+    # attribute with), and every row passes the roofline/noise/platform
+    # gate (bench.gate_row) — round-5's 1.27e11-GFLOPS rows must die
+    # HERE, loudly.  secs is rounded to 9 digits so a genuine ~1 us
+    # marginal cannot quantize DOWN to the gate's 1e-6 floor and be
+    # rejected as noise.
+    from quda_tpu.obs.roofline import achieved
+    th = achieved(flops, bytes_, secs)
     record_row(suite, {
         "name": name,
-        "gflops": round(flops / secs / 1e9, 2),
-        "gbps": round(bytes_ / secs / 1e9, 2),
+        "gflops": th["gflops"],
+        "gbps": th["gbps"],
         "secs_per_call": round(secs, 9),
         "platform": platform, "lattice": list(lattice), **extra,
     }, banner_platform=banner)
@@ -126,6 +131,12 @@ def _bench_fused_reduce(fn, arg, consts=(), n1=8, n2=200, reps=3):
 def main(argv):
     import os
 
+    # --trace: run the whole suite under the obs span tracer and emit
+    # the chrome-trace artifact (bench_trace.json + the JSONL event
+    # stream) next to the bench JSON output; tuner candidate timings
+    # and roofline events land in the same stream
+    do_trace = "--trace" in argv
+
     force_cpu = _conf("QUDA_TPU_BENCH_CPU")
     if force_cpu:
         probe = {"platform": "cpu", "complex_ok": True}
@@ -169,6 +180,10 @@ def main(argv):
 
     suites = set(a for a in argv if not a.startswith("-")) or {
         "blas", "dslash", "solver", "sharded"}
+
+    if do_trace:
+        from quda_tpu.obs import trace as qtrace
+        qtrace.start(os.getcwd(), prefix="bench_trace")
 
     def suite_guard(suite: str) -> bool:
         """Window hygiene (VERDICT r7 #10): every suite re-checks the
@@ -443,13 +458,15 @@ def main(argv):
             the measured seconds (None on failure) so later rows can
             quote cost ratios against this one."""
             try:
+                from quda_tpu.obs.roofline import achieved
                 res, secs = time_solve(solve, b)
                 it = int(_fetch(res.iters))
                 conv = bool(np.asarray(jax.device_get(res.converged)
                                        ).all())
                 record_row("solver", {
                     "name": name, "iters": it, "secs": round(secs, 3),
-                    "gflops": round(it * fl_per_iter / secs / 1e9, 2),
+                    "gflops": achieved(it * fl_per_iter, 0.0,
+                                       secs)["gflops"],
                     "converged": conv, "platform": platform,
                     "lattice": [lattice_l] * 4, **extra},
                     banner_platform=banner)
@@ -1040,6 +1057,13 @@ def main(argv):
             "use_embedding": False,
             "platform": platform, "lattice": [Lm] * 4,
             "n_vec": 8}, banner_platform=banner)
+
+    if do_trace:
+        from quda_tpu.obs import trace as qtrace
+        paths = qtrace.stop()
+        if paths:
+            print(json.dumps({"suite": "harness", "trace": paths}),
+                  flush=True)
 
 
 if __name__ == "__main__":

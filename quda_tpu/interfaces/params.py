@@ -112,6 +112,13 @@ class InvertParam:
     # the per-RHS sums with the volume/2 PC flop convention
     true_res_multi: Sequence[float] = ()
     iter_count_multi: Sequence[int] = ()
+    # convergence trace (populated when QUDA_TPU_TRACE is on —
+    # obs/convergence.py): res_history = per-check-point entries
+    # [{"iter", "r2", "relres"}, ...] (every iteration at cadence 1),
+    # events = reliable_update / restart / breakdown / shift_converged /
+    # cadence markers.  Empty on untraced solves (zero-overhead path).
+    res_history: Sequence = ()
+    events: Sequence = ()
 
     def validate(self):
         _check(self.dslash_type in DSLASH_TYPES,

@@ -39,10 +39,12 @@ _ctx = {
 
 def init_quda(device: int = 0):
     """initQuda analog (device selection is PJRT's job on TPU)."""
+    from ..obs import trace as otr
     from ..utils import config as qconf
     from ..utils import monitor as qmon
     qconf.check_environment()  # warn on typoed / CUDA-era env knobs
     qmon.start_default()       # QUDA_TPU_ENABLE_MONITOR sampling thread
+    otr.maybe_start()          # QUDA_TPU_TRACE span/event session
     _ctx["initialized"] = True
     qlog.printq("initialized", qlog.VERBOSE)
 
@@ -81,8 +83,21 @@ def end_quda():
     _ctx["mg_epoch"] = -1
     from ..utils import monitor as qmon
     qmon.stop_default()
+    # shutdown telemetry flush (endQuda summary semantics): the timer
+    # summary + profile.tsv, the tuner's profiler half (profile_0.tsv),
+    # the roofline rows, and the trace session artifacts
     from ..utils.timer import print_summary
     print_summary()
+    from ..utils import tune as qtune
+    qtune.save_profile()
+    from ..obs import roofline as orf
+    from ..obs import trace as otr
+    orf.save()
+    orf.reset()     # a later init/end cycle must not re-dump these rows
+    paths = otr.stop()
+    if paths:
+        qlog.printq(f"trace artifacts: {paths['chrome']} / "
+                    f"{paths['jsonl']}", qlog.SUMMARIZE)
 
 
 def _require_init():
@@ -422,25 +437,33 @@ def _invert_wilson_df64(b, param: InvertParam, d, sloppy_prec: str,
 
     from .. import solvers
     from ..models.wilson import DiracWilsonPCPacked
+    from ..obs import convergence as oconv
+    from ..obs import trace as otr
     from ..ops import df64 as dfm
     from ..ops import wilson_df64 as wdf
 
-    dpk = d if isinstance(d, DiracWilsonPCPacked) else d.packed()
-    op = wdf.WilsonPCDF64(dpk)
-    be, bo = _split(b, param)
-    rhs_df = op.prepare_df(be, bo)
+    recording = otr.enabled()
+    with otr.phase("setup", "invert_quda"):
+        dpk = d if isinstance(d, DiracWilsonPCPacked) else d.packed()
+        op = wdf.WilsonPCDF64(dpk)
+        be, bo = _split(b, param)
+        rhs_df = op.prepare_df(be, bo)
 
-    if sloppy_prec == "quarter":
-        qlog.printq("df64 route has no int8 pair codec; sloppy storage "
-                    "runs at bf16 ('half')", qlog.SUMMARIZE)
-    store = jnp.bfloat16 if sloppy_prec in ("half", "quarter") \
-        else jnp.float32
-    sl = dpk.pairs(store, use_pallas=_pallas_enabled(on_tpu),
-                   pallas_interpret=_pallas_interpret(on_tpu))
-    codec = solvers.pair_inplace_codec(store)
-    res = solvers.cg_reliable_df(
-        op, sl.MdagM_pairs, rhs_df, codec, tol=param.tol,
-        maxiter=param.maxiter, delta=param.reliable_delta)
+        if sloppy_prec == "quarter":
+            qlog.printq("df64 route has no int8 pair codec; sloppy "
+                        "storage runs at bf16 ('half')", qlog.SUMMARIZE)
+        store = jnp.bfloat16 if sloppy_prec in ("half", "quarter") \
+            else jnp.float32
+        sl = dpk.pairs(store, use_pallas=_pallas_enabled(on_tpu),
+                       pallas_interpret=_pallas_interpret(on_tpu))
+        codec = solvers.pair_inplace_codec(store)
+    with otr.phase("compute", "invert_quda"), \
+            otr.span("solve:cg_reliable_df64", cat="solver",
+                     tol=param.tol):
+        res = solvers.cg_reliable_df(
+            op, sl.MdagM_pairs, rhs_df, codec, tol=param.tol,
+            maxiter=param.maxiter, delta=param.reliable_delta,
+            record=recording)
 
     xe_df, xo_df = op.reconstruct_df(res.x, be, bo)
     fr2 = float(dfm.to_f32(op.full_residual_norm2(xe_df, xo_df, be, bo)))
@@ -459,178 +482,312 @@ def _invert_wilson_df64(b, param: InvertParam, d, sloppy_prec: str,
     # accounting note)
     sites = _ctx["geom"].volume // 2
     param.gflops = (param.iter_count * 2.0 * flops * sites) / 1e9
+    if recording:
+        # the recorded curve is the normal-equation residual and the
+        # solver ships its own |Mdag b|^2 in the history dict, which
+        # harvest prefers over this direct-system fallback
+        b2_sys = float(dfm.to_f32(dfm.norm2(rhs_df)))
+        oconv.publish(oconv.harvest("cg-reliable-df64", res,
+                                    tol=param.tol, b2=b2_sys), param)
     qlog.printq(
         f"invert_quda[wilson/cg/df64]: {param.iter_count} iters, "
         f"true_res {param.true_res:.2e}, {param.secs:.2f} s")
     return x_full
 
 
+def _solve_form(d) -> str:
+    """Kernel-form label for roofline attribution (obs/roofline.py):
+    conservative — only forms whose PERF.md traffic model provably
+    matches the executing kernel get a specific label; everything else
+    is 'generic' (flop attribution only, no bandwidth claim)."""
+    op = getattr(d, "op", d)
+    name = type(op).__name__.lower()
+    if "wilson" in name and getattr(op, "use_pallas", False):
+        v = getattr(op, "_pallas_version", None)
+        # reconstruct-12 storage is visible in the resident link shape
+        # (rows kept: 2 instead of 3 — models/wilson.to_recon12), which
+        # is authoritative even if QUDA_TPU_RECONSTRUCT changed after
+        # operator construction; it shrinks the gauge traffic the
+        # roofline model charges, so the label must carry it
+        gpp = getattr(op, "gauge_eo_pp", None)
+        r12 = (gpp is not None and len(gpp) > 0
+               and gpp[0].shape[1] == 2)
+        suffix = "_r12" if r12 else ""
+        if getattr(op, "_mesh", None) is not None and v in (2, 3):
+            return f"wilson_sharded_v{v}{suffix}"
+        if v in (2, 3):
+            return f"wilson_v{v}{suffix}"
+    if "wilson" in name:
+        return "wilson_xla"
+    return "generic"
+
+
 def invert_quda(source, param: InvertParam):
     """invertQuda: solve M x = b per param; returns x, mutates param
-    result fields (true_res, iter_count, secs, gflops)."""
+    result fields (true_res, iter_count, secs, gflops; with
+    QUDA_TPU_TRACE also res_history/events — obs/convergence.py)."""
     _require_init()
     param.validate()
-    from .. import solvers
+    from ..obs import trace as otr
+    with otr.api_span("invert_quda", dslash=param.dslash_type,
+                      inv=param.inv_type, tol=param.tol):
+        return _invert_quda_body(source, param)
 
+
+def _invert_quda_body(source, param: InvertParam):
+    from .. import solvers
+    from ..obs import convergence as oconv
+    from ..obs import trace as otr
+
+    recording = otr.enabled()
     dtype = complex_dtype(param.cuda_prec)
     b = jnp.asarray(source, dtype)
     t0 = time.perf_counter()
     pc = param.solve_type.endswith("-pc")
-    d = _build_dirac(param, pc)
-    d_full = _build_dirac(param, False)
-
-    # Mixed-precision gate (computed early: the layout choice below must
-    # not apply to representation combinations it cannot serve).  QUDA
-    # threads matSloppy through every solver (include/invert_quda.h:369);
-    # the TPU ladder (utils/precision.py) has two genuinely distinct
-    # sloppy levels: a lower complex dtype (double->single, CPU only) and
-    # bf16/int8 pair storage ("half"/"quarter" — ops/pair.py).
-    sloppy_prec = _resolve_sloppy(param)
-    on_tpu = jax.default_backend() == "tpu"
-    # complex-free staggered pair adapter: CG-family solves only (its
-    # coefficients are real on the Hermitian PC operator, so the pair
-    # representation is exact; bicgstab/gcr would feed pair residuals
-    # into the complex wrappers), and never silently degrade an f64
-    # solve to the f32 pair representation (on TPU f64 does not exist,
-    # so the adapter is the only executable path there)
-    # shared pair-adapter gate: CG-family solves only (their
-    # coefficients are real — exact on the pair representation), never
-    # silently degrading an f64 solve to f32 pairs
-    pairs_ok = (pc
-                and param.inv_type in ("cg", "pcg", "cg3", "cgne",
-                                       "cgnr")
-                and (param.cuda_prec == "single" or on_tpu)
-                and _packed_enabled(on_tpu))
-    stag_pairs = pairs_ok and param.dslash_type in ("staggered", "asqtad",
-                                                    "hisq")
-    # complex-free adapter for the non-Hermitian PC families (cg routes
-    # through the normal equations, whose coefficients are real)
-    pair_op = pairs_ok and param.dslash_type in (
-        "domain-wall", "domain-wall-4d", "mobius", "mobius-eofa",
-        "clover", "twisted-mass", "twisted-clover", "ndeg-twisted-mass",
-        "ndeg-twisted-clover")
-    # pallas-dslash-in-solver routing for Wilson PC (kernel-form selection
-    # threaded from utils/config.py: QUDA_TPU_PALLAS gates it on/off,
-    # QUDA_TPU_PALLAS_VERSION picks the kernel generation — v2 by chip
-    # measurement).  'quarter' keeps the canonical int8-codec path.
-    wil_pairs = (pairs_ok and param.dslash_type == "wilson"
-                 and _pallas_enabled(on_tpu)
-                 and sloppy_prec != "quarter")
-    pair_sloppy = (sloppy_prec in ("half", "quarter")
-                   and ((param.dslash_type == "wilson" and pc)
-                        or stag_pairs or pair_op))
-    dtype_sloppy = (sloppy_prec != param.cuda_prec
-                    and complex_dtype(sloppy_prec) != complex_dtype(
-                        param.cuda_prec))
-    mixed = (param.inv_type == "cg" and (pair_sloppy or dtype_sloppy))
-    # a canonical dtype-sloppy operator cannot consume pair iterates
-    # (same exclusion as the wilson packed gate below)
-    pair_excluded = mixed and dtype_sloppy and not pair_sloppy
-    stag_pairs = stag_pairs and not pair_excluded
-    pair_op = pair_op and not pair_excluded
-    wil_pairs = wil_pairs and not pair_excluded
-
-    # TPU-native packed device order for the Wilson PC solve path (QUDA
-    # keeps solver fields in native FloatN order the same way); default
-    # on TPU, opt-in/out anywhere via QUDA_TPU_PACKED=1/0.  Skipped for
-    # the dtype-sloppy mixed path (its canonical sloppy operator cannot
-    # consume packed iterates) and for 'quarter' (the int8 gauge codec
-    # lives on the canonical layout).
-    if (param.dslash_type == "wilson" and pc
-            and _packed_enabled(on_tpu)
-            and not (mixed and dtype_sloppy and not pair_sloppy)
-            and sloppy_prec != "quarter"):
-        d = d.packed()
-
-    # Extended-precision (df64) route: deep-tolerance Wilson CG where no
-    # f64 backend serves (TPU always; CPU when the precise dtype is f32).
-    # The fp64-matPrecise + dbldbl-reduction analog (lib/inv_cg_quda.cpp:63,
-    # include/dbldbl.h): precise side in float32-pair arithmetic, sloppy
-    # loop unchanged.  QUDA_TPU_DF64: '' auto / '1' force / '0' off.
-    from ..utils import config as qconf
-    df64_mode = str(qconf.get("QUDA_TPU_DF64", fresh=True))
-    # precision guard even when forced: the route certifies the residual
-    # of the f32-valued system, so an f64 source (CPU double path, which
-    # the native f64 solve already serves) must never be silently rounded
-    # into a false 1e-10 certificate; packed opt-out honored because the
-    # df64 stencil lives on the packed layout
-    df64_able = (param.dslash_type == "wilson" and pc
-                 and param.inv_type == "cg" and not param.num_offset
-                 and (on_tpu or param.cuda_prec == "single")
-                 and _packed_enabled(on_tpu))
-    if df64_able and df64_mode != "0" and (
-            df64_mode == "1" or param.tol < 5e-8):
-        return _invert_wilson_df64(b, param, d, sloppy_prec, on_tpu, t0)
-    if stag_pairs:
-        # complex-free staggered solve loop (pair representation end to
-        # end; the pallas eo stencil on real TPU).  'quarter' storage has
-        # no staggered int8 codec — the sloppy op falls back to bf16.
-        d = _StaggeredPairsSolve(d, _pallas_enabled(on_tpu),
-                                 _pallas_interpret(on_tpu))
-    elif pair_op:
-        d = _PairOpSolve(d, _pallas_enabled(on_tpu),
-                         _pallas_interpret(on_tpu))
-    elif wil_pairs:
-        from ..models.wilson import DiracWilsonPCPacked
-        if isinstance(d, DiracWilsonPCPacked):
-            # the hand-tuned eo kernel runs inside the compiled Krylov
-            # loop (interpret-mode off TPU so the routing is testable on
-            # CPU hosts)
-            d = _WilsonPairsSolve(d, _pallas_interpret(on_tpu))
-
-    if pc:
-        be, bo = _split(b, param, d)
-        rhs = d.prepare(be, bo)
-    else:
-        rhs = b
-
-    normop = param.solve_type.startswith("normop")
-    hermitian_pc = getattr(d, "hermitian", False)
-
-    if param.num_offset:
-        qlog.errorq("use invert_multishift_quda for shifted solves")
-
-    if hermitian_pc:           # staggered PC: already the normal operator
-        mv = d.M
-        sys_rhs = rhs
-        back = lambda x: x
-        mv_applies = 1.0
-    elif normop:
-        mv = lambda v: d.Mdag(d.M(v))
-        sys_rhs = d.Mdag(rhs)
-        back = lambda x: x
-        mv_applies = 2.0
-    else:
-        mv = d.M
-        sys_rhs = rhs
-        back = lambda x: x
-        mv_applies = 1.0
-
     inv = param.inv_type
-    if inv == "cg" and not (hermitian_pc or normop):
-        # QUDA's solve-type matrix (lib/solve.cpp:180): CG + direct solve
-        # is routed through the normal RESIDUAL equations (CGNR).  Users
-        # wanting the normal-ERROR form should pick inv_type="cgne".
-        qlog.warningq("cg on a non-normal system; using CGNR "
-                      "(normal-residual) semantics")
-        mv = lambda v: d.Mdag(d.M(v))
-        sys_rhs = d.Mdag(rhs)
-        mv_applies = 2.0
+    with otr.phase("setup", "invert_quda"):
+        d = _build_dirac(param, pc)
+        d_full = _build_dirac(param, False)
 
-    # direct-route solvers that internally apply the operator more than
-    # once per counted iteration (cgne/cgnr compose Mdag themselves,
-    # BiCGStab does two mat-vecs per iteration).  Hermitian-PC systems
-    # run these as plain one-apply CG — no bump.  cg3's recursion is one
-    # apply per counted iteration.
-    if (mv_applies == 1.0 and not hermitian_pc
-            and inv in ("cgne", "cgnr", "bicgstab")):
-        mv_applies = 2.0
-    # BiCGStab(L) needs NO bump: solvers/bicgstab.bicgstab_l counts
-    # MATVEC APPLICATIONS as iterations (k += 2L per cycle = exactly the
-    # 2L operator applies the cycle performs), so each counted iteration
-    # is already one mv apply.  The old flat 2.0 treated the count as
-    # cycles and over-reported its gflops 2x; charging L+1 per counted
-    # iteration would over-report (L+1)x.
+        # Mixed-precision gate (computed early: the layout choice below
+        # must not apply to representation combinations it cannot serve).
+        # QUDA threads matSloppy through every solver
+        # (include/invert_quda.h:369); the TPU ladder
+        # (utils/precision.py) has two genuinely distinct sloppy levels:
+        # a lower complex dtype (double->single, CPU only) and bf16/int8
+        # pair storage ("half"/"quarter" — ops/pair.py).
+        sloppy_prec = _resolve_sloppy(param)
+        on_tpu = jax.default_backend() == "tpu"
+        # complex-free staggered pair adapter: CG-family solves only (its
+        # coefficients are real on the Hermitian PC operator, so the pair
+        # representation is exact; bicgstab/gcr would feed pair residuals
+        # into the complex wrappers), and never silently degrade an f64
+        # solve to the f32 pair representation (on TPU f64 does not
+        # exist, so the adapter is the only executable path there)
+        # shared pair-adapter gate: CG-family solves only (their
+        # coefficients are real — exact on the pair representation),
+        # never silently degrading an f64 solve to f32 pairs
+        pairs_ok = (pc
+                    and param.inv_type in ("cg", "pcg", "cg3", "cgne",
+                                           "cgnr")
+                    and (param.cuda_prec == "single" or on_tpu)
+                    and _packed_enabled(on_tpu))
+        stag_pairs = pairs_ok and param.dslash_type in ("staggered",
+                                                        "asqtad", "hisq")
+        # complex-free adapter for the non-Hermitian PC families (cg
+        # routes through the normal equations, whose coefficients are
+        # real)
+        pair_op = pairs_ok and param.dslash_type in (
+            "domain-wall", "domain-wall-4d", "mobius", "mobius-eofa",
+            "clover", "twisted-mass", "twisted-clover",
+            "ndeg-twisted-mass", "ndeg-twisted-clover")
+        # pallas-dslash-in-solver routing for Wilson PC (kernel-form
+        # selection threaded from utils/config.py: QUDA_TPU_PALLAS gates
+        # it on/off, QUDA_TPU_PALLAS_VERSION picks the kernel generation
+        # — v2 by chip measurement).  'quarter' keeps the canonical
+        # int8-codec path.
+        wil_pairs = (pairs_ok and param.dslash_type == "wilson"
+                     and _pallas_enabled(on_tpu)
+                     and sloppy_prec != "quarter")
+        pair_sloppy = (sloppy_prec in ("half", "quarter")
+                       and ((param.dslash_type == "wilson" and pc)
+                            or stag_pairs or pair_op))
+        dtype_sloppy = (sloppy_prec != param.cuda_prec
+                        and complex_dtype(sloppy_prec) != complex_dtype(
+                            param.cuda_prec))
+        mixed = (param.inv_type == "cg" and (pair_sloppy or dtype_sloppy))
+        # a canonical dtype-sloppy operator cannot consume pair iterates
+        # (same exclusion as the wilson packed gate below)
+        pair_excluded = mixed and dtype_sloppy and not pair_sloppy
+        stag_pairs = stag_pairs and not pair_excluded
+        pair_op = pair_op and not pair_excluded
+        wil_pairs = wil_pairs and not pair_excluded
+
+        # TPU-native packed device order for the Wilson PC solve path
+        # (QUDA keeps solver fields in native FloatN order the same way);
+        # default on TPU, opt-in/out anywhere via QUDA_TPU_PACKED=1/0.
+        # Skipped for the dtype-sloppy mixed path (its canonical sloppy
+        # operator cannot consume packed iterates) and for 'quarter'
+        # (the int8 gauge codec lives on the canonical layout).
+        if (param.dslash_type == "wilson" and pc
+                and _packed_enabled(on_tpu)
+                and not (mixed and dtype_sloppy and not pair_sloppy)
+                and sloppy_prec != "quarter"):
+            d = d.packed()
+
+        # Extended-precision (df64) route: deep-tolerance Wilson CG where
+        # no f64 backend serves (TPU always; CPU when the precise dtype
+        # is f32).  The fp64-matPrecise + dbldbl-reduction analog
+        # (lib/inv_cg_quda.cpp:63, include/dbldbl.h): precise side in
+        # float32-pair arithmetic, sloppy loop unchanged.
+        # QUDA_TPU_DF64: '' auto / '1' force / '0' off.
+        from ..utils import config as qconf
+        df64_mode = str(qconf.get("QUDA_TPU_DF64", fresh=True))
+        # precision guard even when forced: the route certifies the
+        # residual of the f32-valued system, so an f64 source (CPU double
+        # path, which the native f64 solve already serves) must never be
+        # silently rounded into a false 1e-10 certificate; packed opt-out
+        # honored because the df64 stencil lives on the packed layout
+        df64_able = (param.dslash_type == "wilson" and pc
+                     and param.inv_type == "cg" and not param.num_offset
+                     and (on_tpu or param.cuda_prec == "single")
+                     and _packed_enabled(on_tpu))
+        df64_route = df64_able and df64_mode != "0" and (
+            df64_mode == "1" or param.tol < 5e-8)
+        if not df64_route:
+            if stag_pairs:
+                # complex-free staggered solve loop (pair representation
+                # end to end; the pallas eo stencil on real TPU).
+                # 'quarter' storage has no staggered int8 codec — the
+                # sloppy op falls back to bf16.
+                d = _StaggeredPairsSolve(d, _pallas_enabled(on_tpu),
+                                         _pallas_interpret(on_tpu))
+            elif pair_op:
+                d = _PairOpSolve(d, _pallas_enabled(on_tpu),
+                                 _pallas_interpret(on_tpu))
+            elif wil_pairs:
+                from ..models.wilson import DiracWilsonPCPacked
+                if isinstance(d, DiracWilsonPCPacked):
+                    # the hand-tuned eo kernel runs inside the compiled
+                    # Krylov loop (interpret-mode off TPU so the routing
+                    # is testable on CPU hosts)
+                    d = _WilsonPairsSolve(d, _pallas_interpret(on_tpu))
+
+            if pc:
+                be, bo = _split(b, param, d)
+                rhs = d.prepare(be, bo)
+            else:
+                rhs = b
+
+            normop = param.solve_type.startswith("normop")
+            hermitian_pc = getattr(d, "hermitian", False)
+
+            if param.num_offset:
+                qlog.errorq("use invert_multishift_quda for shifted "
+                            "solves")
+
+            if hermitian_pc:   # staggered PC: already the normal operator
+                mv = d.M
+                sys_rhs = rhs
+                back = lambda x: x
+                mv_applies = 1.0
+            elif normop:
+                mv = lambda v: d.Mdag(d.M(v))
+                sys_rhs = d.Mdag(rhs)
+                back = lambda x: x
+                mv_applies = 2.0
+            else:
+                mv = d.M
+                sys_rhs = rhs
+                back = lambda x: x
+                mv_applies = 1.0
+
+            if inv == "cg" and not (hermitian_pc or normop):
+                # QUDA's solve-type matrix (lib/solve.cpp:180): CG +
+                # direct solve is routed through the normal RESIDUAL
+                # equations (CGNR).  Users wanting the normal-ERROR form
+                # should pick inv_type="cgne".
+                qlog.warningq("cg on a non-normal system; using CGNR "
+                              "(normal-residual) semantics")
+                mv = lambda v: d.Mdag(d.M(v))
+                sys_rhs = d.Mdag(rhs)
+                mv_applies = 2.0
+
+            # direct-route solvers that internally apply the operator
+            # more than once per counted iteration (cgne/cgnr compose
+            # Mdag themselves, BiCGStab does two mat-vecs per iteration).
+            # Hermitian-PC systems run these as plain one-apply CG — no
+            # bump.  cg3's recursion is one apply per counted iteration.
+            if (mv_applies == 1.0 and not hermitian_pc
+                    and inv in ("cgne", "cgnr", "bicgstab")):
+                mv_applies = 2.0
+            # BiCGStab(L) needs NO bump: solvers/bicgstab.bicgstab_l
+            # counts MATVEC APPLICATIONS as iterations (k += 2L per cycle
+            # = exactly the 2L operator applies the cycle performs), so
+            # each counted iteration is already one mv apply.  The old
+            # flat 2.0 treated the count as cycles and over-reported its
+            # gflops 2x; charging L+1 per counted iteration would
+            # over-report (L+1)x.
+
+    if df64_route:
+        return _invert_wilson_df64(b, param, d, sloppy_prec, on_tpu, t0)
+
+    t_solve0 = time.perf_counter()
+    with otr.phase("compute", "invert_quda"), \
+            otr.span(f"solve:{inv}", cat="solver", tol=param.tol,
+                     maxiter=param.maxiter):
+        # keyword-only at the call site: four adjacent bools among 17
+        # parameters — a positional transposition would type-check and
+        # silently pick the wrong solve route
+        res = _invert_dispatch(param=param, d=d, d_full=d_full, b=b,
+                               rhs=rhs, sys_rhs=sys_rhs, mv=mv,
+                               mv_applies=mv_applies, inv=inv,
+                               mixed=mixed, pair_sloppy=pair_sloppy,
+                               hermitian_pc=hermitian_pc, normop=normop,
+                               sloppy_prec=sloppy_prec, dtype=dtype,
+                               pc=pc, t0=t0, recording=recording)
+    if not isinstance(res, tuple):
+        return res             # gcr-mg handled everything itself
+    res, publish_sys_rhs = res
+    t_solve = time.perf_counter() - t_solve0
+
+    with otr.phase("epilogue", "invert_quda"):
+        x_sys = back(res.x)
+        if pc:
+            xe, xo = d.reconstruct(x_sys, be, bo)
+            x_full = _join(xe, xo, param, d)
+        else:
+            x_full = x_sys
+
+        param.iter_count = int(res.iters)
+        param.secs = time.perf_counter() - t0
+        r = b - d_full.M(x_full)
+        param.true_res = float(jnp.sqrt(blas.norm2(r) / blas.norm2(b)))
+        flops = getattr(d, "flops_per_site_M", lambda: 0)()
+        # GFLOPS convention: flops_per_site_M counts flops per site the
+        # operator UPDATES, and an even/odd-preconditioned operator
+        # updates one parity — volume/2 sites (the reference's
+        # Dirac*PC::flops are per-parity counts, include/dslash.h:475).
+        # Charging the FULL volume overstated every PC gflops ~2x
+        # (round-5 logs predate this fix).  mv_applies follows the SOLVE
+        # ROUTE (1 for direct/Hermitian-PC operators AND BiCGStab(L),
+        # whose iteration counter already counts matvec applications;
+        # 2 for normal-equation forms), set where mv is built.
+        sites = _ctx["geom"].volume // 2 if pc else _ctx["geom"].volume
+        param.gflops = (param.iter_count * mv_applies * flops
+                        * sites) / 1e9
+
+    from ..utils import timer as qtimer
+    qtimer.add_flops(param.gflops * 1e9)
+    if recording:
+        # convergence history -> InvertParam.res_history/events + trace
+        # residual events; roofline attribution of the compute phase
+        rec = oconv.harvest(inv, res, tol=param.tol,
+                            b2=float(blas.norm2(publish_sys_rhs)))
+        oconv.publish(rec, param)
+        from ..obs import roofline as orf
+        # applies counts M applications; a PC M runs TWO dslash
+        # invocations per apply, and the KERNEL_MODELS traffic side is
+        # per invocation — dslash_per_apply keeps the BW column honest
+        orf.record(_solve_form(d), sites,
+                   param.iter_count * mv_applies, t_solve,
+                   flops_per_site=flops,
+                   dslash_per_apply=2.0 if pc else 1.0,
+                   label=f"invert_quda:{param.dslash_type}/{inv}")
+    qlog.printq(
+        f"invert_quda[{param.dslash_type}/{inv}]: {param.iter_count} "
+        f"iters, true_res {param.true_res:.2e}, {param.secs:.2f} s")
+    return x_full
+
+
+def _invert_dispatch(param, d, d_full, b, rhs, sys_rhs, mv, mv_applies,
+                     inv, mixed, pair_sloppy, hermitian_pc, normop,
+                     sloppy_prec, dtype, pc, t0, recording):
+    """The solver dispatch chain of invert_quda.  Returns
+    ``(SolverResult, system_rhs_for_history)`` — or the finished
+    solution array for the gcr-mg route, which completes its own
+    epilogue/accounting."""
+    from .. import solvers
 
     if mixed and inv == "cg":
         if pair_sloppy:
@@ -647,7 +804,7 @@ def invert_quda(source, param: InvertParam):
             res = solvers.cg_reliable(
                 mv, mv_lo, sys_rhs, tol=param.tol,
                 maxiter=param.maxiter, delta=param.reliable_delta,
-                codec=codec)
+                codec=codec, record=recording)
         else:
             sl = _build_sloppy(param, pc, sloppy_prec)
             if hermitian_pc:
@@ -657,10 +814,12 @@ def invert_quda(source, param: InvertParam):
             res = solvers.cg_reliable(
                 mv, mv_lo, sys_rhs, complex_dtype(sloppy_prec),
                 tol=param.tol, maxiter=param.maxiter,
-                delta=param.reliable_delta)
+                delta=param.reliable_delta, record=recording)
     elif inv in ("cg", "pcg", "cg3"):
         fn = solvers.create(inv)
         kw = {"tol_hq": param.tol_hq} if inv == "cg" else {}
+        if inv in ("cg", "pcg"):
+            kw["record"] = recording
         res = fn(mv, sys_rhs, tol=param.tol, maxiter=param.maxiter, **kw)
     elif inv in ("cgne", "cgnr"):
         # explicit normal-error / normal-residual solves on the DIRECT
@@ -668,7 +827,8 @@ def invert_quda(source, param: InvertParam):
         # then x = Mdag y (error-norm minimising); cgnr solves
         # Mdag M x = Mdag b (residual-norm minimising)
         if hermitian_pc:
-            res = solvers.cg(d.M, rhs, tol=param.tol, maxiter=param.maxiter)
+            res = solvers.cg(d.M, rhs, tol=param.tol,
+                             maxiter=param.maxiter, record=recording)
         else:
             fn = solvers.cgne if inv == "cgne" else solvers.cgnr
             res = fn(d.M, d.Mdag, rhs, tol=param.tol, maxiter=param.maxiter)
@@ -686,10 +846,12 @@ def invert_quda(source, param: InvertParam):
                     mv_in, r, tol=1e-3, maxiter=param.maxiter)))
         else:
             res = solvers.bicgstab(mv, sys_rhs, tol=param.tol,
-                                   maxiter=param.maxiter)
+                                   maxiter=param.maxiter,
+                                   record=recording)
     elif inv == "bicgstab-l":
         res = solvers.bicgstab_l(mv, sys_rhs, L=_BICGSTAB_L,
-                                 tol=param.tol, maxiter=param.maxiter)
+                                 tol=param.tol, maxiter=param.maxiter,
+                                 record=recording)
     elif inv == "gcr":
         if pair_sloppy:
             sl = d.sloppy(sloppy_prec)
@@ -737,33 +899,10 @@ def invert_quda(source, param: InvertParam):
     else:
         qlog.errorq(f"inv_type {inv} not wired")
 
-    x_sys = back(res.x)
-    if pc:
-        xe, xo = d.reconstruct(x_sys, be, bo)
-        x_full = _join(xe, xo, param, d)
-    else:
-        x_full = x_sys
-
-    param.iter_count = int(res.iters)
-    param.secs = time.perf_counter() - t0
-    r = b - d_full.M(x_full)
-    param.true_res = float(jnp.sqrt(blas.norm2(r) / blas.norm2(b)))
-    flops = getattr(d, "flops_per_site_M", lambda: 0)()
-    # GFLOPS convention: flops_per_site_M counts flops per site the
-    # operator UPDATES, and an even/odd-preconditioned operator updates
-    # one parity — volume/2 sites (the reference's Dirac*PC::flops are
-    # per-parity counts, include/dslash.h:475).  Charging the FULL
-    # volume overstated every PC gflops ~2x (round-5 logs predate this
-    # fix).  mv_applies follows the SOLVE ROUTE (1 for direct/
-    # Hermitian-PC operators AND BiCGStab(L), whose iteration counter
-    # already counts matvec applications; 2 for normal-equation forms),
-    # set where mv is built.
-    sites = _ctx["geom"].volume // 2 if pc else _ctx["geom"].volume
-    param.gflops = (param.iter_count * mv_applies * flops * sites) / 1e9
-    qlog.printq(
-        f"invert_quda[{param.dslash_type}/{inv}]: {param.iter_count} iters,"
-        f" true_res {param.true_res:.2e}, {param.secs:.2f} s")
-    return x_full
+    # the cgne/cgnr branch solves against the DIRECT rhs; everything
+    # else iterated on sys_rhs — the history relres must normalise
+    # against the system the recorded residuals belong to
+    return res, (rhs if inv in ("cgne", "cgnr") else sys_rhs)
 
 
 def invert_multi_src_quda(sources, param: InvertParam):
@@ -795,13 +934,23 @@ def invert_multi_src_quda(sources, param: InvertParam):
     QUDA_TPU_MULTI_SRC_SPLIT forces ('1') or forbids ('0') the
     split-grid route.
     """
-    import numpy as np
-
     _require_init()
     param.validate()
+    from ..obs import trace as otr
+    with otr.api_span("invert_multi_src_quda", dslash=param.dslash_type,
+                      inv=param.inv_type, n_src=len(sources)):
+        return _invert_multi_src_body(sources, param)
+
+
+def _invert_multi_src_body(sources, param: InvertParam):
+    import numpy as np
+
+    from ..obs import convergence as oconv
+    from ..obs import trace as otr
     from ..utils import config as qconf
     from ..solvers.block import _check_nrhs
 
+    recording = otr.enabled()
     dtype = complex_dtype(param.cuda_prec)
     B = jnp.asarray(sources, dtype)
     n_src = B.shape[0]
@@ -898,38 +1047,55 @@ def invert_multi_src_quda(sources, param: InvertParam):
 
         # pass the RAW resident gauge; each sub-grid folds the boundary
         # phase inside its own trace (DiracWilsonPC does it)
-        x_full, iters = split_grid_solve(solve_one, _ctx["gauge"], B,
-                                         mesh)
-        d_chk = _build_dirac(param, False)
-        res_rhs = [float(jnp.sqrt(blas.norm2(B[i] - d_chk.M(x_full[i]))
-                                  / blas.norm2(B[i])))
-                   for i in range(n_src)]
-        return _finish(x_full, np.asarray(iters), res_rhs, 2.0)
+        with otr.phase("compute", "invert_multi_src_quda",
+                       route="split_grid"):
+            x_full, iters = split_grid_solve(solve_one, _ctx["gauge"],
+                                             B, mesh)
+        with otr.phase("epilogue", "invert_multi_src_quda"):
+            d_chk = _build_dirac(param, False)
+            res_rhs = [float(jnp.sqrt(blas.norm2(B[i]
+                                                 - d_chk.M(x_full[i]))
+                                      / blas.norm2(B[i])))
+                       for i in range(n_src)]
+            return _finish(x_full, np.asarray(iters), res_rhs, 2.0)
 
     if batched_ok:
-        from ..solvers.block import batched_cg_pairs, block_cg_pairs
-        d = _build_dirac(param, True).packed()
-        op = d.pairs(jnp.float32,
-                     use_pallas=_pallas_enabled(on_tpu),
-                     pallas_interpret=_pallas_interpret(on_tpu))
-        halves = [even_odd_split(B[i], geom) for i in range(n_src)]
-        be = jnp.stack([h[0] for h in halves])
-        bo = jnp.stack([h[1] for h in halves])
-        rhs_b = op.prepare_pairs_mrhs(be, bo)
-        # CGNR on the batched normal equations (coefficients real —
-        # exact on pairs; same route as the single-source wil_pairs cg)
-        nrm_b = op.Mdag_pairs_mrhs(rhs_b)
-        use_block = str(qconf.get("QUDA_TPU_MULTI_SRC_BLOCK",
-                                  fresh=True)) == "1"
-        if use_block:
-            res = block_cg_pairs(op.MdagM_pairs_mrhs, nrm_b,
-                                 tol=param.tol, maxiter=param.maxiter)
-            iters_rhs = np.full(n_src, int(res.iters))
-        else:
-            res = batched_cg_pairs(op.MdagM_pairs_mrhs, nrm_b,
-                                   tol=param.tol,
-                                   maxiter=param.maxiter)
-            iters_rhs = np.asarray(res.iters)
+        from ..solvers.block import (_per_rhs_dot, batched_cg_pairs,
+                                     block_cg_pairs)
+        with otr.phase("setup", "invert_multi_src_quda"):
+            d = _build_dirac(param, True).packed()
+            op = d.pairs(jnp.float32,
+                         use_pallas=_pallas_enabled(on_tpu),
+                         pallas_interpret=_pallas_interpret(on_tpu))
+            halves = [even_odd_split(B[i], geom) for i in range(n_src)]
+            be = jnp.stack([h[0] for h in halves])
+            bo = jnp.stack([h[1] for h in halves])
+            rhs_b = op.prepare_pairs_mrhs(be, bo)
+            # CGNR on the batched normal equations (coefficients real —
+            # exact on pairs; same route as the single-source wil_pairs
+            # cg)
+            nrm_b = op.Mdag_pairs_mrhs(rhs_b)
+            use_block = str(qconf.get("QUDA_TPU_MULTI_SRC_BLOCK",
+                                      fresh=True)) == "1"
+        solver_name = "block-cg-pairs" if use_block else \
+            "batched-cg-pairs"
+        t_solve0 = time.perf_counter()
+        with otr.phase("compute", "invert_multi_src_quda"), \
+                otr.span(f"solve:{solver_name}", cat="solver",
+                         nrhs=n_src, tol=param.tol):
+            if use_block:
+                res = block_cg_pairs(op.MdagM_pairs_mrhs, nrm_b,
+                                     tol=param.tol,
+                                     maxiter=param.maxiter,
+                                     record=recording)
+                iters_rhs = np.full(n_src, int(res.iters))
+            else:
+                res = batched_cg_pairs(op.MdagM_pairs_mrhs, nrm_b,
+                                       tol=param.tol,
+                                       maxiter=param.maxiter,
+                                       record=recording)
+                iters_rhs = np.asarray(res.iters)
+        t_solve = time.perf_counter() - t_solve0
         conv = np.asarray(res.converged)
         if not conv.all():
             qlog.warningq(
@@ -938,14 +1104,33 @@ def invert_multi_src_quda(sources, param: InvertParam):
                 f"within {param.maxiter} iterations (block-CG Gram "
                 "breakdown reports lanes unconverged too); per-RHS "
                 "true_res_multi holds the achieved residuals")
-        xe_b, xo_b = op.reconstruct_pairs_mrhs(res.x, be, bo)
-        x_full = jax.vmap(
-            lambda e, o: even_odd_join(e, o, geom))(xe_b, xo_b)
-        d_chk = _build_dirac(param, False)
-        res_rhs = [float(jnp.sqrt(blas.norm2(B[i] - d_chk.M(x_full[i]))
-                                  / blas.norm2(B[i])))
-                   for i in range(n_src)]
-        return _finish(x_full, iters_rhs, res_rhs, 2.0)
+        with otr.phase("epilogue", "invert_multi_src_quda"):
+            xe_b, xo_b = op.reconstruct_pairs_mrhs(res.x, be, bo)
+            x_full = jax.vmap(
+                lambda e, o: even_odd_join(e, o, geom))(xe_b, xo_b)
+            d_chk = _build_dirac(param, False)
+            res_rhs = [float(jnp.sqrt(blas.norm2(B[i]
+                                                 - d_chk.M(x_full[i]))
+                                      / blas.norm2(B[i])))
+                       for i in range(n_src)]
+            x_out = _finish(x_full, iters_rhs, res_rhs, 2.0)
+        if recording:
+            # per-lane convergence histories (worst relative lane is
+            # the headline; each lane normalized against its OWN b2)
+            # + MRHS roofline attribution of the batch solve
+            b2_rhs = np.asarray(_per_rhs_dot(nrm_b, nrm_b))
+            rec = oconv.harvest(solver_name, res, tol=param.tol,
+                                b2=b2_rhs)
+            oconv.publish(rec, param)
+            from ..obs import roofline as orf
+            form = ("wilson_mrhs"
+                    if getattr(op, "use_pallas", False) else "generic")
+            orf.record(form, geom.volume // 2,
+                       float(np.max(iters_rhs)) * 2.0, t_solve,
+                       nrhs=n_src, flops_per_site=2 * 1320 + 48,
+                       dslash_per_apply=2.0,
+                       label=f"invert_multi_src_quda:{solver_name}")
+        return x_out
 
     # generic fallback: per-source invert_quda loop (correct everywhere,
     # no gauge amortisation) — keeps the multi-source surface total
@@ -1112,7 +1297,38 @@ def invert_multishift_quda(source, param: InvertParam):
     """invertMultiShiftQuda: (A + offset_i) x_i = b on the PC normal op."""
     _require_init()
     param.validate()
+    from ..obs import trace as otr
+    with otr.api_span("invert_multishift_quda",
+                      dslash=param.dslash_type,
+                      n_shifts=len(param.offset)):
+        return _invert_multishift_body(source, param)
+
+
+def _publish_multishift(res, rhs, param, tol=None, stage_note=None):
+    """Convergence history for a multishift route: base-system residuals
+    + per-shift lanes/converged-at events (obs/convergence.py).
+
+    ``tol`` is the tolerance the RECORDED stage actually ran at (the
+    dtype-sloppy route clamps to 1e-4; labeling that history with
+    param.tol would produce a record that looks 6 orders short of a
+    tolerance nothing was judged against).  ``stage_note`` marks a
+    record that covers only part of the route (e.g. unrecorded
+    per-shift refinement CGs follow)."""
+    from ..obs import convergence as oconv
+    if getattr(res, "history", None) is None:
+        return
+    rec = oconv.harvest("multi-shift-cg", res,
+                        tol=param.tol if tol is None else tol,
+                        b2=float(blas.norm2(rhs)))
+    if rec is not None and stage_note is not None:
+        rec.events.insert(0, {"type": "stage", "note": stage_note})
+    oconv.publish(rec, param)
+
+
+def _invert_multishift_body(source, param: InvertParam):
+    from ..obs import trace as otr
     from ..solvers.multishift import multishift_cg
+    recording = otr.enabled()
     b = jnp.asarray(source, complex_dtype(param.cuda_prec))
     d = _build_dirac(param, True)
     be, bo = _split(b, param, d)
@@ -1142,11 +1358,14 @@ def invert_multishift_quda(source, param: InvertParam):
         ad = _StaggeredPairsSolve(d, _pallas_enabled(on_tpu),
                                   _pallas_interpret(on_tpu))
         rhs_pp = ad.prepare(be, bo)
-        res = multishift_cg(ad.M, rhs_pp, tuple(param.offset),
-                            tol=param.tol, maxiter=param.maxiter)
+        with otr.phase("compute", "invert_multishift_quda"):
+            res = multishift_cg(ad.M, rhs_pp, tuple(param.offset),
+                                tol=param.tol, maxiter=param.maxiter,
+                                record=recording)
         param.iter_count = int(res.iters)
         param.secs = time.perf_counter() - t0
         _account()
+        _publish_multishift(res, rhs_pp, param)
         r0 = rhs_pp - (ad.M(res.x[0])
                        + param.offset[0] * res.x[0].astype(jnp.float32))
         param.true_res = float(jnp.sqrt(blas.norm2(r0)
@@ -1174,12 +1393,14 @@ def invert_multishift_quda(source, param: InvertParam):
                               pallas_interpret=_pallas_interpret(on_tpu))
         rhs_pp = sl.prepare_pairs(be, bo)
         nrm_rhs = sl.Mdag_pairs(rhs_pp)
-        res = multishift_cg(sl.MdagM_pairs, nrm_rhs,
-                            tuple(param.offset), tol=param.tol,
-                            maxiter=param.maxiter)
+        with otr.phase("compute", "invert_multishift_quda"):
+            res = multishift_cg(sl.MdagM_pairs, nrm_rhs,
+                                tuple(param.offset), tol=param.tol,
+                                maxiter=param.maxiter, record=recording)
         param.iter_count = int(res.iters)
         param.secs = time.perf_counter() - t0
         _account()
+        _publish_multishift(res, nrm_rhs, param)
         r0 = nrm_rhs - (sl.MdagM_pairs(res.x[0])
                         + param.offset[0] * res.x[0].astype(jnp.float32))
         param.true_res = float(jnp.sqrt(blas.norm2(r0)
@@ -1205,9 +1426,16 @@ def invert_multishift_quda(source, param: InvertParam):
         # sloppy solution.
         from ..solvers.cg import cg as cg_solve
         sl = d.sloppy(sloppy_prec)
-        res = multishift_cg(sl.MdagM, rhs.astype(jnp.complex64),
-                            shifts, tol=max(param.tol, 1e-4),
-                            maxiter=param.maxiter)
+        with otr.phase("compute", "invert_multishift_quda"):
+            res = multishift_cg(sl.MdagM, rhs.astype(jnp.complex64),
+                                shifts, tol=max(param.tol, 1e-4),
+                                maxiter=param.maxiter, record=recording)
+        _publish_multishift(
+            res, rhs, param, tol=max(param.tol, 1e-4),
+            stage_note="sloppy shared-Krylov stage (tol clamped to "
+                       "1e-4); per-shift precise refinement CGs follow "
+                       "and are not recorded, so param.iter_count "
+                       "exceeds this history's length")
         xs, iters = [], int(res.iters)
         for i, s in enumerate(shifts):
             mv_s = (lambda sig: lambda v: mv(v) + sig * v)(s)
@@ -1221,11 +1449,13 @@ def invert_multishift_quda(source, param: InvertParam):
         r0 = rhs - (mv(xs[0]) + shifts[0] * xs[0])
         param.true_res = float(jnp.sqrt(blas.norm2(r0) / blas.norm2(rhs)))
         return jnp.stack(xs)
-    res = multishift_cg(mv, rhs, shifts, tol=param.tol,
-                        maxiter=param.maxiter)
+    with otr.phase("compute", "invert_multishift_quda"):
+        res = multishift_cg(mv, rhs, shifts, tol=param.tol,
+                            maxiter=param.maxiter, record=recording)
     param.iter_count = int(res.iters)
     param.secs = time.perf_counter() - t0
     _account()
+    _publish_multishift(res, rhs, param)
     r0 = rhs - (mv(res.x[0]) + shifts[0] * res.x[0])
     param.true_res = float(jnp.sqrt(blas.norm2(r0) / blas.norm2(rhs)))
     return res.x
@@ -1255,10 +1485,20 @@ def eigensolve_quda(eig_param: EigParamAPI, invert_param: InvertParam):
     """eigensolveQuda: returns (evals, evecs)."""
     _require_init()
     eig_param.validate()
+    from ..obs import trace as otr
+    with otr.api_span("eigensolve_quda", eig_type=eig_param.eig_type,
+                      n_ev=eig_param.n_ev,
+                      dslash=invert_param.dslash_type):
+        return _eigensolve_body(eig_param, invert_param)
+
+
+def _eigensolve_body(eig_param: EigParamAPI, invert_param: InvertParam):
     from ..eig.iram import iram
     from ..eig.lanczos import EigParam, trlm
-    pc = invert_param.solve_type.endswith("-pc")
-    d = _build_dirac(invert_param, pc)
+    from ..obs import trace as otr
+    with otr.phase("setup", "eigensolve_quda"):
+        pc = invert_param.solve_type.endswith("-pc")
+        d = _build_dirac(invert_param, pc)
     geom = _ctx["geom"]
     dtype = complex_dtype(invert_param.cuda_prec)
     shape = (geom.half_lattice_shape if pc else geom.lattice_shape) + (4, 3)
@@ -1304,7 +1544,9 @@ def eigensolve_quda(eig_param: EigParamAPI, invert_param: InvertParam):
             ex_pp = jnp.zeros((3, 2, T, Z, Y * X // 2), jnp.float32)
             pair_axis = 1
             conv = ad.op._from_pairs
-        res = trlm_pairs(mv, ex_pp, p, pair_axis)
+        with otr.phase("compute", "eigensolve_quda",
+                       solver="trlm_pairs"):
+            res = trlm_pairs(mv, ex_pp, p, pair_axis)
         if res.evecs.shape[0] < eig_param.n_ev:
             qlog.printq(
                 f"eigensolve (pair route): only {res.evecs.shape[0]} of "
@@ -1335,15 +1577,17 @@ def eigensolve_quda(eig_param: EigParamAPI, invert_param: InvertParam):
         op = d.M if getattr(d, "hermitian", False) else d.MdagM
     else:
         op = d.M
-    if eig_param.eig_type == "trlm":
-        res = trlm(op, example, p)
-    elif eig_param.eig_type == "arpack":
-        # host ARPACK bridge (lib/arpack_interface.cpp analog)
-        from ..eig.arpack_bridge import arpack_solve
-        res = arpack_solve(op, example, p,
-                           hermitian=eig_param.use_norm_op)
-    else:
-        res = iram(op, example, p)
+    with otr.phase("compute", "eigensolve_quda",
+                   solver=eig_param.eig_type):
+        if eig_param.eig_type == "trlm":
+            res = trlm(op, example, p)
+        elif eig_param.eig_type == "arpack":
+            # host ARPACK bridge (lib/arpack_interface.cpp analog)
+            from ..eig.arpack_bridge import arpack_solve
+            res = arpack_solve(op, example, p,
+                               hermitian=eig_param.use_norm_op)
+        else:
+            res = iram(op, example, p)
     if eig_param.vec_outfile:
         from ..utils.io import save_vectors
         save_vectors(eig_param.vec_outfile, res.evecs, res.evals)

@@ -1,0 +1,25 @@
+"""Observability layer: span tracing, convergence recording, roofline
+attribution.
+
+The reference ships its performance story as instrumentation built INTO
+the product — per-API ``TimeProfile`` statics (lib/timer.cpp), the
+autotuner doubling as a profiler (profile_N.tsv, lib/tune.cpp:450-474),
+and per-solve convergence reporting.  This package is the TPU-native
+home for that surface:
+
+* ``obs.trace``       — nestable named spans + instant events, exported
+                        as chrome-trace/perfetto JSON and a JSONL event
+                        stream (QUDA_TPU_TRACE / QUDA_TPU_TRACE_PATH;
+                        off = zero-overhead no-op spans, safe under jit).
+* ``obs.convergence`` — per-iteration residual histories and solver
+                        events (reliable updates, restarts, breakdowns,
+                        per-RHS lanes) harvested from SolverResult
+                        histories and surfaced on InvertParam.
+* ``obs.roofline``    — the PERF.md per-site flops/bytes models joined
+                        with measured wall-times into achieved-GFLOPS /
+                        achieved-BW / %-of-demonstrated-peak rows per
+                        kernel form, replacing hand arithmetic in the
+                        bench harness and the round logs.
+"""
+
+from . import convergence, roofline, trace  # noqa: F401

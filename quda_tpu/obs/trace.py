@@ -1,0 +1,262 @@
+"""Span tracer: nestable named spans + instant events, chrome-trace out.
+
+Reference behavior: the reference's profiling surface is pushProfile
+RAII spans (include/timer.h:243) + the tunecache profiler tsv
+(lib/tune.cpp:450-474).  This module adds the modern export formats on
+top of the same span discipline: a chrome-trace/perfetto JSON
+(``trace.json``) and a flat JSONL event stream
+(``trace_events.jsonl``), written under QUDA_TPU_TRACE_PATH (default:
+the resource path) when tracing is active.
+
+Activation: ``QUDA_TPU_TRACE=1`` (read by init_quda via
+``maybe_start``) or an explicit ``start()`` (the bench harness's
+``--trace``).  **Off means off**: ``span()`` returns a module-level
+no-op singleton whose __enter__/__exit__ do nothing and ``event()``
+returns after one global load — no buffers, no clocks, no allocation —
+so instrumented code is safe to leave in hot host paths and around jit
+boundaries.  (Spans time HOST regions; device work inside a span is
+attributed to it only up to XLA's async dispatch, so callers that need
+device-accurate spans must pass a fetched/blocked result the way the
+bench harness does.)
+
+When jax.profiler.TraceAnnotation is available each span also opens a
+matching annotation, so quda_tpu spans show up inside a jax/XLA
+profiler capture (StartTraceRegion analog).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+
+class _NoopSpan:
+    """Zero-overhead disabled span (the QUDA_DO_NOT_PROFILE analog)."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Session:
+    def __init__(self, path: str, prefix: str, max_events: int):
+        self.path = path
+        self.prefix = prefix
+        self.max_events = max_events
+        self.t0 = time.perf_counter()
+        self.wall0 = time.time()
+        self.chrome: list = []     # chrome traceEvents dicts
+        self.jsonl: list = []      # flat event-stream dicts
+        self.dropped = 0
+        self.lock = threading.Lock()
+        self.depth: dict = {}      # thread ident -> current span depth
+        try:
+            import jax.profiler
+            self.annotation_cls = getattr(jax.profiler, "TraceAnnotation",
+                                          None)
+        except Exception:
+            self.annotation_cls = None
+
+
+_session: Optional[_Session] = None
+
+
+def enabled() -> bool:
+    return _session is not None
+
+
+def _trace_dir() -> str:
+    from ..utils import config as qconf
+    return (qconf.get("QUDA_TPU_TRACE_PATH", fresh=True)
+            or qconf.get("QUDA_TPU_RESOURCE_PATH", fresh=True)
+            or ".")
+
+
+def start(path: Optional[str] = None, prefix: str = "trace") -> _Session:
+    """Open a trace session (idempotent: an active session is kept —
+    and its path/prefix WIN; explicit arguments that conflict with the
+    active session are discarded with a warning, so a driver that
+    init_quda'd with QUDA_TPU_TRACE=1 and then asks for bench_trace
+    artifacts learns where its events actually went).
+    Artifacts land in ``path`` (default: QUDA_TPU_TRACE_PATH, else the
+    resource path, else cwd) as <prefix>.json / <prefix>_events.jsonl."""
+    global _session
+    if _session is None:
+        from ..utils import config as qconf
+        _session = _Session(path or _trace_dir(), prefix,
+                            qconf.get("QUDA_TPU_TRACE_EVENTS_MAX",
+                                      fresh=True))
+    elif ((path is not None and path != _session.path)
+          or prefix != _session.prefix):
+        from ..utils import logging as qlog
+        qlog.warningq(
+            f"obs.trace.start({path!r}, prefix={prefix!r}): a session "
+            f"is already active, keeping its artifacts at "
+            f"{_session.path}/{_session.prefix}.json")
+    return _session
+
+
+def maybe_start() -> Optional[_Session]:
+    """Start a session iff QUDA_TPU_TRACE is set (init_quda hook)."""
+    from ..utils import config as qconf
+    if qconf.get("QUDA_TPU_TRACE", fresh=True):
+        return start()
+    return None
+
+
+def stop(flush_files: bool = True) -> Optional[dict]:
+    """Close the session; returns {'chrome': path, 'jsonl': path} when
+    artifacts were written (end_quda hook)."""
+    global _session
+    if _session is None:
+        return None
+    paths = flush() if flush_files else None
+    _session = None
+    return paths
+
+
+def _now_us(s: _Session) -> float:
+    return (time.perf_counter() - s.t0) * 1e6
+
+
+def _push(s: _Session, chrome_ev: dict, jsonl_ev: Optional[dict]):
+    with s.lock:
+        if len(s.chrome) >= s.max_events:
+            s.dropped += 1
+            return
+        s.chrome.append(chrome_ev)
+        if jsonl_ev is not None:
+            s.jsonl.append(jsonl_ev)
+
+
+class _Span:
+    __slots__ = ("name", "cat", "args", "_ts", "_ann", "_depth", "_tid")
+
+    def __init__(self, name: str, cat: str, args: dict):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._ann = None
+        self._ts = 0.0
+        self._depth = 0
+        self._tid = 0
+
+    def __enter__(self):
+        s = _session
+        if s is None:            # stopped between creation and entry
+            return self
+        self._tid = threading.get_ident()
+        self._depth = s.depth.get(self._tid, 0) + 1
+        s.depth[self._tid] = self._depth
+        if s.annotation_cls is not None:
+            try:
+                self._ann = s.annotation_cls(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self._ts = _now_us(s)
+        return self
+
+    def __exit__(self, *exc):
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:
+                pass
+        s = _session
+        if s is None or self._depth == 0:
+            return False
+        dur = _now_us(s) - self._ts
+        s.depth[self._tid] = self._depth - 1
+        args = dict(self.args, depth=self._depth)
+        _push(s, {"name": self.name, "cat": self.cat, "ph": "X",
+                  "ts": round(self._ts, 3), "dur": round(dur, 3),
+                  "pid": 0, "tid": 0, "args": args},
+              {"kind": "span", "name": self.name, "cat": self.cat,
+               "ts_us": round(self._ts, 3), "dur_us": round(dur, 3),
+               "depth": self._depth, **self.args})
+        return False
+
+
+def span(name: str, cat: str = "api", **args):
+    """A nestable named span; the module no-op singleton when tracing is
+    off (so call sites stay branch-cheap on the disabled path)."""
+    if _session is None:
+        return _NOOP
+    return _Span(name, cat, args)
+
+
+def event(name: str, cat: str = "event", **fields):
+    """Instant event into both the chrome trace and the JSONL stream."""
+    s = _session
+    if s is None:
+        return
+    ts = _now_us(s)
+    _push(s, {"name": name, "cat": cat, "ph": "i", "s": "t",
+              "ts": round(ts, 3), "pid": 0, "tid": 0, "args": fields},
+          {"kind": "event", "name": name, "cat": cat,
+           "ts_us": round(ts, 3), **fields})
+
+
+def flush() -> Optional[dict]:
+    """Write the chrome-trace JSON + JSONL stream; returns their paths.
+    The session stays active (incremental flushes overwrite)."""
+    s = _session
+    if s is None:
+        return None
+    os.makedirs(s.path, exist_ok=True)
+    chrome_path = os.path.join(s.path, f"{s.prefix}.json")
+    jsonl_path = os.path.join(s.path, f"{s.prefix}_events.jsonl")
+    with s.lock:
+        doc = {"traceEvents": list(s.chrome),
+               "displayTimeUnit": "ms",
+               "otherData": {"source": "quda_tpu.obs.trace",
+                             "wall_start": s.wall0,
+                             "dropped_events": s.dropped}}
+        lines = [json.dumps(e) for e in s.jsonl]
+    with open(chrome_path, "w") as fh:
+        json.dump(doc, fh)
+    with open(jsonl_path, "w") as fh:
+        fh.write("\n".join(lines) + ("\n" if lines else ""))
+    return {"chrome": chrome_path, "jsonl": jsonl_path}
+
+
+# -- TimeProfile-bridged helpers for the API layer --------------------------
+
+@contextmanager
+def api_span(name: str, **args):
+    """Top-level API span: a pushProfile interval (category 'total' on
+    the named TimeProfile) + a trace span — one context for every
+    interface entry point (invert_quda, eigensolve_quda, ...)."""
+    from ..utils.timer import push_profile
+    with push_profile(name):
+        with span(name, cat="api", **args):
+            yield
+
+
+@contextmanager
+def phase(category: str, profile: Optional[str] = None, **args):
+    """One category interval on ``profile``'s TimeProfile + a trace span
+    — the setup/compute/comms/epilogue breakdown inside an api_span."""
+    from ..utils import timer as qtimer
+    prof = (qtimer.get_profile(profile)
+            if profile is not None and qtimer._profiling_enabled()
+            else None)
+    if prof is not None:
+        prof.start(category)
+    try:
+        with span(category, cat=category, **args):
+            yield
+    finally:
+        if prof is not None:
+            prof.stop(category)

@@ -24,7 +24,7 @@ from .cg import SolverResult
 
 def bicgstab(matvec: Callable, b: jnp.ndarray,
              x0: Optional[jnp.ndarray] = None, tol: float = 1e-10,
-             maxiter: int = 2000) -> SolverResult:
+             maxiter: int = 2000, record: bool = False) -> SolverResult:
     b2 = blas.norm2(b)
     stop = (tol ** 2) * b2
     x = jnp.zeros_like(b) if x0 is None else x0
@@ -36,6 +36,9 @@ def bicgstab(matvec: Callable, b: jnp.ndarray,
     state = dict(x=x, r=r, p=jnp.zeros_like(b), v=jnp.zeros_like(b),
                  rho=one, alpha=one, omega=one,
                  r2=blas.norm2(r), k=jnp.int32(0))
+    if record:
+        state["hist"] = jnp.full((maxiter + 1,), jnp.nan,
+                                 state["r2"].dtype)
 
     def cond(c):
         return jnp.logical_and(c["r2"] > stop, c["k"] < maxiter)
@@ -52,17 +55,23 @@ def bicgstab(matvec: Callable, b: jnp.ndarray,
             blas.norm2(t), jnp.finfo(c["r2"].dtype).tiny).astype(dt)
         x = c["x"] + alpha * p + omega * s
         r = s - omega * t
-        return dict(x=x, r=r, p=p, v=v, rho=rho_new, alpha=alpha,
-                    omega=omega, r2=blas.norm2(r), k=c["k"] + 1)
+        nxt = dict(x=x, r=r, p=p, v=v, rho=rho_new, alpha=alpha,
+                   omega=omega, r2=blas.norm2(r), k=c["k"] + 1)
+        if record:
+            nxt["hist"] = c["hist"].at[c["k"]].set(nxt["r2"])
+        return nxt
 
     out = jax.lax.while_loop(cond, body, state)
-    return SolverResult(out["x"], out["k"], out["r2"], out["r2"] <= stop)
+    return SolverResult(out["x"], out["k"], out["r2"], out["r2"] <= stop,
+                        out["hist"] if record else None)
 
 
 def bicgstab_l(matvec: Callable, b: jnp.ndarray, L: int = 4,
                x0: Optional[jnp.ndarray] = None, tol: float = 1e-10,
-               maxiter: int = 2000) -> SolverResult:
-    """BiCGStab(L); maxiter counts matvec applications (2L per cycle)."""
+               maxiter: int = 2000, record: bool = False) -> SolverResult:
+    """BiCGStab(L); maxiter counts matvec applications (2L per cycle).
+    ``record=True`` captures |r|^2 once per cycle (cadence 2L in the
+    harvested history — each cycle IS 2L matvec applications)."""
     b2 = blas.norm2(b)
     stop = (tol ** 2) * b2
     x = jnp.zeros_like(b) if x0 is None else x0
@@ -78,6 +87,9 @@ def bicgstab_l(matvec: Callable, b: jnp.ndarray, L: int = 4,
                  omega=jnp.ones((), dt),
                  r2=blas.norm2(r0), k=jnp.int32(0))
     state["r"] = state["r"].at[0].set(r0)
+    if record:
+        state["hist"] = jnp.full((maxiter // (2 * L) + 2,), jnp.nan,
+                                 rdt)
 
     def cond(c):
         return jnp.logical_and(c["r2"] > stop, c["k"] < maxiter)
@@ -111,8 +123,12 @@ def bicgstab_l(matvec: Callable, b: jnp.ndarray, L: int = 4,
         omega = g[L - 1]
         r = r.at[0].set(rnew)
         u = u.at[0].set(u0)
-        return dict(x=x, r=r, u=u, rho=rho, alpha=alpha, omega=omega,
-                    r2=blas.norm2(rnew), k=c["k"] + 2 * L)
+        nxt = dict(x=x, r=r, u=u, rho=rho, alpha=alpha, omega=omega,
+                   r2=blas.norm2(rnew), k=c["k"] + 2 * L)
+        if record:
+            nxt["hist"] = c["hist"].at[c["k"] // (2 * L)].set(nxt["r2"])
+        return nxt
 
     out = jax.lax.while_loop(cond, body, state)
-    return SolverResult(out["x"], out["k"], out["r2"], out["r2"] <= stop)
+    return SolverResult(out["x"], out["k"], out["r2"], out["r2"] <= stop,
+                        out["hist"] if record else None)

@@ -52,6 +52,8 @@ class BlockCGResult(NamedTuple):
     iters: jnp.ndarray
     r2: jnp.ndarray          # (nrhs,)
     converged: jnp.ndarray   # (nrhs,)
+    # optional (slots, nrhs) per-iteration |r|^2 lanes (record=True)
+    history: object = None
 
 
 def block_cg(matvec: Callable, B: jnp.ndarray, tol: float = 1e-10,
@@ -121,6 +123,8 @@ class BatchedCGResult(NamedTuple):
     iters: jnp.ndarray       # (nrhs,) iterations to convergence per RHS
     r2: jnp.ndarray          # (nrhs,) final |r|^2
     converged: jnp.ndarray   # (nrhs,)
+    # optional (slots, nrhs) per-check-point |r|^2 lanes (record=True)
+    history: object = None
 
 
 def _per_rhs_dot(u: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
@@ -136,7 +140,8 @@ def _bcast(s: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
 
 def batched_cg_pairs(matvec_batch: Callable, B: jnp.ndarray,
                      tol: float = 1e-10, maxiter: int = 1000,
-                     check_every: Optional[int] = None
+                     check_every: Optional[int] = None,
+                     record: bool = False
                      ) -> BatchedCGResult:
     """Batched CG on pair arrays with the fused-iteration tail.
 
@@ -177,26 +182,35 @@ def batched_cg_pairs(matvec_batch: Callable, B: jnp.ndarray,
         return x, r, p, r2
 
     def cond(carry):
-        x, r, p, rz, k, it_conv = carry
+        rz, k = carry[3], carry[4]
         return jnp.logical_and(jnp.any(rz > stop), k < maxiter)
 
     def body(carry):
-        x, r, p, rz, k, it_conv = carry
+        x, r, p, rz, k, it_conv = carry[:6]
         for _ in range(check_every):
             x, r, p, rz = one_iter(x, r, p, rz)
-        k = k + check_every
-        it_conv = jnp.where((it_conv < 0) & (rz <= stop), k, it_conv)
-        return (x, r, p, rz, k, it_conv)
+        k_new = k + check_every
+        it_conv = jnp.where((it_conv < 0) & (rz <= stop), k_new, it_conv)
+        if record:
+            hist = carry[6].at[k // check_every].set(rz)
+            return (x, r, p, rz, k_new, it_conv, hist)
+        return (x, r, p, rz, k_new, it_conv)
 
     it_conv0 = jnp.full((n,), -1, jnp.int32)
-    x, r, p, rz, k, it_conv = jax.lax.while_loop(
-        cond, body, (x, r, p, rz, jnp.int32(0), it_conv0))
+    init = (x, r, p, rz, jnp.int32(0), it_conv0)
+    if record:
+        slots = maxiter // check_every + 2
+        init = init + (jnp.full((slots, n), jnp.nan, rdt),)
+    out = jax.lax.while_loop(cond, body, init)
+    x, r, p, rz, k, it_conv = out[:6]
     it_conv = jnp.where(it_conv < 0, k, it_conv)
-    return BatchedCGResult(x, it_conv, rz, rz <= stop)
+    return BatchedCGResult(x, it_conv, rz, rz <= stop,
+                           out[6] if record else None)
 
 
 def block_cg_pairs(matvec_batch: Callable, B: jnp.ndarray,
-                   tol: float = 1e-10, maxiter: int = 1000
+                   tol: float = 1e-10, maxiter: int = 1000,
+                   record: bool = False
                    ) -> BlockCGResult:
     """Block CG (O'Leary) on pair arrays: one shared Krylov space.
 
@@ -254,10 +268,16 @@ def block_cg_pairs(matvec_batch: Callable, B: jnp.ndarray,
         rr_new = gram(R, R)
         beta = jnp.linalg.solve(rr, rr_new)
         P = R + comb(beta, P)
-        return dict(X=X, R=R, P=P, r2=jnp.diagonal(rr_new),
-                    k=c["k"] + 1)
+        nxt = dict(X=X, R=R, P=P, r2=jnp.diagonal(rr_new),
+                   k=c["k"] + 1)
+        if record:
+            nxt["hist"] = c["hist"].at[c["k"]].set(nxt["r2"])
+        return nxt
 
     state = dict(X=X, R=R, P=P, r2=b2, k=jnp.int32(0))
+    if record:
+        state["hist"] = jnp.full((maxiter + 1, n), jnp.nan, rdt)
     out = jax.lax.while_loop(cond, body, state)
     return BlockCGResult(out["X"], out["k"], out["r2"],
-                         out["r2"] <= stop)
+                         out["r2"] <= stop,
+                         out["hist"] if record else None)

@@ -27,13 +27,19 @@ class SolverResult(NamedTuple):
     iters: jnp.ndarray      # int32
     r2: jnp.ndarray         # final |r|^2
     converged: jnp.ndarray  # bool
+    # optional convergence history (obs/convergence.py): a NaN-padded
+    # per-check-point |r|^2 buffer (or a dict of such buffers) when the
+    # solver ran with record=True; None (the default) otherwise — the
+    # zero-overhead path never allocates it
+    history: Optional[object] = None
 
 
 def cg(matvec: Callable, b: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
        tol: float = 1e-10, maxiter: int = 1000,
        precond: Optional[Callable] = None,
        tol_hq: float = 0.0,
-       check_every: Optional[int] = None) -> SolverResult:
+       check_every: Optional[int] = None,
+       record: bool = False) -> SolverResult:
     """Solve matvec(x) = b for Hermitian positive-definite matvec.
 
     Convergence: |r|^2 <= tol^2 * |b|^2 (QUDA's L2 relative residual,
@@ -53,7 +59,7 @@ def cg(matvec: Callable, b: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
     from .fused_iter import fused_cg
     return fused_cg(matvec, b, x0=x0, tol=tol, maxiter=maxiter,
                     precond=precond, tol_hq=tol_hq,
-                    check_every=check_every)
+                    check_every=check_every, record=record)
 
 
 def cg_fixed_iters(matvec: Callable, b: jnp.ndarray, x0, n_iters: int):

@@ -67,7 +67,8 @@ def fused_cg(matvec: Callable, b: jnp.ndarray,
              maxiter: int = 1000, precond: Optional[Callable] = None,
              tol_hq: float = 0.0, check_every: Optional[int] = None,
              use_pallas_tail: Optional[bool] = None,
-             pallas_interpret: Optional[bool] = None) -> SolverResult:
+             pallas_interpret: Optional[bool] = None,
+             record: bool = False) -> SolverResult:
     """CG/PCG with a fused iteration body and check-cadence amortisation.
 
     Semantics match solvers/cg.cg (which delegates here): convergence at
@@ -81,6 +82,13 @@ def fused_cg(matvec: Callable, b: jnp.ndarray,
     cadence boundaries: with cadence k the solve can run up to k-1
     iterations past convergence or past maxiter — ``iters`` always
     reports the iterations actually executed.
+
+    ``record=True`` threads a NaN-padded |r|^2 history buffer through
+    the loop carry, written at every convergence-check point (slot i =
+    iteration (i+1)*check_every; intermediate iterations at cadence > 1
+    are the documented cadence gaps) and returned as
+    ``SolverResult.history`` for obs/convergence.py to harvest.  With
+    record=False the carry is unchanged — zero recording overhead.
     """
     check_every = _resolve_check_every(check_every)
     pallas_tail = _resolve_pallas_tail(use_pallas_tail, b)
@@ -139,16 +147,23 @@ def fused_cg(matvec: Callable, b: jnp.ndarray,
         return jnp.logical_or(l2, hq2 > stop_hq)
 
     def cond(carry):
-        x, r, p, rz, r2, k = carry
+        x, r, r2, k = carry[0], carry[1], carry[4], carry[5]
         return jnp.logical_and(not_done(x, r, r2), k < maxiter)
 
     def body(carry):
-        x, r, p, rz, r2, k = carry
+        x, r, p, rz, r2, k = carry[:6]
         for _ in range(check_every):
             x, r, p, rz, r2 = one_iter(x, r, p, rz)
+        if record:
+            hist = carry[6].at[k // check_every].set(r2)
+            return (x, r, p, rz, r2, k + check_every, hist)
         return (x, r, p, rz, r2, k + check_every)
 
-    x, r, p, rz, r2, k = jax.lax.while_loop(
-        cond, body, (x, r, p, rz, r2, jnp.int32(0)))
+    init = (x, r, p, rz, r2, jnp.int32(0))
+    if record:
+        slots = maxiter // check_every + 2
+        init = init + (jnp.full((slots,), jnp.nan, rdt),)
+    out = jax.lax.while_loop(cond, body, init)
+    x, r, p, rz, r2, k = out[:6]
     done = jnp.logical_not(not_done(x, r, r2))
-    return SolverResult(x, k, r2, done)
+    return SolverResult(x, k, r2, done, out[6] if record else None)

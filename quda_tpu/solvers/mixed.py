@@ -144,7 +144,8 @@ def pair_inplace_codec(store_dtype, use_pallas_tail: Optional[bool] = None,
 def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
                 sloppy_dtype=None, tol: float = 1e-10, maxiter: int = 2000,
                 delta: float = 0.1,
-                codec: Optional[StorageCodec] = None) -> SolverResult:
+                codec: Optional[StorageCodec] = None,
+                record: bool = False) -> SolverResult:
     """Mixed-precision CG with reliable updates.
 
     matvec_hi acts on the precise (complex) representation; matvec_lo acts
@@ -152,6 +153,11 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
     pair array for the bf16/int8 codec).  Convergence is judged on the
     TRUE residual norm maintained through reliable updates, so the
     returned r2 is trustworthy at the precise level.
+
+    ``record=True`` returns ``history={'r2': per-iteration residual
+    norms (the true residual at reliable-update iterations, the sloppy
+    one otherwise), 'reliable': per-iteration reliable-update flags}``
+    for obs/convergence.py; record=False leaves the carry unchanged.
     """
     if codec is None:
         if sloppy_dtype is None:
@@ -197,7 +203,7 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
             # compensated: the reported residual must be trustworthy
             # below the plain-f32 accumulation floor (dbldbl.h analog)
             r2_true = blas.norm2_comp(r_true).astype(rdt)
-            return dict(
+            d = dict(
                 c, x=x_new, r=r_true, r2=r2_true,
                 r_lo=codec.down(r_true),
                 # restart the direction at the true residual (QUDA resets
@@ -205,26 +211,39 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
                 p=codec.down(r_true),
                 x_lo=jnp.zeros_like(x_lo),
                 r2_lo=r2_true, r2max=r2_true, k=c["k"] + 1)
+            if record:
+                d["hist"] = c["hist"].at[c["k"]].set(r2_true)
+                d["rel"] = c["rel"].at[c["k"]].set(True)
+            return d
 
         def keep(_):
-            return dict(c, p=p, r_lo=r_lo, x_lo=x_lo, r2_lo=r2_new,
-                        r2=r2_new.astype(rdt), r2max=r2max, k=c["k"] + 1)
+            d = dict(c, p=p, r_lo=r_lo, x_lo=x_lo, r2_lo=r2_new,
+                     r2=r2_new.astype(rdt), r2max=r2max, k=c["k"] + 1)
+            if record:
+                d["hist"] = c["hist"].at[c["k"]].set(r2_new.astype(rdt))
+                d["rel"] = c["rel"]
+            return d
 
         return jax.lax.cond(do_reliable, reliable, keep, None)
 
     init = dict(b=b, x=x, r=r, r2=r2.astype(rdt), r_lo=r_lo, p=p, x_lo=x_lo,
                 r2_lo=r2.astype(rdt), r2max=r2.astype(rdt), k=jnp.int32(0))
+    if record:
+        init["hist"] = jnp.full((maxiter + 1,), jnp.nan, rdt)
+        init["rel"] = jnp.zeros((maxiter + 1,), bool)
     out = jax.lax.while_loop(cond, body, init)
     # final fold of any un-injected sloppy contribution
     x_fin = out["x"] + codec.up(out["x_lo"])
     r_fin = b - matvec_hi(x_fin)
     r2_fin = blas.norm2_comp(r_fin)
-    return SolverResult(x_fin, out["k"], r2_fin, r2_fin <= stop)
+    hist = ({"r2": out["hist"], "reliable": out["rel"]} if record
+            else None)
+    return SolverResult(x_fin, out["k"], r2_fin, r2_fin <= stop, hist)
 
 
 def cg_reliable_df(op_df, matvec_lo: Callable, rhs_df, codec: StorageCodec,
                    tol: float = 1e-10, maxiter: int = 4000,
-                   delta: float = 0.1) -> SolverResult:
+                   delta: float = 0.1, record: bool = False) -> SolverResult:
     """Extended-precision reliable-update CG on the normal equations.
 
     The TPU analog of QUDA's double-precise / sloppy-pair solve to 1e-10
@@ -298,25 +317,47 @@ def cg_reliable_df(op_df, matvec_lo: Callable, rhs_df, codec: StorageCodec,
                                       rn2_true <= c["stop_n"])
             stop_n_new = jnp.where(tighten, c["stop_n"] / 16.0,
                                    c["stop_n"])
-            return dict(
+            d = dict(
                 c, x=x_new, d2=d2, stop_n=stop_n_new,
                 r_lo=codec.down(rn), p=codec.down(rn),
                 x_lo=jnp.zeros_like(x_lo),
                 r2_lo=rn2_true, r2max=rn2_true, k=c["k"] + 1)
+            if record:
+                # record the TRUE normal-equation residual, not d2: the
+                # keep branch records sloppy normal-eq norms, and one
+                # history must stay one system or the curve is
+                # unreadable (the direct-system certificate is the
+                # returned r2, judged against stop_d)
+                d["hist"] = c["hist"].at[c["k"]].set(rn2_true)
+                d["rel"] = c["rel"].at[c["k"]].set(True)
+            return d
 
         def keep(_):
-            return dict(c, p=p, r_lo=r_lo, x_lo=x_lo, r2_lo=r2_new,
-                        r2max=r2max, k=c["k"] + 1)
+            d = dict(c, p=p, r_lo=r_lo, x_lo=x_lo, r2_lo=r2_new,
+                     r2max=r2max, k=c["k"] + 1)
+            if record:
+                d["hist"] = c["hist"].at[c["k"]].set(r2_new)
+                d["rel"] = c["rel"]
+            return d
 
         return jax.lax.cond(do_reliable, reliable, keep, None)
 
     init = dict(x=x, d2=b2d, stop_n=stop_n, r_lo=r_lo, p=r_lo, x_lo=x_lo,
                 r2_lo=rn2, r2max=rn2, k=jnp.int32(0))
+    if record:
+        init["hist"] = jnp.full((maxiter + 1,), jnp.nan, f32)
+        init["rel"] = jnp.zeros((maxiter + 1,), bool)
     out = jax.lax.while_loop(cond, body, init)
     x_fin = dfm.add(out["x"], dfm.promote(codec.up(out["x_lo"])))
     d_df = op_df.residual_df(rhs_df, x_fin)
     d2_fin = dfm.to_f32(dfm.norm2(d_df))
-    return SolverResult(x_fin, out["k"], d2_fin, d2_fin <= stop_d)
+    # the history is the NORMAL-equation residual curve (|Mdag r|^2,
+    # sloppy between reliable updates, true at them) — ship its own
+    # reference norm |Mdag b|^2 so harvest() normalizes relres in the
+    # recorded system instead of the caller's direct-system b2
+    hist = ({"r2": out["hist"], "reliable": out["rel"], "b2": bn2}
+            if record else None)
+    return SolverResult(x_fin, out["k"], d2_fin, d2_fin <= stop_d, hist)
 
 
 def solve_refined(matvec_hi: Callable, inner_solve: Callable, b: jnp.ndarray,

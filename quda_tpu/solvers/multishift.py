@@ -27,17 +27,25 @@ class MultiShiftResult(NamedTuple):
     iters: jnp.ndarray
     r2: jnp.ndarray         # base-system final |r|^2
     converged: jnp.ndarray  # (n_shifts,) bool
+    # optional per-iteration history (record=True): {"r2": base-system
+    # norms, "shift_r2": (slots, n_shifts) analytic shifted residuals}
+    history: object = None
 
 
 def multishift_cg(matvec: Callable, b: jnp.ndarray,
                   shifts: Sequence[float], tol: float = 1e-10,
-                  maxiter: int = 2000) -> MultiShiftResult:
+                  maxiter: int = 2000,
+                  record: bool = False) -> MultiShiftResult:
     """Solve (matvec + shift_i) x_i = b, matvec Hermitian positive
     semi-definite and every shift >= 0 (the RHMC setting).
 
     Shifts are offset so the BASE system includes the smallest shift (QUDA
     orders shifts ascending and iterates the zeroth); convergence of shift i
     is |r_i|^2 = zeta_i^2 |r|^2 <= tol^2 |b|^2.
+
+    ``record=True`` additionally returns per-iteration base residual
+    norms and the analytically-known per-shift residuals
+    (|r_s|^2 = zeta_s^2 |r|^2) as ``history`` for obs/convergence.py.
     """
     shifts = tuple(float(s) for s in shifts)
     ns = len(shifts)
@@ -64,6 +72,9 @@ def multishift_cg(matvec: Callable, b: jnp.ndarray,
         beta_old=jnp.zeros((), rdt),
         k=jnp.int32(0),
     )
+    if record:
+        state["hist"] = jnp.full((maxiter + 1,), jnp.nan, rdt)
+        state["shist"] = jnp.full((maxiter + 1, ns), jnp.nan, rdt)
 
     def shift_r2(c):
         return (c["zeta"] ** 2) * c["r2"]
@@ -96,10 +107,17 @@ def multishift_cg(matvec: Callable, b: jnp.ndarray,
         p = (expand(zeta_new).astype(b.dtype) * r[None]
              + expand(beta_s).astype(b.dtype) * c["p"])
 
-        return dict(x=x, p=p, r=r, r2=r2_new, zeta=zeta_new,
-                    zeta_old=c["zeta"], alpha_old=alpha, beta_old=beta,
-                    k=c["k"] + 1)
+        nxt = dict(x=x, p=p, r=r, r2=r2_new, zeta=zeta_new,
+                   zeta_old=c["zeta"], alpha_old=alpha, beta_old=beta,
+                   k=c["k"] + 1)
+        if record:
+            nxt["hist"] = c["hist"].at[c["k"]].set(r2_new)
+            nxt["shist"] = c["shist"].at[c["k"]].set(
+                (zeta_new ** 2) * r2_new)
+        return nxt
 
     out = jax.lax.while_loop(cond, body, state)
     conv = shift_r2(out) <= stop
-    return MultiShiftResult(out["x"], out["k"], out["r2"], conv)
+    hist = ({"r2": out["hist"], "shift_r2": out["shist"]} if record
+            else None)
+    return MultiShiftResult(out["x"], out["k"], out["r2"], conv, hist)

@@ -181,7 +181,26 @@ _register("QUDA_TPU_DETERMINISTIC_REDUCE", "bool", True,
           "per compiled executable already",
           reference="QUDA_DETERMINISTIC_REDUCE")
 
-# -- monitoring / profiling -------------------------------------------------
+# -- monitoring / profiling / tracing ---------------------------------------
+_register("QUDA_TPU_TRACE", "bool", False,
+          "enable the observability layer (quda_tpu/obs): nestable "
+          "span tracing of every API solve (chrome-trace JSON + JSONL "
+          "event stream), per-iteration convergence recording surfaced "
+          "on InvertParam.res_history, and roofline attribution rows; "
+          "off (default) = zero-overhead no-op spans and unmodified "
+          "solver loop carries",
+          reference="pushProfile spans + profile_N.tsv (lib/tune.cpp:"
+                    "450-474)")
+_register("QUDA_TPU_TRACE_PATH", "str", "",
+          "directory for trace artifacts (trace.json / "
+          "trace_events.jsonl); empty = QUDA_TPU_RESOURCE_PATH, else "
+          "the working directory",
+          reference="QUDA_PROFILE_OUTPUT_BASE")
+_register("QUDA_TPU_TRACE_EVENTS_MAX", "int", 200000,
+          "cap on buffered trace events per session; events past the "
+          "cap are dropped and counted in the flushed trace's "
+          "otherData.dropped_events",
+          reference="bounded profiling buffers")
 _register("QUDA_TPU_ENABLE_MONITOR", "bool", False,
           "periodically sample device/host memory into the monitor log",
           reference="QUDA_ENABLE_MONITOR")

@@ -26,17 +26,21 @@ class TimeProfile:
         self.name = name
         self.seconds: Dict[str, float] = defaultdict(float)
         self.count: Dict[str, int] = defaultdict(int)
-        self._open: Dict[str, float] = {}
+        # per-category stack of open start times: nested same-category
+        # spans each keep their own interval (a plain dict dropped the
+        # outer interval on re-entrant start, losing its time entirely)
+        self._open: Dict[str, List[float]] = {}
 
     def start(self, category: str = "total"):
-        self._open[category] = time.perf_counter()
+        self._open.setdefault(category, []).append(time.perf_counter())
 
     def stop(self, category: str = "total", sync=None):
         if sync is not None:
             sync.block_until_ready()
-        t0 = self._open.pop(category, None)
-        if t0 is None:
-            return
+        stack = self._open.get(category)
+        if not stack:
+            return          # unmatched stop stays a no-op
+        t0 = stack.pop()
         self.seconds[category] += time.perf_counter() - t0
         self.count[category] += 1
 

@@ -64,15 +64,32 @@ def tuning_enabled() -> bool:
     return qconf.get("QUDA_TPU_ENABLE_TUNING", fresh=True)
 
 
+def _obs_event(name: str, **fields):
+    """Mirror tuner decisions into the trace stream (no-op when tracing
+    is off) so every cached choice is auditable next to the spans it
+    affects — the policy-engine-as-profiler contract."""
+    try:
+        from ..obs import trace as otr
+        otr.event(name, cat="tune", **fields)
+    except Exception:
+        pass
+
+
 def tune(name: str, volume, candidates: Dict[str, Callable], args: tuple,
          aux: str = "", reps: int = 3, inner: int = 5) -> str:
     """Return the winning candidate key; time once, cache forever.
 
     candidates: {param_string: jitted callable}; each is called as f(*args)
     and must return a jax array (block_until_ready used for timing).
+    Candidate timings, failures, the winner and cache hits are emitted
+    as trace events (obs/trace.py) and the candidate timings accumulate
+    into the profiler half (record_launch -> profile_N.tsv).
     """
     key = tune_key(name, volume, aux)
     if key in _cache and _cache[key]["param"] in candidates:
+        _obs_event("tune_cached", key=key,
+                   param=_cache[key]["param"],
+                   seconds=_cache[key].get("time"))
         return _cache[key]["param"]
     if not tuning_enabled():
         return next(iter(candidates))
@@ -89,13 +106,18 @@ def tune(name: str, volume, candidates: Dict[str, Callable], args: tuple,
                 out.block_until_ready()
                 times.append((time.perf_counter() - t0) / inner)
             t = min(times)
-        except Exception:
+        except Exception as e:
+            _obs_event("tune_candidate_failed", key=key, param=param,
+                       error=str(e)[:120])
             continue
+        record_launch(name, volume, f"{aux}|{param}", t)
+        _obs_event("tune_candidate", key=key, param=param, seconds=t)
         if t < best_t:
             best, best_t = param, t
     if best is None:
         raise RuntimeError(f"no tuning candidate succeeded for {key}")
     _cache[key] = {"param": best, "time": best_t}
+    _obs_event("tune_winner", key=key, param=best, seconds=best_t)
     save_cache()
     return best
 
