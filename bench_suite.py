@@ -342,6 +342,38 @@ def main(argv):
                      stp.dslash_staggered_pallas(
                          g, fb, p, X, long_pl=g, long_bw_pl=lb)),
                  (g_pairs,), stag_p, stag_flops, stag_bytes))
+            # round-10 kernel-form A/B (PERF.md round 8 "re-measure
+            # before and after (a)"): the SAME operator through (i) the
+            # two-pass gather form above (1512 B/site model), (ii) the
+            # two-pass scatter form (984 B/site, no backward copies) and
+            # (iii) the FUSED single-pass fat+Naik kernel (864 B/site,
+            # one launch, one psi read, no XLA sum pass) — raced, not
+            # assumed, since v3 LOST for Wilson on this chip
+            cases.append(
+                ("improved_staggered_v3",
+                 lambda g, p: stp.dslash_staggered_pallas_v3(
+                     g, p, X, long_pl=g),
+                 (g_pairs,), stag_p, stag_flops, stag_bytes))
+            cases.append(
+                ("improved_staggered_fused",
+                 lambda g, p: stp.dslash_staggered_pallas_fused(
+                     g, p, X, long_pl=g),
+                 (g_pairs,), stag_p, stag_flops, stag_bytes))
+            # staggered MRHS amortization curve (the round-7 Wilson
+            # measurement on the second headline family): fat/long tiles
+            # fetched once per (t, z-block), N color-spinor tiles
+            # streamed through them — per-RHS model 360 + 1152/N B/site
+            for nrhs in (1, 4, 8):
+                sp_b = jnp.stack([jnp.roll(stag_p, i, axis=-1)
+                                  for i in range(nrhs)])
+                sp_b.block_until_ready()
+                cases.append(
+                    (f"staggered_mrhs_n{nrhs}",
+                     lambda g, p, fb=fat_bw, lb=long_bw: (
+                         stp.dslash_staggered_pallas_mrhs(
+                             g, fb, p, X, long_pl=g, long_bw_pl=lb)),
+                     (g_pairs,), sp_b, stag_flops * nrhs,
+                     2 * gauge_bytes + nrhs * 2 * stag_spinor_bytes))
         if complex_ok:
             from quda_tpu.ops import wilson as wops
             from quda_tpu.models.clover import DiracClover
@@ -739,6 +771,46 @@ def main(argv):
             except Exception as e:
                 print(json.dumps({"suite": "solver",
                                   "name": "df64_rows_24",
+                                  "error": str(e)[:140]}), flush=True)
+
+            # --- staggered/HISQ chip solver row (round 10): the second
+            # headline family through the SAME pallas-in-solver
+            # pipeline — the fused fat+Naik kernel inside the compiled
+            # CG loop (the PC operator is Hermitian positive definite,
+            # so the iteration is ONE M apply — no normal-equation wrap)
+            try:
+                from quda_tpu.models.staggered import DiracStaggeredPC
+                lng_c = (0.1 * gc_h).astype(np.complex64)
+                with jax.default_device(cpu0):
+                    gcd_s = jax.device_put(gc_h, cpu0)
+                    lcd_s = jax.device_put(lng_c, cpu0)
+                    dst_pc = DiracStaggeredPC(gcd_s, geo_c, 0.1,
+                                              improved=True,
+                                              long_links=lcd_s)
+                    # form pinned (the construction-time race cannot
+                    # execute pallas on the CPU staging device; the
+                    # kernel-form A/B lives in the dslash suite rows)
+                    sop = dst_pc.pairs(jnp.float32, use_pallas=True,
+                                       form="fused")
+                    pcs = jax.device_put(pc_h[..., :1, :], cpu0)
+                    sbe, sbo = even_odd_split(pcs, geo_c)
+                    srhs_c = dst_pc.prepare(sbe, sbo)
+                    srhs_pp_h = np.asarray(sop._to_pairs(srhs_c))
+                sop.fat_eo_pp = tuple(jax.device_put(np.asarray(g))
+                                      for g in sop.fat_eo_pp)
+                sop.long_eo_pp = tuple(jax.device_put(np.asarray(g))
+                                       for g in sop.long_eo_pp)
+                srhs_pp = jax.device_put(jnp.asarray(srhs_pp_h))
+                srhs_pp.block_until_ready()
+                fl_iter_st = (2 * 1146 + 24) * (vol_c // 2)
+                solver_row("cg_staggered_pc_f32pairs_pallas_24",
+                           jax.jit(lambda b: cg(sop.M_pairs, b,
+                                                tol=1e-6, maxiter=600)),
+                           srhs_pp, fl_iter_st, Lc, form="fused",
+                           mass=0.1)
+            except Exception as e:
+                print(json.dumps({"suite": "solver",
+                                  "name": "cg_staggered_pc_24",
                                   "error": str(e)[:140]}), flush=True)
 
     if "sharded" in suites and suite_guard("sharded"):

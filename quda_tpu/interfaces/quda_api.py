@@ -519,6 +519,27 @@ def _solve_form(d) -> str:
             return f"wilson_v{v}{suffix}"
     if "wilson" in name:
         return "wilson_xla"
+    if "staggered" in name:
+        # base traffic model keyed on the hop-set count: 'fat' = plain
+        # staggered (one hop set), 'fat_naik' = improved (fat + Naik)
+        base = ("fat_naik" if getattr(op, "long_eo_pp", None) is not None
+                else "fat")
+        if getattr(op, "use_pallas", False):
+            form = getattr(op, "_pallas_form", None)
+            if getattr(op, "_mesh", None) is not None:
+                # mesh pins the two-pass interior today (see
+                # models/staggered.py); the halo transport is
+                # policy-dependent O(surface) and lives in the trace
+                return f"staggered_sharded_{base}"
+            if form == "fused":
+                return f"staggered_{base}_fused"
+            if form == "v3":
+                return f"staggered_{base}_v3"
+            if form == "two_pass":
+                # the PERF.md round-8 model name predates the form knob
+                return ("staggered_fat_naik" if base == "fat_naik"
+                        else "staggered_fat")
+        return "staggered_xla"
     return "generic"
 
 
@@ -920,11 +941,14 @@ def invert_multi_src_quda(sources, param: InvertParam):
     * >1 device and the batch divides the device count -> SPLIT GRID
       (parallel/split.py): sources sharded over the mesh src axis,
       gauge replicated, one independent PC solve per sub-grid.
-    * otherwise, Wilson PC + CG family on the packed representation ->
-      the BATCHED PAIRS pipeline: every Krylov iterate is a packed pair
-      batch (n_src, 4, 3, 2, T, Z, Y*Xh) and the stencil is the MRHS
-      pallas eo kernel (gauge tile loaded once per (t, z-block), all
-      RHS streamed through it) or its vmapped XLA form off-TPU.
+    * otherwise, Wilson PC or staggered/HISQ PC + CG family on the
+      packed representation -> the BATCHED PAIRS pipeline: every Krylov
+      iterate is a packed pair batch ((n_src, 4, 3, 2, T, Z, Y*Xh)
+      Wilson / (n_src, 3, 2, T, Z, Y*Xh) staggered) and the stencil is
+      the MRHS pallas eo kernel (link tiles loaded once per
+      (t, z-block), all RHS streamed through them) or its vmapped XLA
+      form off-TPU.  The staggered PC operator is Hermitian, so its
+      batch runs direct CG (one M per iteration); Wilson runs CGNR.
       QUDA_TPU_MULTI_SRC_BLOCK=1 swaps the independent per-RHS lanes
       for true block CG (shared Krylov space, real Gram matmuls).
     * anything else falls back to a per-source invert_quda loop (same
@@ -978,11 +1002,23 @@ def _invert_multi_src_body(sources, param: InvertParam):
     # deep-tol batches take the per-source fallback, whose invert_quda
     # engages the df64 route (same 5e-8 threshold it uses)
     tol_ok = param.tol >= 5e-8
-    batched_ok = (mesh is None and pc
-                  and param.dslash_type == "wilson" and cg_family
-                  and tol_ok
-                  and (param.cuda_prec == "single" or on_tpu)
-                  and _packed_enabled(on_tpu))
+    stag_family = param.dslash_type in ("staggered", "asqtad", "hisq")
+    # Wilson AND the staggered/HISQ family ride the batched pairs
+    # pipeline (round 10: MILC-interface HISQ workloads no longer run
+    # the slow per-source path end to end); checked against ``mesh is
+    # None`` at the route decision below, AFTER the split-grid gate may
+    # have released an unusable mesh back to this route
+    batched_able = (pc
+                    and (param.dslash_type == "wilson" or stag_family)
+                    and cg_family and tol_ok
+                    and (param.cuda_prec == "single" or on_tpu)
+                    and _packed_enabled(on_tpu))
+    # per-UPDATED-site flops of one PC M apply (round-6 convention)
+    if stag_family:
+        flops_m = 2 * (1146 if param.dslash_type != "staggered"
+                       else 570) + 24
+    else:
+        flops_m = 2 * 1320 + 48
 
     def _finish(x_full, iters_rhs, res_rhs, mv_applies):
         param.iter_count_multi = [int(i) for i in iters_rhs]
@@ -990,7 +1026,7 @@ def _invert_multi_src_body(sources, param: InvertParam):
         param.iter_count = int(sum(param.iter_count_multi))
         param.true_res = max(param.true_res_multi)
         param.secs = time.perf_counter() - t0
-        flops = 2 * 1320 + 48        # Wilson PC M (per updated site)
+        flops = flops_m              # PC M cost (per updated site)
         sites = geom.volume // 2 if pc else geom.volume
         # per-RHS accounting, QUDA's per-source gflops convention.  The
         # batched route records each lane's OWN converged iteration
@@ -1020,7 +1056,8 @@ def _invert_multi_src_body(sources, param: InvertParam):
             f"invert_multi_src_quda: split-grid route serves Wilson PC "
             f"CG-family solves at tol >= 5e-8 only; "
             f"{param.dslash_type}/{param.inv_type} (tol {param.tol:g}) "
-            "falls back to the per-source loop", qlog.SUMMARIZE)
+            "falls back to the batched-pairs/per-source routes",
+            qlog.SUMMARIZE)
         mesh = None
 
     if mesh is not None:
@@ -1059,22 +1096,39 @@ def _invert_multi_src_body(sources, param: InvertParam):
                        for i in range(n_src)]
             return _finish(x_full, np.asarray(iters), res_rhs, 2.0)
 
-    if batched_ok:
+    if mesh is None and batched_able:
         from ..solvers.block import (_per_rhs_dot, batched_cg_pairs,
                                      block_cg_pairs)
         with otr.phase("setup", "invert_multi_src_quda"):
-            d = _build_dirac(param, True).packed()
+            d = _build_dirac(param, True)
+            if param.dslash_type == "wilson":
+                d = d.packed()
+            # staggered: pin the two_pass form — this route only ever
+            # runs the gather MRHS kernel (_d_to_mrhs), so 'auto' would
+            # race single-RHS kernels whose winner is never used
+            kw = ({"form": "two_pass"} if stag_family else {})
             op = d.pairs(jnp.float32,
                          use_pallas=_pallas_enabled(on_tpu),
-                         pallas_interpret=_pallas_interpret(on_tpu))
+                         pallas_interpret=_pallas_interpret(on_tpu),
+                         **kw)
             halves = [even_odd_split(B[i], geom) for i in range(n_src)]
             be = jnp.stack([h[0] for h in halves])
             bo = jnp.stack([h[1] for h in halves])
             rhs_b = op.prepare_pairs_mrhs(be, bo)
-            # CGNR on the batched normal equations (coefficients real —
-            # exact on pairs; same route as the single-source wil_pairs
-            # cg)
-            nrm_b = op.Mdag_pairs_mrhs(rhs_b)
+            if stag_family:
+                # the staggered PC operator is already the (Hermitian
+                # positive definite) normal operator — the batched CG
+                # runs it directly, one M apply per counted iteration
+                nrm_b = rhs_b
+                mv_b = op.M_pairs_mrhs
+                mv_applies = 1.0
+            else:
+                # CGNR on the batched normal equations (coefficients
+                # real — exact on pairs; same route as the
+                # single-source wil_pairs cg)
+                nrm_b = op.Mdag_pairs_mrhs(rhs_b)
+                mv_b = op.MdagM_pairs_mrhs
+                mv_applies = 2.0
             use_block = str(qconf.get("QUDA_TPU_MULTI_SRC_BLOCK",
                                       fresh=True)) == "1"
         solver_name = "block-cg-pairs" if use_block else \
@@ -1084,13 +1138,13 @@ def _invert_multi_src_body(sources, param: InvertParam):
                 otr.span(f"solve:{solver_name}", cat="solver",
                          nrhs=n_src, tol=param.tol):
             if use_block:
-                res = block_cg_pairs(op.MdagM_pairs_mrhs, nrm_b,
+                res = block_cg_pairs(mv_b, nrm_b,
                                      tol=param.tol,
                                      maxiter=param.maxiter,
                                      record=recording)
                 iters_rhs = np.full(n_src, int(res.iters))
             else:
-                res = batched_cg_pairs(op.MdagM_pairs_mrhs, nrm_b,
+                res = batched_cg_pairs(mv_b, nrm_b,
                                        tol=param.tol,
                                        maxiter=param.maxiter,
                                        record=recording)
@@ -1113,7 +1167,7 @@ def _invert_multi_src_body(sources, param: InvertParam):
                                                  - d_chk.M(x_full[i]))
                                       / blas.norm2(B[i])))
                        for i in range(n_src)]
-            x_out = _finish(x_full, iters_rhs, res_rhs, 2.0)
+            x_out = _finish(x_full, iters_rhs, res_rhs, mv_applies)
         if recording:
             # per-lane convergence histories (worst relative lane is
             # the headline; each lane normalized against its OWN b2)
@@ -1123,11 +1177,17 @@ def _invert_multi_src_body(sources, param: InvertParam):
                                 b2=b2_rhs)
             oconv.publish(rec, param)
             from ..obs import roofline as orf
-            form = ("wilson_mrhs"
-                    if getattr(op, "use_pallas", False) else "generic")
+            if not getattr(op, "use_pallas", False):
+                form = "generic"
+            elif not stag_family:
+                form = "wilson_mrhs"
+            else:
+                form = ("staggered_mrhs"
+                        if getattr(op, "long_eo_pp", None) is not None
+                        else "staggered_fat_mrhs")
             orf.record(form, geom.volume // 2,
-                       float(np.max(iters_rhs)) * 2.0, t_solve,
-                       nrhs=n_src, flops_per_site=2 * 1320 + 48,
+                       float(np.max(iters_rhs)) * mv_applies, t_solve,
+                       nrhs=n_src, flops_per_site=flops_m,
                        dslash_per_apply=2.0,
                        label=f"invert_multi_src_quda:{solver_name}")
         return x_out

@@ -13,6 +13,7 @@ prepare/reconstruct for the PC solve of M x = b:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..fields.geometry import EVEN, LatticeGeometry
@@ -128,11 +129,40 @@ class DiracStaggeredPC(DiracPC):
 
     def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
               pallas_interpret: bool = False,
-              pallas_version: int | None = None) -> "DiracStaggeredPCPairs":
+              pallas_version: int | None = None,
+              form: str | None = None, mesh=None,
+              sharded_policy: str | None = None
+              ) -> "DiracStaggeredPCPairs":
         """Complex-free packed companion (f32 = the precise TPU solve
         path; bf16 = the sloppy operator); see DiracStaggeredPCPairs."""
         return DiracStaggeredPCPairs(self, store_dtype, use_pallas,
-                                     pallas_interpret, pallas_version)
+                                     pallas_interpret, pallas_version,
+                                     form=form, mesh=mesh,
+                                     sharded_policy=sharded_policy)
+
+
+_STAG_FORM_NOTICED = False
+
+
+def _notice_staggered_form(form: str, policy: str | None, source: str):
+    """One-time provenance notice naming the staggered kernel form (and,
+    under a mesh, halo policy) actually selected and HOW — an env knob
+    or auto decision must never take effect without a trace (the
+    round-6 wilson.py notice rule; successor semantics of
+    _notice_sharded_policy for the second headline family)."""
+    global _STAG_FORM_NOTICED
+    if _STAG_FORM_NOTICED:
+        return
+    _STAG_FORM_NOTICED = True
+    from ..utils import logging as qlog
+    pol = f", halo policy {policy}" if policy else ""
+    qlog.printq(
+        f"staggered dslash: pallas form {form}{pol} ({source}); pin via "
+        "QUDA_TPU_STAGGERED_FORM / QUDA_TPU_SHARDED_POLICY",
+        qlog.SUMMARIZE)
+
+
+STAGGERED_FORMS = ("fused", "two_pass", "v3")
 
 
 class DiracStaggeredPCPairs:
@@ -143,21 +173,48 @@ class DiracStaggeredPCPairs:
     Mirrors models/wilson.DiracWilsonPCPackedSloppy: half-lattice links
     packed to (4,3,3,2,T,Z,Y*Xh) re/im planes at ``store_dtype``, spinors
     (3,2,T,Z,Y*Xh); compute f32.  ``use_pallas`` swaps the stencil for
-    the hand-tuned eo kernel (ops/staggered_pallas) with its pre-shifted
-    backward links computed once here (per KS-link load).
+    the hand-tuned eo kernels (ops/staggered_pallas); the kernel FORM is
+    selected by ``form`` / QUDA_TPU_STAGGERED_FORM:
+
+    * ``fused``    — single-pass fat+Naik (one launch, one psi read, no
+                     XLA sum pass; ~864 vs 1512 B/site) — improved only;
+    * ``two_pass`` — separate fat/long gather launches with resident
+                     pre-shifted backward links (the pre-round-10 form,
+                     = the old pallas_version=2);
+    * ``v3``       — two-pass scatter form (= pallas_version=3);
+    * ``auto``     — race the applicable forms via utils.tune at
+                     construction and cache the winner — A/B'd, not
+                     assumed (the scatter form LOST for Wilson on chip,
+                     PERF.md round 5, so no staggered form is presumed
+                     either).  Off-chip (interpret mode) the race would
+                     time the interpreter, not the hardware, so auto
+                     resolves statically to the projected winner (fused
+                     for improved, two_pass for fat-only) with a notice.
+
+    ``mesh`` runs the hop under shard_map (t/z mesh axes partition T/Z)
+    through the sharded staggered eo policies
+    (parallel/pallas_dslash.dslash_staggered_eo_pallas_sharded[_v3]),
+    with the halo transport picked by ``sharded_policy`` /
+    QUDA_TPU_SHARDED_POLICY — the same policy seam as Wilson ('auto'
+    races and caches per (volume, mesh, form)).
 
     Reference behavior: QUDA solves staggered systems in float2-pair
     native orders on device too (include/color_spinor_field_order.h);
-    this is that representation made explicit.
+    this is that representation made explicit, and the form selection is
+    the dslash-policy race of lib/dslash_policy.hpp applied to
+    include/kernels/dslash_staggered.cuh's improved=true fusion.
     """
 
     hermitian = True
 
     def __init__(self, dpc: DiracStaggeredPC, store_dtype=jnp.float32,
                  use_pallas: bool = False, pallas_interpret: bool = False,
-                 pallas_version: int | None = None):
+                 pallas_version: int | None = None,
+                 form: str | None = None, mesh=None,
+                 sharded_policy: str | None = None):
         from ..ops import staggered_packed as spk
         from ..ops.wilson_packed import to_packed_pairs
+        from ..utils import config as qconf
         self.geom = dpc.geom
         self.mass = float(dpc.mass)
         self.matpc = dpc.matpc
@@ -171,32 +228,299 @@ class DiracStaggeredPCPairs:
             for g in dpc.long_eo) if dpc.long_eo is not None else None)
         self.use_pallas = use_pallas
         self._pallas_interpret = pallas_interpret
-        if pallas_version is None:
-            from ..utils import config as qconf
-            pallas_version = qconf.get("QUDA_TPU_PALLAS_VERSION",
-                                       fresh=True)
-        if pallas_version not in (2, 3):
-            raise ValueError(f"pallas_version must be 2 or 3, got "
-                             f"{pallas_version}")
-        self._pallas_version = pallas_version
-        # v2 pallas path only: resident pre-shifted backward links (the
-        # v3 scatter-form kernel reads the opposite-parity links as-is)
-        if use_pallas and pallas_version == 2:
-            from ..ops import staggered_pallas as spl
-            self._fat_bw = tuple(
-                spl.backward_links_eo(self.fat_eo_pp[1 - p], self.dims,
-                                      p, 1) for p in (0, 1))
-            self._long_bw = (tuple(
-                spl.backward_links_eo(self.long_eo_pp[1 - p], self.dims,
-                                      p, 3) for p in (0, 1))
-                if self.long_eo_pp is not None else None)
+        self._fat_bw = self._long_bw = None
+        improved = self.long_eo_pp is not None
+
+        # -- kernel-form resolution (explicit kwarg > legacy
+        # pallas_version kwarg > QUDA_TPU_STAGGERED_FORM knob, whose
+        # empty value falls back to QUDA_TPU_PALLAS_VERSION) ----------
+        if form is None:
+            if pallas_version is not None:
+                if pallas_version not in (2, 3):
+                    raise ValueError(f"pallas_version must be 2 or 3, "
+                                     f"got {pallas_version}")
+                form = "two_pass" if pallas_version == 2 else "v3"
+            else:
+                form = str(qconf.get("QUDA_TPU_STAGGERED_FORM",
+                                     fresh=True))
+                if not form:
+                    pv = qconf.get("QUDA_TPU_PALLAS_VERSION", fresh=True)
+                    if pv not in (2, 3):
+                        raise ValueError(
+                            f"QUDA_TPU_PALLAS_VERSION must be 2 or 3, "
+                            f"got {pv}")
+                    form = "two_pass" if pv == 2 else "v3"
+        if form not in STAGGERED_FORMS + ("auto",):
+            raise ValueError(f"staggered form must be one of "
+                             f"{STAGGERED_FORMS + ('auto',)}, got "
+                             f"{form!r}")
+        if form == "fused" and not improved:
+            # the fused kernel IS the fat+Naik fusion; a fat-only
+            # operator has a single hop set (nothing to fuse)
+            _notice_staggered_form("two_pass", None,
+                                   "fused needs fat+Naik; fat-only "
+                                   "falls back")
+            form = "two_pass"
+
+        # single-chip escape: a 1-device mesh shards nothing
+        if mesh is not None and getattr(mesh, "size", 2) == 1:
+            mesh = None
+        self._mesh = mesh
+        if mesh is not None:
+            if not use_pallas:
+                raise ValueError(
+                    "mesh-sharded staggered pair operators need "
+                    "use_pallas=True (the XLA pair stencil shards via "
+                    "GSPMD instead)")
+            if form in ("auto", "fused"):
+                # sharded exteriors exist for the gather and scatter
+                # two-pass forms; fused-under-mesh is future work, and
+                # racing interpret/sharded candidates at construction
+                # would time the wrong thing — pin the measured
+                # single-chip default and say so
+                _notice_staggered_form(
+                    "two_pass", None,
+                    f"mesh pins two_pass (requested {form})")
+                form = "two_pass"
+            self._sharded_policy = (
+                sharded_policy
+                or str(qconf.get("QUDA_TPU_SHARDED_POLICY", fresh=True))
+                or "auto")
+        elif use_pallas and form == "auto":
+            from ..utils import tune as qtune
+            default = "fused" if improved else "two_pass"
+            if pallas_interpret or not qtune.tuning_enabled():
+                _notice_staggered_form(
+                    default, None,
+                    "auto default (no chip race: interpret mode or "
+                    "tuning disabled)")
+                form = default
+            else:
+                form = self._race_form()
+                _notice_staggered_form(
+                    form, None, "raced+cached "
+                    "(QUDA_TPU_STAGGERED_FORM=auto)")
+        elif form == "auto":
+            # XLA stencil path: the form knob has no kernel to pick
+            form = "two_pass"
+        self._pallas_form = form
+        # legacy attribute (callers/benches keyed on the wilson-style
+        # generation number): gather forms report 2, scatter 3
+        self._pallas_version = 3 if form == "v3" else 2
+
+        # gather forms keep resident pre-shifted backward links (the
+        # scatter/fused forms read the opposite-parity links as-is)
+        if use_pallas and mesh is None and form == "two_pass":
+            self._ensure_bw()
+
+        # multi-chip: move the resident links (and the globally
+        # pre-shifted backward links the gather form needs) onto the
+        # mesh once here, then resolve the halo policy
+        if mesh is not None:
+            if form == "two_pass":
+                self._ensure_bw()
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            gspec = NamedSharding(
+                mesh, P(None, None, None, None, "t", "z", None))
+            put = lambda gs: (tuple(jax.device_put(g, gspec)
+                                    for g in gs)
+                              if gs is not None else None)
+            self.fat_eo_pp = put(self.fat_eo_pp)
+            self.long_eo_pp = put(self.long_eo_pp)
+            self._fat_bw = put(self._fat_bw)
+            self._long_bw = put(self._long_bw)
+            if self._sharded_policy == "auto":
+                # race EAGERLY, at construction (the first hop usually
+                # fires inside a solver trace, where timing concrete
+                # candidates is impossible)
+                self._resolve_sharded_policy(self.matpc, None)
+            else:
+                _notice_staggered_form(form, self._sharded_policy,
+                                       "pinned")
+
+    def _ensure_bw(self):
+        """Resident pre-shifted backward links of the gather forms
+        (backward_links_eo on the GLOBAL arrays — under a mesh their t/z
+        shifts then already carry the cross-shard links), computed once
+        per KS-link load and shared by the two_pass and MRHS kernels."""
+        if self._fat_bw is not None:
+            return
+        from ..ops import staggered_pallas as spl
+        self._fat_bw = tuple(
+            spl.backward_links_eo(self.fat_eo_pp[1 - p], self.dims,
+                                  p, 1) for p in (0, 1))
+        self._long_bw = (tuple(
+            spl.backward_links_eo(self.long_eo_pp[1 - p], self.dims,
+                                  p, 3) for p in (0, 1))
+            if self.long_eo_pp is not None else None)
+
+    # -- form race (utils.tune at operator construction) ----------------
+    def _form_candidates(self):
+        """{form: callable(psi_pp)} applying one target-parity hop per
+        SELECTABLE form — the race candidates AND the bit-match test
+        surface (each callable runs exactly what D_to_pairs would run
+        with that form pinned)."""
+        from ..ops import staggered_pallas as spl
+        improved = self.long_eo_pp is not None
+        p = self.matpc
+        itp = self._pallas_interpret
+        cands = {}
+        if improved:
+            cands["fused"] = lambda psi: spl.dslash_staggered_eo_pallas_fused(
+                self.fat_eo_pp[p], self.fat_eo_pp[1 - p], psi, self.dims,
+                p, long_here_pl=self.long_eo_pp[p],
+                long_there_pl=self.long_eo_pp[1 - p], interpret=itp)
+
+        def two_pass(psi):
+            self._ensure_bw()
+            return spl.dslash_staggered_eo_pallas(
+                self.fat_eo_pp[p], self._fat_bw[p], psi, self.dims, p,
+                long_here_pl=(self.long_eo_pp[p] if improved else None),
+                long_bw_pl=(self._long_bw[p] if improved else None),
+                interpret=itp)
+
+        cands["two_pass"] = two_pass
+        cands["v3"] = lambda psi: spl.dslash_staggered_eo_pallas_v3(
+            self.fat_eo_pp[p], self.fat_eo_pp[1 - p], psi, self.dims, p,
+            long_here_pl=(self.long_eo_pp[p] if improved else None),
+            long_there_pl=(self.long_eo_pp[1 - p] if improved else None),
+            interpret=itp)
+        return cands
+
+    def _race_form(self) -> str:
+        """Race the applicable kernel forms on a concrete dummy spinor
+        via utils.tune (QUDA's tune.cpp:862 rule — policies are timed,
+        never assumed) and cache the winner per (volume, improved,
+        dtype) in the tunecache.  A form that cannot compile here
+        simply loses (tune skips failing candidates)."""
+        from ..utils import tune as qtune
+        T, Z, _, _ = self.dims
+        yxh = self.fat_eo_pp[0].shape[-1]
+        psi0 = jnp.zeros((3, 2, T, Z, yxh), self.store_dtype)
+        improved = self.long_eo_pp is not None
+        cands = {k: jax.jit(f)
+                 for k, f in self._form_candidates().items()}
+        return qtune.tune(
+            "staggered_eo_form", self.dims, cands, (psi0,),
+            aux=f"{'fat_naik' if improved else 'fat'}|"
+                f"{jnp.dtype(self.store_dtype).name}")
+
+    # -- sharded dispatch (the QUDA_TPU_SHARDED_POLICY seam) ------------
+    def _build_sharded_fn(self, target_parity, out_dtype, policy: str):
+        """jitted shard_map of the sharded staggered eo policy for one
+        (parity, out_dtype, halo policy) configuration."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel import compat
+        from ..parallel.pallas_dslash import (
+            dslash_staggered_eo_pallas_sharded,
+            dslash_staggered_eo_pallas_sharded_v3)
+        pspec = P(None, None, "t", "z", None)
+        gspec = P(None, None, None, None, "t", "z", None)
+        improved = self.long_eo_pp is not None
+        odt = out_dtype or self.store_dtype
+
+        if self._pallas_form == "two_pass":
+            def local(fh, fb, lh, lb, psi):
+                return dslash_staggered_eo_pallas_sharded(
+                    fh, fb, psi, self.dims, target_parity, self._mesh,
+                    long_here_pl=lh, long_bw_pl=lb,
+                    interpret=self._pallas_interpret,
+                    policy=policy).astype(odt)
+        else:
+            def local(fh, ft, lh, lt, psi):
+                return dslash_staggered_eo_pallas_sharded_v3(
+                    fh, ft, psi, self.dims, target_parity, self._mesh,
+                    long_here_pl=lh, long_there_pl=lt,
+                    interpret=self._pallas_interpret,
+                    policy=policy).astype(odt)
+        n_g = 4 if improved else 2
+        if improved:
+            fn = compat.shard_map(
+                local, mesh=self._mesh,
+                in_specs=(gspec,) * n_g + (pspec,), out_specs=pspec)
+        else:
+            fn = compat.shard_map(
+                lambda fh, fb, psi: local(fh, fb, None, None, psi),
+                mesh=self._mesh, in_specs=(gspec, gspec, pspec),
+                out_specs=pspec)
+        return jax.jit(fn)
+
+    def _sharded_args(self, target_parity):
+        p = target_parity
+        second = (self._fat_bw[p] if self._pallas_form == "two_pass"
+                  else self.fat_eo_pp[1 - p])
+        if self.long_eo_pp is None:
+            return (self.fat_eo_pp[p], second)
+        fourth = (self._long_bw[p] if self._pallas_form == "two_pass"
+                  else self.long_eo_pp[1 - p])
+        return (self.fat_eo_pp[p], second, self.long_eo_pp[p], fourth)
+
+    def _resolve_sharded_policy(self, target_parity, out_dtype) -> str:
+        """'auto' races every registered halo policy on REAL
+        shard-resident operands via utils.tune and caches the winner per
+        (volume, mesh, form) — the Wilson policy engine covering
+        staggered through the same seam."""
+        pol = self._sharded_policy
+        if pol != "auto":
+            return pol
+        won = getattr(self, "_sharded_policy_winner", None)
+        if won is not None:
+            return won
+        from ..parallel.pallas_dslash import SHARDED_POLICIES
+        from ..utils import tune as qtune
+        cands = {p: self._build_sharded_fn(target_parity, out_dtype, p)
+                 for p in SHARDED_POLICIES}
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        T, Z, _, _ = self.dims
+        yxh = self.fat_eo_pp[0].shape[-1]
+        psi0 = jax.device_put(
+            jnp.zeros((3, 2, T, Z, yxh), self.store_dtype),
+            NamedSharding(self._mesh, P(None, None, "t", "z", None)))
+        mesh_shape = tuple(int(self._mesh.shape[a])
+                           for a in self._mesh.axis_names)
+        won = qtune.tune(
+            "staggered_eo_sharded_policy", self.dims, cands,
+            self._sharded_args(target_parity) + (psi0,),
+            aux=f"{self._pallas_form}|mesh{mesh_shape}|"
+                f"{jnp.dtype(self.store_dtype).name}")
+        self._sharded_policy_winner = won
+        key = (target_parity,
+               jnp.dtype(out_dtype or self.store_dtype).name)
+        self.__dict__.setdefault("_sharded_fns", {})[key] = cands[won]
+        _notice_staggered_form(self._pallas_form, won,
+                               "raced+cached "
+                               "(QUDA_TPU_SHARDED_POLICY=auto)")
+        return won
+
+    def _sharded_d_to(self, target_parity, out_dtype):
+        cache = self.__dict__.setdefault("_sharded_fns", {})
+        key = (target_parity,
+               jnp.dtype(out_dtype or self.store_dtype).name)
+        if key not in cache:
+            policy = self._resolve_sharded_policy(target_parity,
+                                                  out_dtype)
+            cache[key] = self._build_sharded_fn(target_parity,
+                                                out_dtype, policy)
+        return cache[key]
 
     def D_to_pairs(self, psi_pp, target_parity, out_dtype=None):
         out_dtype = out_dtype or self.store_dtype
         if self.use_pallas:
             from ..ops import staggered_pallas as spl
             p = target_parity
-            if self._pallas_version == 3:
+            if self._mesh is not None:
+                fn = self._sharded_d_to(p, out_dtype)
+                return fn(*self._sharded_args(p), psi_pp)
+            if self._pallas_form == "fused":
+                return spl.dslash_staggered_eo_pallas_fused(
+                    self.fat_eo_pp[p], self.fat_eo_pp[1 - p], psi_pp,
+                    self.dims, p,
+                    long_here_pl=self.long_eo_pp[p],
+                    long_there_pl=self.long_eo_pp[1 - p],
+                    interpret=self._pallas_interpret,
+                    out_dtype=out_dtype)
+            if self._pallas_form == "v3":
                 return spl.dslash_staggered_eo_pallas_v3(
                     self.fat_eo_pp[p], self.fat_eo_pp[1 - p], psi_pp,
                     self.dims, p,
@@ -218,6 +542,27 @@ class DiracStaggeredPCPairs:
             self.fat_eo_pp, psi_pp, self.dims, target_parity,
             self.long_eo_pp, out_dtype=out_dtype)
 
+    def _d_to_mrhs(self, psi_b, target_parity, out_dtype=None):
+        """Batched eo hop: psi_b (N,3,2,T,Z,Y*Xh).  The single-chip
+        pallas path routes the MRHS kernel (fat/long tiles fetched once
+        per (t, z-block), N spinor tiles streamed through them — the
+        round-7 Wilson move on the second headline family); everything
+        else falls back to the vmapped single-RHS stencil."""
+        out_dtype = out_dtype or self.store_dtype
+        if self.use_pallas and self._mesh is None:
+            from ..ops import staggered_pallas as spl
+            self._ensure_bw()
+            p = target_parity
+            return spl.dslash_staggered_eo_pallas_mrhs(
+                self.fat_eo_pp[p], self._fat_bw[p], psi_b, self.dims, p,
+                long_here_pl=(self.long_eo_pp[p]
+                              if self.long_eo_pp is not None else None),
+                long_bw_pl=(self._long_bw[p]
+                            if self._long_bw is not None else None),
+                interpret=self._pallas_interpret, out_dtype=out_dtype)
+        return jax.vmap(
+            lambda q: self.D_to_pairs(q, target_parity, out_dtype))(psi_b)
+
     def M_pairs(self, x_pp):
         """(4m^2 - D_pq D_qp) on pair arrays — Hermitian positive
         definite; cg(op.M_pairs, rhs_pairs) solves it directly."""
@@ -231,6 +576,25 @@ class DiracStaggeredPCPairs:
 
     def MdagM_pairs(self, x_pp):
         return self.M_pairs(self.M_pairs(x_pp))
+
+    # -- multi-RHS (leading batch axis) forms ---------------------------
+    # One home for the batched Schur composition so the MRHS solve path
+    # (solvers/block.py, invert_multi_src_quda) cannot diverge from the
+    # single-RHS math — the models/wilson pattern on the second headline
+    # family.  The PC operator is Hermitian positive definite per lane,
+    # so the batched solvers run it directly (no normal-equation wrap).
+
+    def M_pairs_mrhs(self, x_b):
+        p = self.matpc
+        tmp = self._d_to_mrhs(x_b, 1 - p, self.store_dtype)
+        dd = self._d_to_mrhs(tmp, p, jnp.float32)
+        out = (4.0 * self.mass ** 2) * x_b.astype(jnp.float32) - dd
+        return out.astype(self.store_dtype)
+
+    Mdag_pairs_mrhs = M_pairs_mrhs
+
+    def MdagM_pairs_mrhs(self, x_b):
+        return self.M_pairs_mrhs(self.M_pairs_mrhs(x_b))
 
     # -- complex in/out wrappers (interface boundary) -------------------
     def _to_pairs(self, x):
@@ -276,4 +640,33 @@ class DiracStaggeredPCPairs:
             2.0 * self.mass)
         x_p = self._from_pairs(x_pp, b_q.dtype)
         x_q = self._from_pairs(x_q_pp, b_q.dtype)
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)
+
+    # -- multi-RHS boundary helpers (the invert_multi_src_quda route) ---
+    def prepare_pairs_mrhs(self, b_even_b, b_odd_b):
+        """Batched canonical complex parity sources (N, T,Z,Y,Xh,1,3) ->
+        batched pair-form PC rhs (N,3,2,T,Z,Y*Xh): 2m b_p - D_pq b_q
+        with the batched hop, so the MRHS stencil serves source
+        preparation too (links read once for all N)."""
+        p = self.matpc
+        b_p, b_q = ((b_even_b, b_odd_b) if p == EVEN
+                    else (b_odd_b, b_even_b))
+        to_pp = jax.vmap(self._to_pairs)
+        bp = to_pp(b_p).astype(jnp.float32)
+        dq = self._d_to_mrhs(to_pp(b_q), p, jnp.float32)
+        return ((2.0 * self.mass) * bp - dq).astype(self.store_dtype)
+
+    def solution_from_pairs_mrhs(self, x_b, dtype=jnp.complex64):
+        return jax.vmap(lambda x: self._from_pairs(x, dtype))(x_b)
+
+    def reconstruct_pairs_mrhs(self, x_b, b_even_b, b_odd_b):
+        """Batched reconstruct_pairs: x_q = (b_q - D_qp x_p) / 2m with
+        the MRHS hop.  Returns canonical complex (even, odd) batches."""
+        p = self.matpc
+        b_q = b_odd_b if p == EVEN else b_even_b
+        to_pp = jax.vmap(self._to_pairs)
+        dq = self._d_to_mrhs(x_b, 1 - p, jnp.float32)
+        xq_b = (to_pp(b_q).astype(jnp.float32) - dq) / (2.0 * self.mass)
+        x_p = self.solution_from_pairs_mrhs(x_b, b_q.dtype)
+        x_q = self.solution_from_pairs_mrhs(xq_b, b_q.dtype)
         return (x_p, x_q) if p == EVEN else (x_q, x_p)

@@ -446,8 +446,10 @@ class _PackedHopMixin:
         # the winning candidate is already traced+compiled — seed the
         # hop cache with it so the first real application does not pay
         # an identical second XLA compilation of the distributed dslash
-        key = (target_parity, jnp.dtype(out_dtype).name if out_dtype
-               else None)
+        # (out_dtype=None means "psi dtype" = store_dtype here, so the
+        # key must normalize or real lookups can never hit the seed)
+        key = (target_parity,
+               jnp.dtype(out_dtype or self.store_dtype).name)
         self.__dict__.setdefault("_sharded_fns", {})[key] = cands[won]
         _notice_sharded_policy(self._pallas_version, won, True)
         return won
@@ -457,8 +459,8 @@ class _PackedHopMixin:
         wrapper per call would defeat the pjit cache — it is keyed on
         callable identity)."""
         cache = self.__dict__.setdefault("_sharded_fns", {})
-        key = (target_parity, jnp.dtype(out_dtype).name if out_dtype
-               else None)
+        key = (target_parity,
+               jnp.dtype(out_dtype or self.store_dtype).name)
         if key not in cache:
             policy = self._resolve_sharded_policy(target_parity,
                                                   out_dtype)

@@ -58,9 +58,44 @@ KERNEL_MODELS: Dict[str, dict] = {
     # XLA pair stencil: flop model only (XLA's fusion choices make a
     # static traffic model dishonest)
     "wilson_xla": {"flops_per_site": 1320, "bytes_per_site": None},
-    # improved staggered fat+Naik two-pass kernel (PERF.md round 8)
+    # improved staggered fat+Naik two-pass gather kernel (PERF.md round
+    # 8): per pass psi 5x24 + fwd links 288 + resident backward copy 288
+    # + out 24 = 720, two passes + the XLA sum pass (2x24 read + 24
+    # write)
     "staggered_fat_naik": {"flops_per_site": 1146,
                            "bytes_per_site": 1512},
+    # plain staggered (fat hop set only): ONE gather pass, no sum pass
+    "staggered_fat": {"flops_per_site": 570, "bytes_per_site": 720},
+    # scatter-form (v3) staggered: no backward-link copies; per pass
+    # psi 3x24 + links 288 + U_t plane 72 + out 24 = 456 (+ the sum
+    # pass for the improved two-pass form)
+    "staggered_fat_v3": {"flops_per_site": 570, "bytes_per_site": 456},
+    "staggered_fat_naik_v3": {"flops_per_site": 1146,
+                              "bytes_per_site": 984},
+    # FUSED single-pass fat+Naik (round 10 tentpole): one launch, one
+    # psi read, no XLA sum pass, no backward-link arrays — psi 5x24 +
+    # fat/long fwd links 2x288 + U_t planes at t-1/t-3 2x72 + out 24
+    # (z boundary rows are O(1/bz)).  1.75x less traffic than two-pass
+    "staggered_fat_naik_fused": {"flops_per_site": 1146,
+                                 "bytes_per_site": 864},
+    # MRHS staggered (gather two-pass body, links amortized over N):
+    # improved = 2 passes x (psi 120 + out 24) + sum 72 + 1152/N links;
+    # fat-only = one pass, no sum
+    "staggered_mrhs": {"flops_per_site": 1146,
+                       "bytes_per_site": lambda nrhs: 360.0
+                       + 1152.0 / nrhs},
+    "staggered_fat_mrhs": {"flops_per_site": 570,
+                           "bytes_per_site": lambda nrhs: 144.0
+                           + 576.0 / nrhs},
+    # sharded staggered eo interiors (two-pass gather form — the mesh
+    # default, models/staggered.py; halo transport excluded as for the
+    # Wilson sharded rows: policy-dependent and O(surface))
+    "staggered_sharded_fat": {"flops_per_site": 570,
+                              "bytes_per_site": 720},
+    "staggered_sharded_fat_naik": {"flops_per_site": 1146,
+                                   "bytes_per_site": 1512},
+    # XLA pair stencil: flop model only (same honesty rule as wilson_xla)
+    "staggered_xla": {"flops_per_site": 1146, "bytes_per_site": None},
     # operator-supplied flop count, no traffic model
     "generic": {"flops_per_site": None, "bytes_per_site": None},
 }

@@ -35,6 +35,25 @@ from .wilson_pallas_packed import (_cadd, _cmul, _cmul_conj, _pick_bz,
 
 F32 = jnp.float32
 
+# Per-kernel VMEM budget: the staggered family picks z-blocks against
+# its OWN knob (raised default — the fused fat+Naik working set needs
+# it) while the Wilson kernels keep the proven 6 MB default.
+_STAG_VMEM_KNOB = "QUDA_TPU_PALLAS_VMEM_MB_STAGGERED"
+
+
+def _check_long_bz(Z: int, bz: int, with_long: bool, where: str):
+    """Loud failure instead of silent corruption: the Naik 3-hop z
+    splice reads its boundary rows from the SINGLE adjacent z-block, so
+    a multi-block launch needs bz >= 3 (bz = Z reduces every z shift to
+    an in-tile periodic roll and is always safe).  Checked at every
+    entry point so an explicit ``block_z`` cannot bypass it."""
+    if with_long and Z // bz > 1 and bz < 3:
+        raise ValueError(
+            f"{where}: block_z={bz} is illegal for the Naik 3-hop z "
+            f"splice (needs block_z >= 3, or one z-block block_z={Z}): "
+            "the splice only reaches the adjacent z-block, so 0 < bz < "
+            "3 would silently corrupt the long-hop boundary rows")
+
 
 def backward_links(links_pl: jnp.ndarray, X: int, nhop: int) -> jnp.ndarray:
     """Pre-shifted backward links: out[mu](x) = U_mu(x - nhop*mu), on the
@@ -218,7 +237,9 @@ def dslash_staggered_pallas(fat_pl: jnp.ndarray, fat_bw_pl: jnp.ndarray,
             raise ValueError(f"block_z={bz} does not divide Z={Z}")
     else:
         bz = _pick_bz(Z, YX, psi_pl.dtype, planes=_STAG_PLANES,
-                      min_bz=3 if (long_pl is not None and Z > 3) else 1)
+                      min_bz=3 if (long_pl is not None and Z > 3) else 1,
+                      vmem_knob=_STAG_VMEM_KNOB)
+    _check_long_bz(Z, bz, long_pl is not None, "dslash_staggered_pallas")
 
     out = _stag_pass(fat_pl, fat_bw_pl, psi_pl, X, 1, bz, interpret)
     if long_pl is not None:
@@ -259,6 +280,123 @@ def _splice_z(v, rows, sign: int, nhop: int):
     return tuple(out)
 
 
+def _psi_at(ref, c):
+    """(re, im) f32 color planes from a psi ref.  Center blocks are
+    (3,2,1,bz,YX); boundary-ROW inputs carry one extra singleton z axis
+    (3,2,1,1,nhop,YX) — an nhop-extent block on the sublane axis of a
+    Z-extent array is illegal on hardware, so rows arrive as separate
+    arrays whose z extent IS nhop (block == dim is legal)."""
+    pad = (0,) * (len(ref.shape) - 5)
+    return (ref[(c, 0, 0) + pad].astype(F32),
+            ref[(c, 1, 0) + pad].astype(F32))
+
+
+def _link_at(ref, mu, a, b):
+    """(re, im) f32 link-element planes from a link ref (pad-aware like
+    _psi_at: boundary-row link inputs carry a singleton z axis)."""
+    pad = (0,) * (len(ref.shape) - 7)
+    return (ref[(mu, a, b, 0, 0) + pad].astype(F32),
+            ref[(mu, a, b, 1, 0) + pad].astype(F32))
+
+
+def _mul3(get_psi, get_link, adjoint, scale):
+    """out[a] = scale * sum_b op(U)_ab psi_b as a list of 3 color pairs
+    (no accumulate)."""
+    res = []
+    for a in range(3):
+        term = None
+        for b in range(3):
+            m = (_cmul_conj(get_link(b, a), get_psi(b))
+                 if adjoint else _cmul(get_link(a, b), get_psi(b)))
+            term = m if term is None else _cadd(term, m)
+        res.append((scale * term[0], scale * term[1]))
+    return res
+
+
+def _accumulate_hopset(acc, psi_c, psi_tp, psi_tm, psi_zp, psi_zm,
+                       u, u_bwd, u_t_tm, u_z_zm, nhop: int,
+                       shift_x, shift_y, single_zb: bool):
+    """One scatter-form hop set (all 8 hops of one nhop) accumulated
+    into ``acc`` (list of 3 f32 color pairs, mutated in place).
+
+    The SINGLE home for the staggered scatter-form hop algebra: the v3
+    two-pass kernels run it once per launch, the fused fat+Naik kernel
+    runs it twice (nhop=1 with the fat refs, nhop=3 with the long refs)
+    into separate accumulators — so the fused output is bit-identical
+    to the XLA sum of the two v3 passes by construction.
+
+    ``u_bwd`` supplies the backward x/y/z links (the forward array, or
+    the opposite-parity array for the checkerboarded variant); ``u_t_tm``
+    is the U_t plane at t-nhop; ``u_z_zm`` the U_z boundary rows at
+    z-nhop (unread when ``single_zb``)."""
+    def acc_add(vals):
+        for a in range(3):
+            acc[a] = _cadd(acc[a], vals[a])
+
+    # x, y: forward = shift psi then multiply; backward = multiply
+    # with LOCAL links then shift the product
+    for mu, shifter in ((0, shift_x), (1, shift_y)):
+        acc_add(_mul3(lambda c: shifter(_psi_at(psi_c, c), +1),
+                      lambda a, b: _link_at(u, mu, a, b), False, 0.5))
+        m = _mul3(lambda c: _psi_at(psi_c, c),
+                  lambda a, b: _link_at(u_bwd, mu, a, b), True, -0.5)
+        acc_add([shifter(mc, -1) for mc in m])
+
+    # z forward: nhop-row splice of the shifted central tile (a pure
+    # in-tile roll when the block covers the whole Z axis)
+    if single_zb:
+        acc_add(_mul3(
+            lambda c: tuple(jnp.roll(p, -nhop, axis=0)
+                            for p in _psi_at(psi_c, c)),
+            lambda a, b: _link_at(u, 2, a, b), False, 0.5))
+        m = _mul3(lambda c: _psi_at(psi_c, c),
+                  lambda a, b: _link_at(u_bwd, 2, a, b), True, -0.5)
+        acc_add([tuple(jnp.roll(p, nhop, axis=0) for p in mc)
+                 for mc in m])
+    else:
+        acc_add(_mul3(lambda c: _splice_z(_psi_at(psi_c, c),
+                                          _psi_at(psi_zp, c), +1, nhop),
+                      lambda a, b: _link_at(u, 2, a, b), False, 0.5))
+        # z backward: local product shifted down, boundary rows
+        # built from the z-nhop psi/U_z row inputs
+        m = _mul3(lambda c: _psi_at(psi_c, c),
+                  lambda a, b: _link_at(u_bwd, 2, a, b), True, -0.5)
+        m_b = _mul3(lambda c: _psi_at(psi_zm, c),
+                    lambda a, b: _link_at(u_z_zm, 0, a, b), True, -0.5)
+        acc_add([_splice_z(mc, mbc, -1, nhop)
+                 for mc, mbc in zip(m, m_b)])
+
+    # t: whole neighbour planes, no shift
+    acc_add(_mul3(lambda c: _psi_at(psi_tp, c),
+                  lambda a, b: _link_at(u, 3, a, b), False, 0.5))
+    acc_add(_mul3(lambda c: _psi_at(psi_tm, c),
+                  lambda a, b: _link_at(u_t_tm, 0, a, b), True, -0.5))
+
+
+def _eo_mask_r0(pl, psi_c, bz, eo):
+    """The checkerboard x-slot parity mask from the grid position (the
+    first two grid axes are (t, z-block) in every staggered launch)."""
+    parity, Xh = eo
+    t_id = pl.program_id(0)
+    zb_id = pl.program_id(1)
+    shape = psi_c.shape[-2:]
+    z = jax.lax.broadcasted_iota(jnp.int32, shape, 0) + zb_id * bz
+    y = jax.lax.broadcasted_iota(jnp.int32, shape, 1) // Xh
+    return ((t_id + z + y + parity) % 2) == 0
+
+
+def _make_shifts(X: int, nhop: int, eo, mask_r0):
+    """(shift_x, shift_y) closures for one hop count."""
+    def shift_x(v, sign):
+        if eo is None:
+            return _shift_xy(v, 0, sign, X, nhop)
+        return _shift_x_eo_n(v, sign, eo[1], mask_r0, nhop)
+
+    def shift_y(v, sign):
+        return _shift_xy(v, 1, sign, X if eo is None else eo[1], nhop)
+    return shift_x, shift_y
+
+
 def _make_stag_kernel_v3(X: int, nhop: int, bz: int,
                          eo: tuple | None = None,
                          single_zb: bool = False):
@@ -278,101 +416,20 @@ def _make_stag_kernel_v3(X: int, nhop: int, bz: int,
             (psi_c, psi_tp, psi_tm, psi_zp, psi_zm,
              u, u_t_tm, u_z_zm, out_ref) = refs
             u_bwd = u
+            mask_r0 = None
         else:
             (psi_c, psi_tp, psi_tm, psi_zp, psi_zm,
              u, u_there_xyz, u_t_tm, u_z_zm, out_ref) = refs
             u_bwd = u_there_xyz
-            parity, Xh = eo
-            t_id = pl.program_id(0)
-            zb_id = pl.program_id(1)
-            shape = psi_c.shape[-2:]
-            z = (jax.lax.broadcasted_iota(jnp.int32, shape, 0)
-                 + zb_id * bz)
-            y = jax.lax.broadcasted_iota(jnp.int32, shape, 1) // Xh
-            mask_r0 = ((t_id + z + y + parity) % 2) == 0
+            mask_r0 = _eo_mask_r0(pl, psi_c, bz, eo)
 
-        def psi_at(ref, c):
-            # center blocks are (3,2,1,bz,YX); boundary-ROW inputs carry
-            # one extra singleton z axis (3,2,1,1,nhop,YX) — an nhop-
-            # extent block on the sublane axis of a Z-extent array is
-            # illegal on hardware, so rows arrive as separate arrays
-            # whose z extent IS nhop (block == dim is legal)
-            pad = (0,) * (len(ref.shape) - 5)
-            return (ref[(c, 0, 0) + pad].astype(F32),
-                    ref[(c, 1, 0) + pad].astype(F32))
-
-        def shift_x(v, sign):
-            if eo is None:
-                return _shift_xy(v, 0, sign, X, nhop)
-            return _shift_x_eo_n(v, sign, eo[1], mask_r0, nhop)
-
-        def shift_y(v, sign):
-            return _shift_xy(v, 1, sign, X if eo is None else eo[1],
-                             nhop)
-
-        def link(ref, mu, a, b):
-            pad = (0,) * (len(ref.shape) - 7)
-            return (ref[(mu, a, b, 0, 0) + pad].astype(F32),
-                    ref[(mu, a, b, 1, 0) + pad].astype(F32))
+        shift_x, shift_y = _make_shifts(X, nhop, eo, mask_r0)
 
         acc = [(jnp.zeros(psi_c.shape[-2:], F32),
                 jnp.zeros(psi_c.shape[-2:], F32)) for _ in range(3)]
-
-        def mul(get_psi, get_link, adjoint, scale):
-            """out[a] = scale * sum_b op(U)_ab psi_b as a list of 3
-            color pairs (no accumulate)."""
-            res = []
-            for a in range(3):
-                term = None
-                for b in range(3):
-                    m = (_cmul_conj(get_link(b, a), get_psi(b))
-                         if adjoint else _cmul(get_link(a, b), get_psi(b)))
-                    term = m if term is None else _cadd(term, m)
-                res.append((scale * term[0], scale * term[1]))
-            return res
-
-        def acc_add(vals):
-            for a in range(3):
-                acc[a] = _cadd(acc[a], vals[a])
-
-        # x, y: forward = shift psi then multiply; backward = multiply
-        # with LOCAL links then shift the product
-        for mu, shifter in ((0, shift_x), (1, shift_y)):
-            acc_add(mul(lambda c: shifter(psi_at(psi_c, c), +1),
-                        lambda a, b: link(u, mu, a, b), False, 0.5))
-            m = mul(lambda c: psi_at(psi_c, c),
-                    lambda a, b: link(u_bwd, mu, a, b), True, -0.5)
-            acc_add([shifter(mc, -1) for mc in m])
-
-        # z forward: nhop-row splice of the shifted central tile (a pure
-        # in-tile roll when the block covers the whole Z axis)
-        if single_zb:
-            acc_add(mul(
-                lambda c: tuple(jnp.roll(p, -nhop, axis=0)
-                                for p in psi_at(psi_c, c)),
-                lambda a, b: link(u, 2, a, b), False, 0.5))
-            m = mul(lambda c: psi_at(psi_c, c),
-                    lambda a, b: link(u_bwd, 2, a, b), True, -0.5)
-            acc_add([tuple(jnp.roll(p, nhop, axis=0) for p in mc)
-                     for mc in m])
-        else:
-            acc_add(mul(lambda c: _splice_z(psi_at(psi_c, c),
-                                            psi_at(psi_zp, c), +1, nhop),
-                        lambda a, b: link(u, 2, a, b), False, 0.5))
-            # z backward: local product shifted down, boundary rows
-            # built from the z-nhop psi/U_z row inputs
-            m = mul(lambda c: psi_at(psi_c, c),
-                    lambda a, b: link(u_bwd, 2, a, b), True, -0.5)
-            m_b = mul(lambda c: psi_at(psi_zm, c),
-                      lambda a, b: link(u_z_zm, 0, a, b), True, -0.5)
-            acc_add([_splice_z(mc, mbc, -1, nhop)
-                     for mc, mbc in zip(m, m_b)])
-
-        # t: whole neighbour planes, no shift
-        acc_add(mul(lambda c: psi_at(psi_tp, c),
-                    lambda a, b: link(u, 3, a, b), False, 0.5))
-        acc_add(mul(lambda c: psi_at(psi_tm, c),
-                    lambda a, b: link(u_t_tm, 0, a, b), True, -0.5))
+        _accumulate_hopset(acc, psi_c, psi_tp, psi_tm, psi_zp, psi_zm,
+                           u, u_bwd, u_t_tm, u_z_zm, nhop,
+                           shift_x, shift_y, single_zb)
 
         odt = out_ref.dtype
         for c in range(3):
@@ -481,7 +538,8 @@ def _pick_bz_v3(Z, YX, dtype, with_long: bool, eo: bool = False):
     planes = _STAG_PLANES_V3_EO if eo else _STAG_PLANES_V3
     _require_naik_z(Z, with_long)
     bz = _pick_bz(Z, YX, dtype, planes=planes,
-                  min_bz=3 if (with_long and Z > 3) else 1)
+                  min_bz=3 if (with_long and Z > 3) else 1,
+                  vmem_knob=_STAG_VMEM_KNOB)
     if with_long and bz != Z and bz % 3 != 0:
         # Naik boundary inputs need bz % 3 == 0 (or a single z-block);
         # candidates must ALSO satisfy the hardware block-legality rule
@@ -494,7 +552,8 @@ def _pick_bz_v3(Z, YX, dtype, with_long: bool, eo: bool = False):
             bz = max(cands)
         else:
             # fall back to the whole-Z block; _pick_bz re-checks VMEM
-            bz = _pick_bz(Z, YX, dtype, planes=planes, min_bz=Z)
+            bz = _pick_bz(Z, YX, dtype, planes=planes, min_bz=Z,
+                          vmem_knob=_STAG_VMEM_KNOB)
     return bz
 
 
@@ -600,7 +659,9 @@ def dslash_staggered_eo_pallas(fat_here_pl, fat_bw_pl, psi_pl, dims,
     else:
         bz = _pick_bz(Z, YXh, psi_pl.dtype, planes=_STAG_PLANES,
                       min_bz=3 if (long_here_pl is not None and Z > 3)
-                      else 1)
+                      else 1, vmem_knob=_STAG_VMEM_KNOB)
+    _check_long_bz(Z, bz, long_here_pl is not None,
+                   "dslash_staggered_eo_pallas")
 
     eo = (target_parity, Xh)
     out = _stag_pass(fat_here_pl, fat_bw_pl, psi_pl, X, 1, bz, interpret,
@@ -608,5 +669,395 @@ def dslash_staggered_eo_pallas(fat_here_pl, fat_bw_pl, psi_pl, dims,
     if long_here_pl is not None:
         out = out + _stag_pass(long_here_pl, long_bw_pl, psi_pl, X, 3,
                                bz, interpret, eo)
+    odt = out_dtype or psi_pl.dtype
+    return out.astype(odt)
+
+
+# -- fused single-pass fat+Naik kernel (round 10) ---------------------------
+#
+# The two-pass improved-staggered form above exists only because the
+# COMBINED gather working set (9 psi neighbour tiles + 4 link tile sets)
+# busts the default 6 MB single-buffer VMEM budget — the exact
+# split-launch tax QUDA avoids by fusing all hop sets in one kernel
+# (include/kernels/dslash_staggered.cuh improved=true runs fat and long
+# hops in a single launch).  PERF.md round 8 measured the price: the
+# two-pass kernel reads ~1512 B/site (psi fetched twice, the shift
+# network paid twice, two resident backward-link copies, an XLA sum
+# pass) and lands at 26% of the effective bandwidth the same chip
+# streams on the Wilson v2 kernel.
+#
+# The fused kernel runs BOTH hop sets in one launch in scatter form
+# (the v3 backward-hop restructuring): one psi read, one out write, no
+# XLA sum pass, no backward-link arrays at all.  Per-site traffic:
+#
+#     psi   c + t+-1 + t+-3             5 * 24 = 120 B
+#     z boundary rows                   ~0 (O(1/bz))
+#     links fat fwd + long fwd       2 * 288 = 576 B
+#     U_t planes at t-1 and t-3       2 * 72 = 144 B
+#     out                                       24 B
+#     total                                   ~864 B/site
+#
+# at the same 1146 flops/site — 1.75x less traffic than two-pass.  The
+# hop algebra is _accumulate_hopset (shared with the v3 kernels), run
+# once per hop set into SEPARATE accumulators summed at the end, so the
+# fused output is bit-identical to the XLA sum of the two v3 passes.
+# The kernel is raced against the two-pass forms via utils.tune at
+# operator construction (models/staggered.py) — A/B'd, not assumed,
+# since the scatter form LOST for Wilson on chip (PERF.md round 5).
+#
+# Block legality: the z boundary rows are sliced DIRECTLY from the
+# adjacent block's edge (no bz % nhop reshape constraint — the v3
+# two-pass limitation), so any hardware-legal bz >= 3 serves both hop
+# sets; the budget comes from QUDA_TPU_PALLAS_VMEM_MB_STAGGERED.
+
+# fused working set: 5 psi tiles (30 planes) + fat + long (72 each) +
+# two U_t planes (18 each) + out (6) = 216 bz-row planes (+ tiny
+# nhop-row inputs); the EO variant adds fat/long there_xyz (54 each)
+_STAG_PLANES_FUSED = 222
+_STAG_PLANES_FUSED_EO = 330
+
+
+def _make_stag_kernel_fused(X: int, bz: int, eo: tuple | None = None,
+                            single_zb: bool = False):
+    """Fused fat+Naik kernel over one (t, z-block) tile.  Ref shapes:
+      psi_c/tp1/tm1/tp3/tm3:  (3, 2, 1, bz, YX)
+      psi_zp1/zm1:            (3, 2, 1, 1, YX)   fat boundary rows
+      psi_zp3/zm3:            (3, 2, 1, 3, YX)   Naik boundary rows
+      u_fat / u_lng:          (4, 3, 3, 2, 1, bz, YX) forward links
+      [fat/lng_there_xyz:     (3, 3, 3, 2, 1, bz, YX)  eo only]
+      u_t_fat / u_t_lng:      (1, 3, 3, 2, 1, bz, YX) U_t at t-1 / t-3
+      u_z_fat / u_z_lng:      (1, 3, 3, 2, 1, nhop, YX) U_z rows
+    """
+    from jax.experimental import pallas as pl
+
+    def kernel(*refs):
+        if eo is None:
+            (psi_c, psi_tp1, psi_tm1, psi_tp3, psi_tm3,
+             psi_zp1, psi_zm1, psi_zp3, psi_zm3,
+             u_fat, u_lng, u_t_fat, u_t_lng, u_z_fat, u_z_lng,
+             out_ref) = refs
+            fat_bwd, lng_bwd = u_fat, u_lng
+            mask_r0 = None
+        else:
+            (psi_c, psi_tp1, psi_tm1, psi_tp3, psi_tm3,
+             psi_zp1, psi_zm1, psi_zp3, psi_zm3,
+             u_fat, u_lng, fat_there, lng_there,
+             u_t_fat, u_t_lng, u_z_fat, u_z_lng, out_ref) = refs
+            fat_bwd, lng_bwd = fat_there, lng_there
+            mask_r0 = _eo_mask_r0(pl, psi_c, bz, eo)
+
+        def zero_acc():
+            return [(jnp.zeros(psi_c.shape[-2:], F32),
+                     jnp.zeros(psi_c.shape[-2:], F32)) for _ in range(3)]
+
+        # fat (1-hop) and Naik (3-hop) sets into SEPARATE accumulators:
+        # out = acc_fat + acc_lng reproduces the two-pass XLA sum
+        # bit-for-bit (same adds in the same order)
+        acc_fat = zero_acc()
+        sx1, sy1 = _make_shifts(X, 1, eo, mask_r0)
+        _accumulate_hopset(acc_fat, psi_c, psi_tp1, psi_tm1, psi_zp1,
+                           psi_zm1, u_fat, fat_bwd, u_t_fat, u_z_fat,
+                           1, sx1, sy1, single_zb)
+        acc_lng = zero_acc()
+        sx3, sy3 = _make_shifts(X, 3, eo, mask_r0)
+        _accumulate_hopset(acc_lng, psi_c, psi_tp3, psi_tm3, psi_zp3,
+                           psi_zm3, u_lng, lng_bwd, u_t_lng, u_z_lng,
+                           3, sx3, sy3, single_zb)
+
+        odt = out_ref.dtype
+        for c in range(3):
+            out_ref[c, 0, 0] = (acc_fat[c][0] + acc_lng[c][0]).astype(odt)
+            out_ref[c, 1, 0] = (acc_fat[c][1] + acc_lng[c][1]).astype(odt)
+
+    return kernel
+
+
+def _psi_z_rows(psi_pl, bz: int, nhop: int, nzb: int):
+    """(rows_zp, rows_zm) boundary-row arrays (3,2,T,nzb,nhop,YX) for
+    the z splice, sliced DIRECTLY from each block's edge rows (legal for
+    any bz >= nhop, unlike the v3 q-reshape which needed bz % nhop)."""
+    c, two, T, Z, YX = psi_pl.shape
+    q = psi_pl.reshape(c, two, T, nzb, bz, YX)
+    rows_zp = jnp.roll(q[:, :, :, :, :nhop], -1, axis=3)
+    rows_zm = jnp.roll(q[:, :, :, :, bz - nhop:], 1, axis=3)
+    return rows_zp, rows_zm
+
+
+def _u_z_rows(src, bz: int, nhop: int, nzb: int):
+    """U_z boundary rows (1,3,3,2,T,nzb,nhop,YX) at z-nhop (the previous
+    block's last nhop rows of the mu=2 plane of ``src``)."""
+    R = src.shape[1]
+    T, Z, YX = src.shape[-3:]
+    uq = src[2:3].reshape(1, R, 3, 2, T, nzb, bz, YX)
+    return jnp.roll(uq[:, :, :, :, :, :, bz - nhop:], 1, axis=5)
+
+
+def _pick_bz_fused(Z, YX, dtype, eo: bool = False):
+    planes = _STAG_PLANES_FUSED_EO if eo else _STAG_PLANES_FUSED
+    _require_naik_z(Z, True)
+    return _pick_bz(Z, YX, dtype, planes=planes,
+                    min_bz=3 if Z > 3 else 1,
+                    vmem_knob=_STAG_VMEM_KNOB)
+
+
+def _stag_fused_call(fat_pl, long_pl, psi_pl, X, bz, interpret, eo=None,
+                     fat_there_pl=None, long_there_pl=None):
+    from jax.experimental import pallas as pl
+
+    _, _, T, Z, YX = psi_pl.shape
+    nzb = Z // bz
+    _check_long_bz(Z, bz, True, "fused fat+Naik kernel")
+
+    fat_bwd_src = fat_pl if fat_there_pl is None else fat_there_pl
+    lng_bwd_src = long_pl if long_there_pl is None else long_there_pl
+
+    if nzb == 1:
+        # single z-block: in-tile rolls serve every z shift; the row
+        # refs are unread — pass minimal dummies
+        rows_zp1 = rows_zm1 = jnp.zeros((3, 2, T, 1, 1, YX),
+                                        psi_pl.dtype)
+        rows_zp3 = rows_zm3 = jnp.zeros((3, 2, T, 1, 3, YX),
+                                        psi_pl.dtype)
+        u_z_fat = jnp.zeros((1, 3, 3, 2, T, 1, 1, YX), fat_bwd_src.dtype)
+        u_z_lng = jnp.zeros((1, 3, 3, 2, T, 1, 3, YX), lng_bwd_src.dtype)
+    else:
+        rows_zp1, rows_zm1 = _psi_z_rows(psi_pl, bz, 1, nzb)
+        rows_zp3, rows_zm3 = _psi_z_rows(psi_pl, bz, 3, nzb)
+        u_z_fat = _u_z_rows(fat_bwd_src, bz, 1, nzb)
+        u_z_lng = _u_z_rows(lng_bwd_src, bz, 3, nzb)
+
+    def psi_spec(dt):
+        return pl.BlockSpec(
+            (3, 2, 1, bz, YX),
+            lambda t, zb, dt=dt: (0, 0, (t + dt) % T, zb, 0))
+
+    def psi_row_spec(nhop):
+        return pl.BlockSpec((3, 2, 1, 1, nhop, YX),
+                            lambda t, zb: (0, 0, t, zb, 0, 0))
+
+    links_spec = pl.BlockSpec(
+        (4, 3, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+    links_xyz_spec = pl.BlockSpec(
+        (3, 3, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+
+    def u_t_spec(nhop):
+        return pl.BlockSpec(
+            (1, 3, 3, 2, 1, bz, YX),
+            lambda t, zb, nhop=nhop: (3, 0, 0, 0, (t - nhop) % T, zb, 0))
+
+    def u_z_spec(nhop):
+        return pl.BlockSpec((1, 3, 3, 2, 1, 1, nhop, YX),
+                            lambda t, zb: (0, 0, 0, 0, t, zb, 0, 0))
+
+    in_specs = [psi_spec(0), psi_spec(+1), psi_spec(-1),
+                psi_spec(+3), psi_spec(-3),
+                psi_row_spec(1), psi_row_spec(1),
+                psi_row_spec(3), psi_row_spec(3),
+                links_spec, links_spec]
+    args = [psi_pl, psi_pl, psi_pl, psi_pl, psi_pl,
+            rows_zp1, rows_zm1, rows_zp3, rows_zm3, fat_pl, long_pl]
+    if fat_there_pl is not None:
+        in_specs += [links_xyz_spec, links_xyz_spec]
+        args += [fat_there_pl, long_there_pl]
+    in_specs += [u_t_spec(1), u_t_spec(3), u_z_spec(1), u_z_spec(3)]
+    args += [fat_bwd_src, lng_bwd_src, u_z_fat, u_z_lng]
+
+    return pl.pallas_call(
+        _make_stag_kernel_fused(X, bz, eo, single_zb=(nzb == 1)),
+        grid=(T, nzb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((3, 2, 1, bz, YX),
+                               lambda t, zb: (0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape, jnp.float32),
+        interpret=interpret,
+    )(*args)
+
+
+@functools.partial(jax.jit, static_argnames=("X", "interpret", "block_z",
+                                             "out_dtype"))
+def dslash_staggered_pallas_fused(fat_pl: jnp.ndarray, psi_pl: jnp.ndarray,
+                                  X: int, long_pl: jnp.ndarray = None,
+                                  interpret: bool = False,
+                                  block_z: int | None = None,
+                                  out_dtype=None) -> jnp.ndarray:
+    """Improved-staggered D psi in ONE pallas launch (fat + Naik fused,
+    scatter-form backward hops): ~864 B/site vs the two-pass 1512.
+    Matches staggered_packed.dslash_staggered_packed_pairs; layouts as
+    dslash_staggered_pallas (no backward-link arrays needed)."""
+    if long_pl is None:
+        raise ValueError(
+            "the fused kernel IS the fat+Naik fusion; fat-only "
+            "staggered has a single hop set — use "
+            "dslash_staggered_pallas / _v3 for it")
+    _, _, _, Z, YX = psi_pl.shape
+    _require_naik_z(Z, True)
+    if block_z is not None:
+        bz = block_z
+        if Z % bz != 0:
+            raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    else:
+        bz = _pick_bz_fused(Z, YX, psi_pl.dtype)
+
+    out = _stag_fused_call(fat_pl, long_pl, psi_pl, X, bz, interpret)
+    odt = out_dtype or psi_pl.dtype
+    return out.astype(odt)
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "target_parity",
+                                             "interpret", "block_z",
+                                             "out_dtype"))
+def dslash_staggered_eo_pallas_fused(fat_here_pl, fat_there_pl, psi_pl,
+                                     dims, target_parity: int,
+                                     long_here_pl=None, long_there_pl=None,
+                                     interpret: bool = False,
+                                     block_z: int | None = None,
+                                     out_dtype=None) -> jnp.ndarray:
+    """Checkerboarded fused fat+Naik hop — the improved-staggered CG
+    hot path in one launch.  Backward hops read the UNSHIFTED
+    opposite-parity links (both hop sets flip parity — odd nhop), so no
+    backward_links_eo copies exist anywhere."""
+    if long_here_pl is None:
+        raise ValueError(
+            "the fused kernel IS the fat+Naik fusion; fat-only "
+            "staggered has a single hop set — use "
+            "dslash_staggered_eo_pallas / _v3 for it")
+    T, Z, Y, X = dims
+    Xh = X // 2
+    _, _, _, _, YXh = psi_pl.shape
+    _require_naik_z(Z, True)
+    if block_z is not None:
+        bz = block_z
+        if Z % bz != 0:
+            raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    else:
+        bz = _pick_bz_fused(Z, YXh, psi_pl.dtype, eo=True)
+
+    out = _stag_fused_call(fat_here_pl, long_here_pl, psi_pl, X, bz,
+                           interpret, eo=(target_parity, Xh),
+                           fat_there_pl=fat_there_pl,
+                           long_there_pl=long_there_pl)
+    odt = out_dtype or psi_pl.dtype
+    return out.astype(odt)
+
+
+# -- multi-RHS (MRHS) variants: gauge-amortized staggered -------------------
+#
+# Same pipeline move as wilson_pallas_packed.dslash_pallas_packed_mrhs
+# (PERF.md round 7): grid (T, Z/bz, N) with the RHS axis INNERMOST, psi
+# and out BlockSpecs carrying a leading size-1 RHS block, and fat/long
+# link BlockSpecs whose index maps IGNORE n — consecutive grid steps
+# present the same link block index, so the Mosaic pipeline keeps the
+# tiles resident and N spinor tiles stream through one link fetch.  The
+# kernel body is the single-RHS two-pass gather kernel through a
+# leading-axis Ref view (_mrhs_wrap), bit-identical per RHS.  Per-RHS
+# traffic (two-pass improved): psi 2x5x24 + out 2x24 + sum 72 + links
+# 1152/N = 360 + 1152/N B/site -> ~504 at N=8.
+
+
+def _stag_pass_mrhs(links_pl, links_bw_pl, psi_pl, X, nhop, bz,
+                    interpret, eo=None):
+    from jax.experimental import pallas as pl
+
+    from .wilson_pallas_packed import _mrhs_wrap
+
+    N, _, _, T, Z, YX = psi_pl.shape
+    nzb = Z // bz
+    if nzb > 1 and bz < nhop:
+        raise ValueError(
+            f"block_z={bz} < nhop={nhop}: the z splice only reaches the "
+            "adjacent z-block")
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (1, 3, 2, 1, bz, YX),
+            lambda t, zb, n, dt=dt, dz=dz: (n, 0, 0, (t + dt) % T,
+                                            (zb + dz) % nzb, 0))
+
+    # link index maps ignore n: the block index repeats across the
+    # innermost RHS loop, so the pipeline re-uses the resident tiles
+    links_spec = pl.BlockSpec(
+        (4, 3, 3, 2, 1, bz, YX), lambda t, zb, n: (0, 0, 0, 0, t, zb, 0))
+
+    kernel = _mrhs_wrap(_make_stag_kernel(X, nhop, bz, eo), n_psi=5)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(T, nzb, N),
+        in_specs=[psi_spec(0, 0), psi_spec(+nhop, 0), psi_spec(-nhop, 0),
+                  psi_spec(0, +1), psi_spec(0, -1), links_spec,
+                  links_spec],
+        out_specs=pl.BlockSpec((1, 3, 2, 1, bz, YX),
+                               lambda t, zb, n: (n, 0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape, jnp.float32),
+        interpret=interpret,
+    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, links_pl, links_bw_pl)
+
+
+@functools.partial(jax.jit, static_argnames=("X", "interpret", "block_z",
+                                             "out_dtype"))
+def dslash_staggered_pallas_mrhs(fat_pl: jnp.ndarray, fat_bw_pl: jnp.ndarray,
+                                 psi_pl: jnp.ndarray, X: int,
+                                 long_pl: jnp.ndarray = None,
+                                 long_bw_pl: jnp.ndarray = None,
+                                 interpret: bool = False,
+                                 block_z: int | None = None,
+                                 out_dtype=None) -> jnp.ndarray:
+    """Multi-RHS staggered / improved-staggered D psi: psi_pl carries a
+    leading RHS axis (N,3,2,T,Z,YX) over the dslash_staggered_pallas
+    layout; per-RHS results bit-match the single-RHS kernel, with the
+    fat/long link tiles fetched once per (t, z-block) for all N."""
+    _, _, _, _, Z, YX = psi_pl.shape
+    if block_z is not None:
+        bz = block_z
+        if Z % bz != 0:
+            raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    else:
+        bz = _pick_bz(Z, YX, psi_pl.dtype, planes=_STAG_PLANES,
+                      min_bz=3 if (long_pl is not None and Z > 3) else 1,
+                      vmem_knob=_STAG_VMEM_KNOB)
+    _check_long_bz(Z, bz, long_pl is not None,
+                   "dslash_staggered_pallas_mrhs")
+
+    out = _stag_pass_mrhs(fat_pl, fat_bw_pl, psi_pl, X, 1, bz, interpret)
+    if long_pl is not None:
+        out = out + _stag_pass_mrhs(long_pl, long_bw_pl, psi_pl, X, 3,
+                                    bz, interpret)
+    odt = out_dtype or psi_pl.dtype
+    return out.astype(odt)
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "target_parity",
+                                             "interpret", "block_z",
+                                             "out_dtype"))
+def dslash_staggered_eo_pallas_mrhs(fat_here_pl, fat_bw_pl, psi_pl, dims,
+                                    target_parity: int,
+                                    long_here_pl=None, long_bw_pl=None,
+                                    interpret: bool = False,
+                                    block_z: int | None = None,
+                                    out_dtype=None) -> jnp.ndarray:
+    """Multi-RHS checkerboarded staggered hop — the batched staggered
+    solver hot path (dslash_staggered_eo_pallas with a leading RHS axis
+    on psi: (N,3,2,T,Z,Y*Xh) of parity 1-p).  Link tiles are fetched
+    once per (t, z-block) and shared by all N RHS."""
+    T, Z, Y, X = dims
+    Xh = X // 2
+    YXh = psi_pl.shape[-1]
+    if block_z is not None:
+        bz = block_z
+        if Z % bz != 0:
+            raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    else:
+        bz = _pick_bz(Z, YXh, psi_pl.dtype, planes=_STAG_PLANES,
+                      min_bz=3 if (long_here_pl is not None and Z > 3)
+                      else 1, vmem_knob=_STAG_VMEM_KNOB)
+    _check_long_bz(Z, bz, long_here_pl is not None,
+                   "dslash_staggered_eo_pallas_mrhs")
+
+    eo = (target_parity, Xh)
+    out = _stag_pass_mrhs(fat_here_pl, fat_bw_pl, psi_pl, X, 1, bz,
+                          interpret, eo)
+    if long_here_pl is not None:
+        out = out + _stag_pass_mrhs(long_here_pl, long_bw_pl, psi_pl, X,
+                                    3, bz, interpret, eo)
     odt = out_dtype or psi_pl.dtype
     return out.astype(odt)

@@ -309,7 +309,8 @@ def _make_kernel(X: int, bz: int, eo: tuple | None = None,
 
 
 def _pick_bz(Z: int, YX: int, dtype=jnp.float32, planes: int = 288,
-             min_bz: int = 1) -> int:
+             min_bz: int = 1,
+             vmem_knob: str = "QUDA_TPU_PALLAS_VMEM_MB") -> int:
     """Divisor of Z maximising sublane-tile utilisation within the VMEM
     budget.
 
@@ -332,14 +333,18 @@ def _pick_bz(Z: int, YX: int, dtype=jnp.float32, planes: int = 288,
     CPU and failed on the chip.  Candidates violating the rule are
     excluded here.
 
+    ``vmem_knob`` names the registered budget knob — the Wilson kernels
+    use the proven QUDA_TPU_PALLAS_VMEM_MB default; the staggered family
+    passes its per-kernel override (QUDA_TPU_PALLAS_VMEM_MB_STAGGERED),
+    whose raised default admits the fused fat+Naik working set.
+
     Raises when even BZ=1 does not fit — callers fall back to the XLA
     packed path."""
     sub = 16 if jnp.dtype(dtype).itemsize < 4 else 8
     nbytes = jnp.dtype(dtype).itemsize
     yx_pad = -(-YX // 128) * 128
     from ..utils import config as qconf
-    budget = int(float(qconf.get("QUDA_TPU_PALLAS_VMEM_MB",
-                                 fresh=True)) * 2 ** 20)
+    budget = int(float(qconf.get(vmem_knob, fresh=True)) * 2 ** 20)
     fitting = []
     for bz in sorted({d for d in range(min_bz, Z + 1)
                       if Z % d == 0}):

@@ -306,63 +306,115 @@ def _stag_term(u_slab, psi_slab, adjoint: bool):
 
 
 def _stag_fix_faces(out, links_fwd, links_bwd, psi_pl, nhop: int, axis,
-                    name, n, mu):
+                    name, n, mu, exchange=_exchange_xla):
     """Fat (nhop=1) or Naik (nhop=3) face fixes for one partitioned
-    direction, v3 scatter-form conventions:
+    direction, scatter-form conventions (the v3 two-pass kernels AND the
+    fused fat+Naik kernel — its backward hops wrap the locally-computed
+    product exactly like v3, so the same fixes serve both):
 
     * forward hop, HIGH slab: psi(x + nhop*mu) must come from the next
       shard's first nhop planes (the kernel wrapped the local ones);
       hop-to-plane alignment is 1:1 within the slab.
     * backward hop, LOW slab: the kernel wrapped the locally-computed
-      product U^dag psi of the LAST nhop planes; ppermute the product
+      product U^dag psi of the LAST nhop planes; permute the product
       slab itself (linear in the face) — no link exchange.
+
+    Both transfers ride ONE ``exchange`` call per hop set (the
+    QUDA_TPU_SHARDED_POLICY seam, see SHARDED_POLICIES — the psi slab
+    and the product slab have identical shapes, so the fused-RDMA
+    bidirectional kernel serves them like the Wilson v3 fixes).
 
     ``links_fwd``/``links_bwd``: the link arrays each hop reads — the
     same full-lattice array, or (checkerboarded) the target-parity and
     opposite-parity link arrays respectively."""
-    u_hi = _face_n(links_fwd[mu], axis, lo=False, n=nhop)
-    halo_hi = _nbr(_face_n(psi_pl, axis, lo=True, n=nhop), name,
-                   towards_lower=True, n=n)
-    wrong_hi = _face_n(psi_pl, axis, lo=True, n=nhop)
-    corr_hi = 0.5 * (_stag_term(u_hi, halo_hi, False)
-                     - _stag_term(u_hi, wrong_hi, False))
-    out = _add_face_n(out, corr_hi, axis, lo=False, n=nhop)
-
+    lo_first = _face_n(psi_pl, axis, lo=True, n=nhop)
     prod = _stag_term(_face_n(links_bwd[mu], axis, lo=False, n=nhop),
                       _face_n(psi_pl, axis, lo=False, n=nhop), True)
-    corr_lo = -0.5 * (_nbr(prod, name, towards_lower=False, n=n) - prod)
+    halo_hi, prod_in = exchange(lo_first, prod, name, n)
+
+    u_hi = _face_n(links_fwd[mu], axis, lo=False, n=nhop)
+    corr_hi = 0.5 * (_stag_term(u_hi, halo_hi, False)
+                     - _stag_term(u_hi, lo_first, False))
+    out = _add_face_n(out, corr_hi, axis, lo=False, n=nhop)
+
+    corr_lo = -0.5 * (prod_in - prod)
     return _add_face_n(out, corr_lo, axis, lo=True, n=nhop)
 
 
-def dslash_staggered_pallas_sharded_v3(fat_pl, psi_pl, X: int, mesh,
-                                       long_pl=None,
-                                       interpret: bool = False):
-    """Staggered / improved-staggered D psi on per-shard local packed
-    pair blocks — call INSIDE shard_map over ``mesh`` (t/z mesh axes
-    partition T/Z; y/x mesh axes must be 1).  The interior runs the
-    single-chip v3 scatter-form kernel (ops/staggered_pallas); the Naik
-    term's 3-hop boundary is three planes per face, fixed with ONE
-    3-plane ppermute per direction-sign (reference: the nFace=3
-    staggered policies of lib/dslash_policy.hpp:365 applied to
-    include/kernels/dslash_staggered.cuh).
+def _stag_fix_faces_v2(out, links_fwd, links_bwd_sh, psi_pl, nhop: int,
+                       axis, name, n, mu, exchange=_exchange_xla):
+    """Fat (nhop=1) or Naik (nhop=3) face fixes for one partitioned
+    direction, v2 GATHER-form conventions — the staggered analog of
+    ``_wilson_fix_faces_v2`` (round-8 tentpole ported to the second
+    headline family):
 
-    Requires local T/Z extents >= 3 when ``long_pl`` is given (the slab
-    fix assumes the 3-hop crosses at most one shard boundary).
-    """
-    from ..ops.staggered_pallas import dslash_staggered_pallas_v3
+    * forward hop, HIGH slab: psi(x + nhop*mu) from the next shard's
+      first nhop planes against ``links_fwd`` (local forward links —
+      already correct);
+    * backward hop, LOW slab: ``links_bwd_sh`` is the LOCAL block of
+      the GLOBALLY pre-shifted backward links
+      (ops/staggered_pallas.backward_links / backward_links_eo computed
+      on the global field BEFORE sharding), so its low slab already
+      holds the correct cross-shard U_mu(x - nhop*mu) — only
+      psi(x - nhop*mu) must come from the previous shard's last nhop
+      planes.
 
+    Both psi slabs ride ONE ``exchange`` call per hop set (the policy
+    seam); the Naik hop set exchanges 3-row slabs."""
+    lo_first = _face_n(psi_pl, axis, lo=True, n=nhop)
+    hi_last = _face_n(psi_pl, axis, lo=False, n=nhop)
+    halo_hi, halo_lo = exchange(lo_first, hi_last, name, n)
+
+    u_hi = _face_n(links_fwd[mu], axis, lo=False, n=nhop)
+    corr_hi = 0.5 * (_stag_term(u_hi, halo_hi, False)
+                     - _stag_term(u_hi, lo_first, False))
+    out = _add_face_n(out, corr_hi, axis, lo=False, n=nhop)
+
+    u_lo = _face_n(links_bwd_sh[mu], axis, lo=True, n=nhop)
+    corr_lo = -0.5 * (_stag_term(u_lo, halo_lo, True)
+                      - _stag_term(u_lo, hi_last, True))
+    return _add_face_n(out, corr_lo, axis, lo=True, n=nhop)
+
+
+def _check_stag_mesh(name: str, mesh, psi_pl, with_long: bool):
+    """Shared mesh/extent guards of the sharded staggered policies."""
     n_t, n_z = mesh.shape["t"], mesh.shape["z"]
     if mesh.shape["y"] != 1 or mesh.shape["x"] != 1:
-        raise ValueError(
-            "dslash_staggered_pallas_sharded_v3 shards t/z only (y/x "
-            "mesh axes must be 1)")
-    if long_pl is not None:
+        raise ValueError(f"{name} shards t/z only (y/x mesh axes must "
+                         "be 1)")
+    if with_long:
         for ax, nn in ((-3, n_t), (-2, n_z)):
             if nn > 1 and psi_pl.shape[ax] < 3:
                 raise ValueError(
                     "local extent < 3 on a partitioned axis: the Naik "
                     "slab fix needs the 3-hop to cross at most one "
                     "shard boundary")
+    return n_t, n_z
+
+
+def dslash_staggered_pallas_sharded_v3(fat_pl, psi_pl, X: int, mesh,
+                                       long_pl=None,
+                                       interpret: bool = False,
+                                       policy: str = "xla_facefix"):
+    """Staggered / improved-staggered D psi on per-shard local packed
+    pair blocks — call INSIDE shard_map over ``mesh`` (t/z mesh axes
+    partition T/Z; y/x mesh axes must be 1).  The interior runs the
+    single-chip v3 scatter-form kernel (ops/staggered_pallas); the Naik
+    term's 3-hop boundary is three planes per face, fixed with ONE
+    3-plane exchange per direction-sign (reference: the nFace=3
+    staggered policies of lib/dslash_policy.hpp:365 applied to
+    include/kernels/dslash_staggered.cuh).  ``policy`` selects the halo
+    transport (SHARDED_POLICIES — QUDA_TPU_SHARDED_POLICY covers
+    staggered through the same seam as Wilson).
+
+    Requires local T/Z extents >= 3 when ``long_pl`` is given (the slab
+    fix assumes the 3-hop crosses at most one shard boundary).
+    """
+    from ..ops.staggered_pallas import dslash_staggered_pallas_v3
+
+    n_t, n_z = _check_stag_mesh("dslash_staggered_pallas_sharded_v3",
+                                mesh, psi_pl, long_pl is not None)
+    exchange = _make_exchange(policy, mesh, interpret)
 
     out = dslash_staggered_pallas_v3(fat_pl, psi_pl, X, long_pl=long_pl,
                                      interpret=interpret)
@@ -372,11 +424,72 @@ def dslash_staggered_pallas_sharded_v3(fat_pl, psi_pl, X: int, mesh,
         if n == 1:
             continue
         out = _stag_fix_faces(out, fat_pl, fat_pl, psi_pl, 1, axis,
-                              name, n, mu)
+                              name, n, mu, exchange)
         if long_pl is not None:
             out = _stag_fix_faces(out, long_pl, long_pl, psi_pl, 3,
-                                  axis, name, n, mu)
+                                  axis, name, n, mu, exchange)
     return out
+
+
+def dslash_staggered_pallas_sharded(fat_pl, fat_bw_pl, psi_pl, X: int,
+                                    mesh, long_pl=None, long_bw_pl=None,
+                                    interpret: bool = False,
+                                    policy: str = "xla_facefix"):
+    """Staggered / improved-staggered D psi under shard_map on the v2
+    GATHER kernel form — the measured single-chip staggered default
+    brought to the mesh (the round-8 Wilson move applied to the second
+    headline family).
+
+    ``fat_bw_pl``/``long_bw_pl`` are the LOCAL blocks of the GLOBALLY
+    pre-shifted backward links (ops/staggered_pallas.backward_links on
+    the global arrays BEFORE sharding — their t/z shifts then already
+    carry the cross-shard links, including the 3-hop Naik reach), so
+    the exterior fixes exchange ONLY psi slabs: a 1-row slab per fat
+    hop set and a 3-row slab per Naik hop set, each riding one
+    ``exchange`` call (the QUDA_TPU_SHARDED_POLICY seam)."""
+    from ..ops.staggered_pallas import dslash_staggered_pallas
+
+    n_t, n_z = _check_stag_mesh("dslash_staggered_pallas_sharded",
+                                mesh, psi_pl, long_pl is not None)
+    exchange = _make_exchange(policy, mesh, interpret)
+
+    out = dslash_staggered_pallas(fat_pl, fat_bw_pl, psi_pl, X,
+                                  long_pl=long_pl,
+                                  long_bw_pl=long_bw_pl,
+                                  interpret=interpret)
+
+    for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
+        if n == 1:
+            continue
+        out = _stag_fix_faces_v2(out, fat_pl, fat_bw_pl, psi_pl, 1,
+                                 axis, name, n, mu, exchange)
+        if long_pl is not None:
+            out = _stag_fix_faces_v2(out, long_pl, long_bw_pl, psi_pl,
+                                     3, axis, name, n, mu, exchange)
+    return out
+
+
+def _check_stag_eo_mesh(name: str, mesh, psi_pl, with_long: bool):
+    """Shared guards of the checkerboarded sharded staggered policies:
+    t/z-only mesh, EVEN local extents on partitioned axes (the in-kernel
+    x-slot parity masks use local coordinates, so shard offsets must not
+    flip the site parity), local extent >= 3 under the Naik slab fix."""
+    n_t, n_z = mesh.shape["t"], mesh.shape["z"]
+    if mesh.shape["y"] != 1 or mesh.shape["x"] != 1:
+        raise ValueError(f"{name} shards t/z only (y/x mesh axes must "
+                         "be 1)")
+    t_loc, z_loc = psi_pl.shape[-3], psi_pl.shape[-2]
+    for nn, ext, nm in ((n_t, t_loc, "T"), (n_z, z_loc, "Z")):
+        if nn > 1 and ext % 2 != 0:
+            raise ValueError(
+                f"local {nm} extent {ext} must be even on a partitioned "
+                f"axis (the checkerboard masks use local coordinates)")
+        if nn > 1 and with_long and ext < 3:
+            raise ValueError(
+                "local extent < 3 on a partitioned axis: the Naik slab "
+                "fix needs the 3-hop to cross at most one shard "
+                "boundary")
+    return n_t, n_z, t_loc, z_loc
 
 
 def dslash_staggered_eo_pallas_sharded_v3(fat_here_pl, fat_there_pl,
@@ -384,13 +497,16 @@ def dslash_staggered_eo_pallas_sharded_v3(fat_here_pl, fat_there_pl,
                                           target_parity: int, mesh,
                                           long_here_pl=None,
                                           long_there_pl=None,
-                                          interpret: bool = False):
+                                          interpret: bool = False,
+                                          policy: str = "xla_facefix"):
     """Checkerboarded staggered hop under shard_map — the complex-free
     staggered SOLVE stencil (models/staggered.DiracStaggeredPCPairs)
     made multi-chip: interior eo v3 kernel + the same slab face fixes,
     with forward hops reading the target-parity links and the backward
     product built from the opposite-parity links (both already resident
-    per shard; only psi slabs and product slabs ride the ppermute).
+    per shard; only psi slabs and product slabs ride the ``exchange``
+    policy seam — QUDA_TPU_SHARDED_POLICY covers staggered through the
+    same seam as Wilson).
 
     t/z hops flip parity but keep the checkerboarded x-slot layout, so
     the full-lattice slab alignment carries over unchanged.  ``dims``
@@ -401,23 +517,11 @@ def dslash_staggered_eo_pallas_sharded_v3(fat_here_pl, fat_there_pl,
     """
     from ..ops.staggered_pallas import dslash_staggered_eo_pallas_v3
 
-    n_t, n_z = mesh.shape["t"], mesh.shape["z"]
-    if mesh.shape["y"] != 1 or mesh.shape["x"] != 1:
-        raise ValueError(
-            "dslash_staggered_eo_pallas_sharded_v3 shards t/z only "
-            "(y/x mesh axes must be 1)")
-    t_loc, z_loc = psi_pl.shape[-3], psi_pl.shape[-2]
-    for nn, ext, nm in ((n_t, t_loc, "T"), (n_z, z_loc, "Z")):
-        if nn > 1 and ext % 2 != 0:
-            raise ValueError(
-                f"local {nm} extent {ext} must be even on a partitioned "
-                f"axis (the checkerboard masks use local coordinates)")
-        if nn > 1 and long_here_pl is not None and ext < 3:
-            raise ValueError(
-                "local extent < 3 on a partitioned axis: the Naik slab "
-                "fix needs the 3-hop to cross at most one shard "
-                "boundary")
+    n_t, n_z, t_loc, z_loc = _check_stag_eo_mesh(
+        "dslash_staggered_eo_pallas_sharded_v3", mesh, psi_pl,
+        long_here_pl is not None)
     dims_local = (t_loc, z_loc, dims[2], dims[3])
+    exchange = _make_exchange(policy, mesh, interpret)
 
     out = dslash_staggered_eo_pallas_v3(
         fat_here_pl, fat_there_pl, psi_pl, dims_local, target_parity,
@@ -429,10 +533,56 @@ def dslash_staggered_eo_pallas_sharded_v3(fat_here_pl, fat_there_pl,
         if n == 1:
             continue
         out = _stag_fix_faces(out, fat_here_pl, fat_there_pl, psi_pl, 1,
-                              axis, name, n, mu)
+                              axis, name, n, mu, exchange)
         if long_here_pl is not None:
             out = _stag_fix_faces(out, long_here_pl, long_there_pl,
-                                  psi_pl, 3, axis, name, n, mu)
+                                  psi_pl, 3, axis, name, n, mu, exchange)
+    return out
+
+
+def dslash_staggered_eo_pallas_sharded(fat_here_pl, fat_bw_pl, psi_pl,
+                                       dims, target_parity: int, mesh,
+                                       long_here_pl=None,
+                                       long_bw_pl=None,
+                                       interpret: bool = False,
+                                       policy: str = "xla_facefix"):
+    """Checkerboarded staggered / improved-staggered hop under shard_map
+    on the v2 GATHER kernel form — the staggered CG hot path brought to
+    the mesh (the round-8 Wilson move applied to the second headline
+    family; reference: the nFace=3 staggered policies of
+    lib/dslash_policy.hpp:365 over include/kernels/dslash_staggered.cuh).
+
+    ``fat_bw_pl``/``long_bw_pl`` are the LOCAL blocks of the GLOBALLY
+    pre-shifted backward links (ops/staggered_pallas.backward_links_eo
+    on the global eo arrays BEFORE sharding — their t/z shifts then
+    already carry the cross-shard links, including the 3-hop Naik
+    reach), so the exterior fixes exchange ONLY psi slabs: a 1-row slab
+    per fat hop set and a 3-row slab per Naik hop set, each riding one
+    ``exchange`` call (the QUDA_TPU_SHARDED_POLICY seam).  ``dims`` are
+    the GLOBAL (T, Z, Y, X); extent rules as the v3 eo wrapper (even
+    local extents, >= 3 under Naik)."""
+    from ..ops.staggered_pallas import dslash_staggered_eo_pallas
+
+    n_t, n_z, t_loc, z_loc = _check_stag_eo_mesh(
+        "dslash_staggered_eo_pallas_sharded", mesh, psi_pl,
+        long_here_pl is not None)
+    dims_local = (t_loc, z_loc, dims[2], dims[3])
+    exchange = _make_exchange(policy, mesh, interpret)
+
+    out = dslash_staggered_eo_pallas(
+        fat_here_pl, fat_bw_pl, psi_pl, dims_local, target_parity,
+        long_here_pl=long_here_pl, long_bw_pl=long_bw_pl,
+        interpret=interpret)
+
+    for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
+        if n == 1:
+            continue
+        out = _stag_fix_faces_v2(out, fat_here_pl, fat_bw_pl, psi_pl, 1,
+                                 axis, name, n, mu, exchange)
+        if long_here_pl is not None:
+            out = _stag_fix_faces_v2(out, long_here_pl, long_bw_pl,
+                                     psi_pl, 3, axis, name, n, mu,
+                                     exchange)
     return out
 
 

@@ -125,6 +125,28 @@ _register("QUDA_TPU_PALLAS_VMEM_MB", "float", 6.0,
           "blocks (e.g. the bf16 full-Z 'equal-to-dim' experiment at "
           "Z=24 needs ~12) — measure before pinning",
           reference="tune.cpp shared-bytes tuning axis")
+_register("QUDA_TPU_PALLAS_VMEM_MB_STAGGERED", "float", 9.0,
+          "per-kernel single-buffer VMEM budget (MB) for the STAGGERED "
+          "pallas z-block selection, overriding QUDA_TPU_PALLAS_VMEM_MB "
+          "for that family only.  The fused single-pass fat+Naik kernel "
+          "keeps both hop sets' link tiles and the t+-1/t+-3 psi tiles "
+          "resident (the split-launch form existed only because that "
+          "working set busts the 6 MB default at useful block sizes, "
+          "PERF.md round 8 lever (a)); the raised default admits it "
+          "while the Wilson kernels keep the measured-proven 6 MB",
+          reference="tune.cpp shared-bytes tuning axis (per-kernel)")
+_register("QUDA_TPU_STAGGERED_FORM", "choice", "auto",
+          "staggered/HISQ pallas kernel form: 'fused' = single-pass "
+          "fat+Naik (one launch, one psi read, no XLA sum pass), "
+          "'two_pass' = separate fat/long gather launches with "
+          "pre-shifted backward links (the pre-round-10 form), 'v3' = "
+          "two-pass scatter, 'auto' = race all forms via utils.tune at "
+          "operator construction and cache the winner per (volume, "
+          "dtype, improved) — A/B'd, not assumed: v3 LOST for Wilson "
+          "on chip, so no staggered form is presumed either",
+          ("", "auto", "fused", "two_pass", "v3"),
+          reference="dslash policy selection; tune.cpp:862 — policies "
+                    "are timed, never assumed")
 _register("QUDA_TPU_DF64", "choice", "",
           "extended-precision (float32-pair) precise path for deep-tol "
           "Wilson CG: '1' = force, '0' = off, empty = auto (engaged when "

@@ -499,3 +499,176 @@ def test_sharded_operator_defaults_to_v2_and_races_policy(tmp_path,
     op2 = dpk.pairs(jnp.float32, use_pallas=True,
                     pallas_interpret=True, mesh=mesh)
     assert op2._sharded_policy_winner == won
+
+
+# -- round 10: sharded staggered on the v2 gather form ----------------------
+
+def _stag_sharded_fixture(improved=True, shape=(4, 4, 8, 16)):
+    """(dims, fat_pp, long_pp, psi_pp) full-lattice staggered pair
+    arrays (partitioned local extents even and >= 3 under Naik)."""
+    from quda_tpu.ops import staggered_packed as spk
+    geom = LatticeGeometry(shape)
+    dims = geom.lattice_shape
+    fat_c = GaugeField.random(jax.random.PRNGKey(61), geom).data.astype(
+        jnp.complex64)
+    long_c = GaugeField.random(jax.random.PRNGKey(62), geom).data.astype(
+        jnp.complex64)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(63), geom
+                                    ).data.astype(jnp.complex64)[..., :1, :]
+    fat_pp = wpk.to_packed_pairs(spk.pack_links(fat_c), jnp.float32)
+    long_pp = (wpk.to_packed_pairs(spk.pack_links(long_c), jnp.float32)
+               if improved else None)
+    psi_pp = wpk.to_packed_pairs(spk.pack_staggered(psi), jnp.float32)
+    return dims, fat_pp, long_pp, psi_pp
+
+
+@pytest.mark.slow
+def test_sharded_staggered_v2_matches_single_device():
+    """Round-10 tentpole (3): the v2 GATHER staggered form — globally
+    pre-shifted backward links for BOTH hop sets (the Naik backward
+    reach crosses the shard seam inside the pre-shift) — under
+    shard_map matches the single-device stencil; only psi slabs ride
+    the exchange (1-row fat + 3-row Naik)."""
+    from quda_tpu.ops import staggered_packed as spk
+    from quda_tpu.ops import staggered_pallas as stp
+    from quda_tpu.parallel.pallas_dslash import (
+        dslash_staggered_pallas_sharded)
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    (T, Z, Y, X), fat_pp, long_pp, psi_pp = _stag_sharded_fixture()
+    ref = spk.dslash_staggered_packed_pairs(fat_pp, psi_pp, X, Y,
+                                            long_pp)
+    # GLOBAL pre-shift, THEN shard (the v2 design)
+    fat_bw = stp.backward_links(fat_pp, X, 1)
+    long_bw = stp.backward_links(long_pp, X, 3)
+
+    mesh = make_lattice_mesh(grid=(4, 2, 1, 1), n_src=1)
+    psi_spec = P(None, None, "t", "z", None)
+    g_spec = P(None, None, None, None, "t", "z", None)
+    fn = compat.shard_map(
+        lambda f, fb, l, lb, p: dslash_staggered_pallas_sharded(
+            f, fb, p, X, mesh, long_pl=l, long_bw_pl=lb,
+            interpret=True),
+        mesh=mesh, in_specs=(g_spec,) * 4 + (psi_spec,),
+        out_specs=psi_spec)
+    args = [jax.device_put(a, NamedSharding(mesh, g_spec))
+            for a in (fat_pp, fat_bw, long_pp, long_bw)]
+    psi_s = jax.device_put(psi_pp, NamedSharding(mesh, psi_spec))
+    out = jax.jit(fn)(*args, psi_s)
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-6
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("parity", [0, 1])
+def test_sharded_staggered_eo_v2_matches_single_device(parity):
+    """Checkerboarded v2-gather staggered hop (the staggered CG hot
+    path) under shard_map == the single-device eo pair stencil, both
+    parities, fat + Naik — the QUDA_TPU_SHARDED_POLICY seam now covers
+    the staggered solve stencil in the measured-best kernel form."""
+    from quda_tpu.fields.spinor import even_odd_split
+    from quda_tpu.ops import staggered_packed as spk
+    from quda_tpu.ops import staggered_pallas as stp
+    from quda_tpu.ops.wilson import split_gauge_eo
+    from quda_tpu.parallel.pallas_dslash import (
+        dslash_staggered_eo_pallas_sharded)
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    geom = LatticeGeometry((4, 4, 8, 16))
+    T, Z, Y, X = geom.lattice_shape
+    dims = (T, Z, Y, X)
+    fat_c = GaugeField.random(jax.random.PRNGKey(64), geom).data.astype(
+        jnp.complex64)
+    long_c = GaugeField.random(jax.random.PRNGKey(65), geom).data.astype(
+        jnp.complex64)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(66), geom
+                                    ).data.astype(jnp.complex64)[..., :1, :]
+    fat_eo = split_gauge_eo(fat_c, geom)
+    long_eo = split_gauge_eo(long_c, geom)
+    pe, po = even_odd_split(psi, geom)
+    src = pe if parity == 1 else po
+    fat_eo_pp = tuple(wpk.to_packed_pairs(spk.pack_links(g), jnp.float32)
+                      for g in fat_eo)
+    long_eo_pp = tuple(wpk.to_packed_pairs(spk.pack_links(g), jnp.float32)
+                       for g in long_eo)
+    src_pp = wpk.to_packed_pairs(spk.pack_staggered(src), jnp.float32)
+    ref = spk.dslash_staggered_eo_packed_pairs(
+        fat_eo_pp, src_pp, dims, parity, long_eo_pp)
+    # GLOBAL pre-shift of the eo backward links, THEN shard
+    fat_bw = stp.backward_links_eo(fat_eo_pp[1 - parity], dims, parity, 1)
+    long_bw = stp.backward_links_eo(long_eo_pp[1 - parity], dims,
+                                    parity, 3)
+
+    mesh = make_lattice_mesh(grid=(4, 2, 1, 1), n_src=1)
+    psi_spec = P(None, None, "t", "z", None)
+    g_spec = P(None, None, None, None, "t", "z", None)
+    fn = compat.shard_map(
+        lambda fh, fb, lh, lb, p: dslash_staggered_eo_pallas_sharded(
+            fh, fb, p, dims, parity, mesh, long_here_pl=lh,
+            long_bw_pl=lb, interpret=True),
+        mesh=mesh, in_specs=(g_spec,) * 4 + (psi_spec,),
+        out_specs=psi_spec)
+    args = [jax.device_put(a, NamedSharding(mesh, g_spec))
+            for a in (fat_eo_pp[parity], fat_bw, long_eo_pp[parity],
+                      long_bw)]
+    src_s = jax.device_put(src_pp, NamedSharding(mesh, psi_spec))
+    out = jax.jit(fn)(*args, src_s)
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-6
+
+
+@pytest.mark.slow
+def test_sharded_staggered_operator_solve_path():
+    """Operator-level wiring: DiracStaggeredPC.pairs(mesh=...) runs
+    M_pairs through the sharded staggered eo policy (two-pass interior
+    pinned under a mesh, halo policy resolved through the
+    QUDA_TPU_SHARDED_POLICY engine) and matches the unsharded pair
+    operator."""
+    from quda_tpu.models.staggered import DiracStaggeredPC
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    geom = LatticeGeometry((4, 4, 8, 16))
+    T, Z, Y, X = geom.lattice_shape
+    fat_c = GaugeField.random(jax.random.PRNGKey(67), geom).data.astype(
+        jnp.complex64)
+    long_c = (0.1 * GaugeField.random(jax.random.PRNGKey(68), geom).data
+              ).astype(jnp.complex64)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(69), geom
+                                    ).data.astype(jnp.complex64)[..., :1, :]
+    from quda_tpu.fields.spinor import even_odd_split
+    pe, _ = even_odd_split(psi, geom)
+    from quda_tpu.ops import staggered_packed as spk
+    dpc = DiracStaggeredPC(fat_c, geom, 0.1, improved=True,
+                           long_links=long_c)
+    ref_op = dpc.pairs(jnp.float32)
+    x_pp = wpk.to_packed_pairs(spk.pack_staggered(pe), jnp.float32)
+    ref = ref_op.M_pairs(x_pp)
+
+    mesh = make_lattice_mesh(grid=(4, 2, 1, 1), n_src=1)
+    sh_op = dpc.pairs(jnp.float32, use_pallas=True,
+                      pallas_interpret=True, mesh=mesh,
+                      sharded_policy="xla_facefix")
+    assert sh_op._pallas_form == "two_pass"   # mesh pins the interior
+    x_s = jax.device_put(
+        x_pp, NamedSharding(mesh, P(None, None, "t", "z", None)))
+    out = jax.jit(sh_op.M_pairs)(x_s)
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-5
+
+
+def test_sharded_staggered_rejects_unknown_policy():
+    """The staggered sharded wrappers ride the same policy registry as
+    Wilson — an unknown QUDA_TPU_SHARDED_POLICY value fails loudly."""
+    from quda_tpu.parallel.pallas_dslash import (
+        dslash_staggered_eo_pallas_sharded)
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 virtual devices")
+    mesh = make_lattice_mesh(grid=(2, 1, 1, 1), n_src=1,
+                             devices=jax.devices()[:2])
+    dims = (4, 4, 4, 8)
+    z = jnp.zeros((4, 3, 3, 2, 4, 4, 16), jnp.float32)
+    p = jnp.zeros((3, 2, 4, 4, 16), jnp.float32)
+    with pytest.raises(ValueError, match="unknown sharded halo policy"):
+        dslash_staggered_eo_pallas_sharded(z, z, p, dims, 0, mesh,
+                                           interpret=True,
+                                           policy="bogus")

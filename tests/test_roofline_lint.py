@@ -1,0 +1,142 @@
+"""Roofline-model lint: every kernel-form label the package can emit
+must have a KERNEL_MODELS entry in obs/roofline.py, so a new kernel
+cannot ship unattributable (the round-9 methodology rule made static —
+same pattern as test_env_knob_lint.py for env knobs).
+
+Two emission surfaces are linted:
+
+* `interfaces/quda_api._solve_form` — swept over dummy operators
+  covering the full attribute lattice (wilson/staggered x kernel
+  form/generation x reconstruct-12 x mesh x pallas-off), so every label
+  the function can construct is checked, including the f-string
+  composites a grep would miss;
+* literal form strings recorded by the API routes and benches —
+  AST-harvested from (a) first string args of record()/attribute()/
+  model() calls and (b) string constants assigned to a ``form``
+  variable, filtered to the roofline namespace prefixes.
+"""
+
+import ast
+import itertools
+import os
+
+import numpy as np
+
+import quda_tpu
+from quda_tpu.interfaces.quda_api import _solve_form
+from quda_tpu.obs import roofline as orf
+
+
+def _mk(name, **attrs):
+    o = type(name, (), {})()
+    for k, v in attrs.items():
+        setattr(o, k, v)
+    return o
+
+
+def _wilson_ops():
+    # the resident link row extent (3 vs 2) drives the _r12 suffix
+    g18 = (np.zeros((4, 3, 3, 2, 2, 2, 4), np.float32),)
+    g12 = (np.zeros((4, 2, 3, 2, 2, 2, 4), np.float32),)
+    for v, g, mesh in itertools.product((2, 3), (g18, g12),
+                                        (None, object())):
+        yield _mk("DiracWilsonPCPackedPairs", use_pallas=True,
+                  _pallas_version=v, gauge_eo_pp=g, _mesh=mesh)
+    yield _mk("DiracWilsonPCPackedPairs", use_pallas=False)
+
+
+def _staggered_ops():
+    from quda_tpu.models.staggered import STAGGERED_FORMS
+    for form, improved, mesh in itertools.product(
+            STAGGERED_FORMS, (False, True), (None, object())):
+        if form == "fused" and not improved:
+            continue          # models/staggered.py forbids the combo
+        yield _mk("DiracStaggeredPCPairs", use_pallas=True,
+                  _pallas_form=form,
+                  long_eo_pp=(object(),) if improved else None,
+                  _mesh=mesh)
+    yield _mk("DiracStaggeredPCPairs", use_pallas=False,
+              long_eo_pp=None)
+
+
+def test_solve_form_labels_have_models():
+    missing = {}
+    for op in itertools.chain(_wilson_ops(), _staggered_ops()):
+        form = _solve_form(op)
+        if form not in orf.KERNEL_MODELS:
+            missing.setdefault(form, type(op).__name__)
+    assert not missing, (
+        f"_solve_form can emit labels without a KERNEL_MODELS entry: "
+        f"{missing} — add the traffic model to obs/roofline.py (or "
+        "None bytes for an honest flops-only row)")
+
+
+_FORM_PREFIXES = ("wilson", "staggered", "generic")
+
+
+def _harvested_literals(path):
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read())
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = getattr(fn, "attr", None) or getattr(fn, "id", "")
+            if name in ("record", "attribute", "model") and node.args:
+                a0 = node.args[0]
+                if (isinstance(a0, ast.Constant)
+                        and isinstance(a0.value, str)):
+                    out.add(a0.value)
+        elif isinstance(node, ast.Assign):
+            if any(getattr(t, "id", "") == "form"
+                   for t in node.targets):
+                for c in ast.walk(node.value):
+                    if (isinstance(c, ast.Constant)
+                            and isinstance(c.value, str)):
+                        out.add(c.value)
+    return {s for s in out
+            if any(s == p or s.startswith(p + "_")
+                   for p in _FORM_PREFIXES)}
+
+
+def test_recorded_form_literals_have_models():
+    pkg = os.path.dirname(os.path.abspath(quda_tpu.__file__))
+    root = os.path.dirname(pkg)
+    paths = [os.path.join(root, f) for f in ("bench.py", "bench_suite.py")
+             if os.path.exists(os.path.join(root, f))]
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        paths += [os.path.join(dirpath, f) for f in filenames
+                  if f.endswith(".py")]
+    missing = {}
+    for path in paths:
+        for lit in _harvested_literals(path):
+            if lit not in orf.KERNEL_MODELS:
+                missing.setdefault(lit, []).append(
+                    os.path.relpath(path, root))
+    assert not missing, (
+        f"form literals recorded without a KERNEL_MODELS entry: "
+        f"{missing}")
+
+
+def test_fused_model_meets_round10_traffic_target():
+    """Acceptance pin: the fused fat+Naik model must show <= ~900 B/site
+    against the two-pass 1512 (the 1.75x structural win the kernel
+    exists to realise), at identical flops."""
+    fused = orf.KERNEL_MODELS["staggered_fat_naik_fused"]
+    two_pass = orf.KERNEL_MODELS["staggered_fat_naik"]
+    assert fused["flops_per_site"] == two_pass["flops_per_site"] == 1146
+    assert fused["bytes_per_site"] <= 900
+    assert two_pass["bytes_per_site"] == 1512
+
+
+def test_mrhs_models_amortize_with_nrhs():
+    """nrhs-dependent traffic models must be callable, decreasing in N,
+    and anchored to the single-RHS two-pass totals at N=1."""
+    for form, n1 in (("staggered_mrhs", 1512.0),
+                     ("staggered_fat_mrhs", 720.0),
+                     ("wilson_mrhs", 1152.0)):
+        bps = orf.KERNEL_MODELS[form]["bytes_per_site"]
+        assert callable(bps)
+        assert bps(1) == n1
+        assert bps(8) < bps(4) < bps(1)

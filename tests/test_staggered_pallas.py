@@ -285,3 +285,243 @@ def test_staggered_pairs_operator_cg(use_pallas):
     x = even_odd_join(xe, xo, geom)
     res = float(jnp.sqrt(qblas.norm2(b - d_full.M(x)) / qblas.norm2(b)))
     assert res < 1e-5
+
+
+# -- round 10: fused single-pass fat+Naik kernel ----------------------------
+
+def test_fused_bitmatches_two_pass_sum_folded_links():
+    """THE round-10 acceptance test: the fused fat+Naik kernel in ONE
+    pallas launch bit-matches the XLA sum of the two v3 scatter passes
+    (same hop algebra — _accumulate_hopset — run twice into separate
+    accumulators), and matches the pair stencil to fp tolerance.  Links
+    carry FOLDED staggered phases + antiperiodic t (the production
+    form), so the sign structure is live."""
+    from quda_tpu.fields.geometry import LatticeGeometry
+    from quda_tpu.ops.boundary import apply_staggered_phases
+
+    geom = LatticeGeometry((4, 4, 6, 4))
+    T, Z, Y, X = geom.lattice_shape
+    key = jax.random.PRNGKey(10)
+    k1, k2, k3 = jax.random.split(key, 3)
+    fat = apply_staggered_phases(
+        GaugeField.random(k1, geom).data.astype(jnp.complex64), geom,
+        True)
+    lng = apply_staggered_phases(
+        GaugeField.random(k2, geom).data.astype(jnp.complex64), geom,
+        True, nhop=3)
+    psi = (jax.random.normal(k3, (T, Z, Y, X, 1, 3), jnp.float32)
+           + 1j * jax.random.normal(jax.random.fold_in(k3, 1),
+                                    (T, Z, Y, X, 1, 3), jnp.float32)
+           ).astype(jnp.complex64)
+    fat_pp = to_packed_pairs(spk.pack_links(fat), jnp.float32)
+    long_pp = to_packed_pairs(spk.pack_links(lng), jnp.float32)
+    psi_pp = to_packed_pairs(spk.pack_staggered(psi), jnp.float32)
+
+    ref = spk.dslash_staggered_packed_pairs(fat_pp, psi_pp, X, Y,
+                                            long_pp)
+    two_pass = spl.dslash_staggered_pallas_v3(fat_pp, psi_pp, X,
+                                              long_pl=long_pp,
+                                              interpret=True, block_z=Z)
+    fused = spl.dslash_staggered_pallas_fused(fat_pp, psi_pp, X,
+                                              long_pl=long_pp,
+                                              interpret=True, block_z=Z)
+    # bit-identical to the two-pass sum (same adds, same order)
+    assert bool(jnp.all(fused == two_pass))
+    err = float(jnp.sqrt(blas.norm2(ref - fused) / blas.norm2(ref)))
+    assert err < 1e-6
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bz", [None, 3])
+def test_fused_multiblock_splice_matches_stencil(bz):
+    """Multi-z-block fused launch: the direct edge-row splice (no
+    bz % nhop constraint) must reproduce the stencil across z-block
+    boundaries for both hop sets."""
+    geom, fat_p, long_p, psi_p = _setup(jax.random.PRNGKey(11),
+                                        (4, 4, 6, 4))
+    T, Z, Y, X = geom.lattice_shape
+    fat_pp = to_packed_pairs(fat_p, jnp.float32)
+    long_pp = to_packed_pairs(long_p, jnp.float32)
+    psi_pp = to_packed_pairs(psi_p, jnp.float32)
+    ref = spk.dslash_staggered_packed_pairs(fat_pp, psi_pp, X, Y,
+                                            long_pp)
+    out = spl.dslash_staggered_pallas_fused(fat_pp, psi_pp, X,
+                                            long_pl=long_pp,
+                                            interpret=True, block_z=bz)
+    err = float(jnp.sqrt(blas.norm2(ref - out) / blas.norm2(ref)))
+    assert err < 1e-6
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bz", [None, 3])
+@pytest.mark.parametrize("parity", [0, 1])
+def test_fused_eo_bitmatches_v3(parity, bz):
+    """Checkerboarded fused kernel == the eo v3 two-pass sum
+    (bit-exact) and the eo pair stencil (tolerance), both parities and
+    both z-blockings — bz=3 exercises the eo boundary-row splice
+    (_psi_z_rows/_u_z_rows), the production configuration whenever
+    _pick_bz_fused selects bz < Z (~32s interpreter compile each ->
+    slow per the >30s policy; the fast tier pins the fused hop algebra
+    through the full-lattice bit-match above, which shares the kernel
+    body)."""
+    _fused_eo_case(parity, bz)
+
+
+def _fused_eo_case(parity, bz=None):
+    from quda_tpu.fields.spinor import even_odd_split
+    from quda_tpu.ops.wilson import split_gauge_eo
+
+    geom = LatticeGeometry((4, 6, 6, 4))
+    T, Z, Y, X = geom.lattice_shape
+    dims = (T, Z, Y, X)
+    key = jax.random.PRNGKey(12)
+    k1, k2, k3 = jax.random.split(key, 3)
+    fat = GaugeField.random(k1, geom).data.astype(jnp.complex64)
+    lng = GaugeField.random(k2, geom).data.astype(jnp.complex64)
+    psi = (jax.random.normal(k3, (T, Z, Y, X, 1, 3), jnp.float32)
+           + 1j * jax.random.normal(jax.random.fold_in(k3, 1),
+                                    (T, Z, Y, X, 1, 3), jnp.float32)
+           ).astype(jnp.complex64)
+    fat_eo = split_gauge_eo(fat, geom)
+    long_eo = split_gauge_eo(lng, geom)
+    pe, po = even_odd_split(psi, geom)
+    src = pe if parity == 1 else po
+    fat_eo_pp = tuple(to_packed_pairs(spk.pack_links(g), jnp.float32)
+                      for g in fat_eo)
+    long_eo_pp = tuple(to_packed_pairs(spk.pack_links(g), jnp.float32)
+                       for g in long_eo)
+    src_pp = to_packed_pairs(spk.pack_staggered(src), jnp.float32)
+    ref = spk.dslash_staggered_eo_packed_pairs(
+        fat_eo_pp, src_pp, dims, parity, long_eo_pp)
+    v3 = spl.dslash_staggered_eo_pallas_v3(
+        fat_eo_pp[parity], fat_eo_pp[1 - parity], src_pp, dims, parity,
+        long_here_pl=long_eo_pp[parity],
+        long_there_pl=long_eo_pp[1 - parity], interpret=True,
+        block_z=Z)
+    fused = spl.dslash_staggered_eo_pallas_fused(
+        fat_eo_pp[parity], fat_eo_pp[1 - parity], src_pp, dims, parity,
+        long_here_pl=long_eo_pp[parity],
+        long_there_pl=long_eo_pp[1 - parity], interpret=True,
+        block_z=bz if bz is not None else Z)
+    assert bool(jnp.all(fused == v3))
+    err = float(jnp.sqrt(blas.norm2(ref - fused) / blas.norm2(ref)))
+    assert err < 1e-6
+
+
+def test_fused_requires_long_links():
+    """The fused kernel IS the fat+Naik fusion: a fat-only call must be
+    rejected loudly (one hop set has nothing to fuse)."""
+    geom, fat_p, _, psi_p = _setup(jax.random.PRNGKey(13), (4, 4, 4, 4))
+    fat_pp = to_packed_pairs(fat_p, jnp.float32)
+    psi_pp = to_packed_pairs(psi_p, jnp.float32)
+    with pytest.raises(ValueError, match="fat\\+Naik fusion"):
+        spl.dslash_staggered_pallas_fused(fat_pp, psi_pp, 4,
+                                          interpret=True)
+
+
+def test_long_bz_guard_raises_loudly():
+    """Satellite: 0 < block_z < 3 with a Naik pass would silently
+    corrupt the long-hop boundary rows (the splice only reaches the
+    adjacent z-block) — every entry point must reject it."""
+    geom, fat_p, long_p, psi_p = _setup(jax.random.PRNGKey(14),
+                                        (4, 4, 6, 4))
+    T, Z, Y, X = geom.lattice_shape
+    fat_pp = to_packed_pairs(fat_p, jnp.float32)
+    long_pp = to_packed_pairs(long_p, jnp.float32)
+    psi_pp = to_packed_pairs(psi_p, jnp.float32)
+    fat_bw = spl.backward_links(fat_pp, X, 1)
+    long_bw = spl.backward_links(long_pp, X, 3)
+    for bad in (1, 2):
+        with pytest.raises(ValueError, match="block_z >= 3"):
+            spl.dslash_staggered_pallas(
+                fat_pp, fat_bw, psi_pp, X, long_pl=long_pp,
+                long_bw_pl=long_bw, interpret=True, block_z=bad)
+        with pytest.raises(ValueError, match="block_z >= 3"):
+            spl.dslash_staggered_pallas_fused(
+                fat_pp, psi_pp, X, long_pl=long_pp, interpret=True,
+                block_z=bad)
+    # the automatic picker must never land in the illegal window:
+    # min_bz=3 excludes it by construction
+    from quda_tpu.ops.wilson_pallas_packed import _pick_bz
+    bz = _pick_bz(Z, Y * X, jnp.float32, planes=180, min_bz=3,
+                  vmem_knob="QUDA_TPU_PALLAS_VMEM_MB_STAGGERED")
+    assert bz == Z or bz >= 3
+
+
+# -- round 10: kernel-form selection on the solver operator -----------------
+
+def _pairs_fixture(improved=True, dims=(4, 4, 4, 4)):
+    from quda_tpu.models.staggered import DiracStaggeredPC
+    geom = LatticeGeometry(dims)
+    T, Z, Y, X = geom.lattice_shape
+    key = jax.random.PRNGKey(15)
+    k1, k2, k3 = jax.random.split(key, 3)
+    fat = GaugeField.random(k1, geom).data.astype(jnp.complex64)
+    lng = ((0.1 * GaugeField.random(k2, geom).data).astype(jnp.complex64)
+           if improved else None)
+    dpc = DiracStaggeredPC(fat, geom, 0.1, improved=improved,
+                           long_links=lng)
+    x = (jax.random.normal(k3, (3, 2, T, Z, Y * X // 2), jnp.float32))
+    return dpc, x
+
+
+@pytest.mark.slow
+def test_staggered_forms_agree_on_M_pairs():
+    """Every selectable kernel form computes the same PC operator: the
+    fused form bit-matches v3 (same hop algebra), and both match the
+    two-pass gather form to fp tolerance."""
+    dpc, x = _pairs_fixture()
+    outs = {}
+    for form in ("fused", "two_pass", "v3"):
+        op = dpc.pairs(jnp.float32, use_pallas=True,
+                       pallas_interpret=True, form=form)
+        assert op._pallas_form == form
+        outs[form] = op.M_pairs(x)
+    assert bool(jnp.all(outs["fused"] == outs["v3"]))
+    err = float(jnp.sqrt(
+        blas.norm2(outs["fused"] - outs["two_pass"])
+        / blas.norm2(outs["two_pass"])))
+    assert err < 1e-6
+
+
+def test_staggered_form_auto_resolves_without_race_off_chip():
+    """'auto' in interpret mode must NOT race (timing the interpreter
+    is meaningless): it resolves statically to the projected winner —
+    fused for improved, two_pass for fat-only (nothing to fuse)."""
+    dpc, _ = _pairs_fixture()
+    op = dpc.pairs(jnp.float32, use_pallas=True, pallas_interpret=True,
+                   form="auto")
+    assert op._pallas_form == "fused"
+    dpc_fat, _ = _pairs_fixture(improved=False)
+    op2 = dpc_fat.pairs(jnp.float32, use_pallas=True,
+                        pallas_interpret=True, form="auto")
+    assert op2._pallas_form == "two_pass"
+    # legacy pallas_version kwarg still pins the generation
+    op3 = dpc.pairs(jnp.float32, use_pallas=True, pallas_interpret=True,
+                    pallas_version=3)
+    assert op3._pallas_form == "v3"
+    assert op3._pallas_version == 3
+
+
+def test_staggered_form_auto_races_via_tune(monkeypatch):
+    """'auto' on chip goes through utils.tune over ALL applicable forms
+    (A/B'd, not assumed — v3 lost for Wilson) and honors the winner."""
+    from quda_tpu.utils import tune as qtune
+    seen = {}
+
+    def fake_tune(name, volume, candidates, args, aux="", **kw):
+        seen["name"] = name
+        seen["cands"] = sorted(candidates)
+        seen["aux"] = aux
+        return "v3"
+
+    monkeypatch.setattr(qtune, "tune", fake_tune)
+    dpc, x = _pairs_fixture()
+    # pallas_interpret=False + tuning enabled -> the race path runs
+    # (tune is mocked, so no pallas kernel actually compiles off-TPU)
+    op = dpc.pairs(jnp.float32, use_pallas=True, pallas_interpret=False,
+                   form="auto")
+    assert seen["name"] == "staggered_eo_form"
+    assert seen["cands"] == ["fused", "two_pass", "v3"]
+    assert "fat_naik" in seen["aux"]
+    assert op._pallas_form == "v3"
