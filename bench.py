@@ -119,6 +119,49 @@ def gate_row(suite: str, row: dict, banner_platform: str = None):
     return True, ""
 
 
+# Rows accepted by record_row in this process, in order — the compare
+# gate's "current run" input (bench_suite --compare).  Rejected rows are
+# kept too so the gate summary can say how many died at the gate.
+_RECORDED_ROWS: list = []
+_REJECTED_ROWS: list = []
+
+
+def recorded_rows() -> list:
+    """(suite, row) pairs accepted by record_row this process."""
+    return list(_RECORDED_ROWS)
+
+
+def rejected_rows() -> list:
+    """(suite, row, reason) triples refused by record_row this process."""
+    return list(_REJECTED_ROWS)
+
+
+def reset_recorded_rows():
+    _RECORDED_ROWS.clear()
+    _REJECTED_ROWS.clear()
+
+
+def _mirror_row_event(name: str, suite: str, row: dict, **extra):
+    """Mirror a bench row into the obs trace stream (bench_suite
+    --trace) so the chrome artifact carries the measurements next to
+    the spans/tuner events; scalars only, and never let observability
+    break a measurement run."""
+    try:
+        from quda_tpu.obs import trace as _otr
+        if _otr.enabled():
+            # row keys that collide with event()'s own parameters are
+            # prefixed
+            taken = ("name", "cat", "suite") + tuple(extra)
+            fields = {("row_" + k if k in taken else k): v
+                      for k, v in row.items()
+                      if isinstance(v, (str, int, float, bool))
+                      or v is None}
+            _otr.event(name, cat="bench", suite=suite, **fields,
+                       **extra)
+    except Exception:
+        pass
+
+
 def record_row(suite: str, row: dict, banner_platform: str = None,
                log=None):
     """Print ``row`` as one JSON line iff it passes ``gate_row``;
@@ -130,27 +173,17 @@ def record_row(suite: str, row: dict, banner_platform: str = None,
     ok, reason = gate_row(suite, row, banner_platform)
     if ok:
         log(json.dumps(dict({"suite": suite}, **row)))
-        # mirror gated rows into the obs trace stream (bench_suite
-        # --trace) so the chrome artifact carries the measurements next
-        # to the spans/tuner events; scalars only, and never let
-        # observability break a measurement run
-        try:
-            from quda_tpu.obs import trace as _otr
-            if _otr.enabled():
-                # row keys that collide with event()'s own parameters
-                # ('name', 'cat') are prefixed
-                fields = {("row_" + k if k in ("name", "cat") else k): v
-                          for k, v in row.items()
-                          if isinstance(v, (str, int, float, bool))
-                          or v is None}
-                _otr.event("bench_row", cat="bench", suite=suite,
-                           **fields)
-        except Exception:
-            pass
+        _RECORDED_ROWS.append((suite, dict(row)))
+        _mirror_row_event("bench_row", suite, row)
     else:
         log(json.dumps({"suite": suite, "name": row.get("name"),
                         "rejected": reason,
                         "platform": row.get("platform")}))
+        _REJECTED_ROWS.append((suite, dict(row), reason))
+        # rejections mirror too (bench_row_rejected): a gate failure
+        # must be visible in the chrome artifact, not just the text log
+        _mirror_row_event("bench_row_rejected", suite, row,
+                          rejected=reason)
     return ok
 
 

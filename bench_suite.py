@@ -137,6 +137,37 @@ def main(argv):
     # and roofline events land in the same stream
     do_trace = "--trace" in argv
 
+    # --compare: after the run, diff this run's gated rows against the
+    # best-credible baselines in the committed BENCH_*/MULTICHIP_*
+    # history (obs/regress.py) — rejection JSON rows + nonzero exit on
+    # >tol throughput regression or solver-iteration inflation, and
+    # trends.tsv written for PERF.md to cite.  --compare --dry skips
+    # all measurement (no jax, no probe): the newest committed round
+    # plays "current" against the rest — the CI-shaped gate over
+    # already-committed history.  Value flags (--tol=X, --iters-tol=Y,
+    # --history=DIR, --trends=PATH) use the = form.
+    do_compare = "--compare" in argv
+    dry = "--dry" in argv
+
+    # value flags are popped up front with the regress CLI's own parser
+    # (one parser, both entry points, --flag X and --flag=X forms) so a
+    # space-separated value can never be mistaken for a suite name
+    from quda_tpu.obs import regress   # pure python, no jax
+    argv = list(argv)
+    try:
+        opts = {flag: regress.pop_opt(argv, flag)
+                for flag in ("--tol", "--iters-tol", "--history",
+                             "--trends")}
+    except ValueError as e:
+        print(json.dumps({"suite": "compare", "error": str(e)}),
+              flush=True)
+        return 2
+
+    if do_compare and dry:
+        passthrough = [t for flag, v in opts.items() if v is not None
+                       for t in (flag, v)]
+        return regress.main(["--latest"] + passthrough)
+
     force_cpu = _conf("QUDA_TPU_BENCH_CPU")
     if force_cpu:
         probe = {"platform": "cpu", "complex_ok": True}
@@ -1137,6 +1168,20 @@ def main(argv):
             print(json.dumps({"suite": "harness", "trace": paths}),
                   flush=True)
 
+    rc = 0
+    if do_compare:
+        import bench as _bench
+        current = regress.canonicalize_recorded(_bench.recorded_rows())
+        tol = opts["--tol"]
+        iters_tol = opts["--iters-tol"]
+        rc = regress.run_compare(
+            current,
+            opts["--history"] or regress.default_history_dir(),
+            tol=float(tol) if tol is not None else None,
+            iters_tol=float(iters_tol) if iters_tol is not None else None,
+            trends_path=opts["--trends"])
+    return rc
+
 
 if __name__ == "__main__":
-    main(sys.argv[1:])
+    sys.exit(main(sys.argv[1:]) or 0)

@@ -42,9 +42,20 @@ def init_quda(device: int = 0):
     from ..obs import trace as otr
     from ..utils import config as qconf
     from ..utils import monitor as qmon
+    from ..utils import tune as qtune
     qconf.check_environment()  # warn on typoed / CUDA-era env knobs
     qmon.start_default()       # QUDA_TPU_ENABLE_MONITOR sampling thread
     otr.maybe_start()          # QUDA_TPU_TRACE span/event session
+    # warm-start the chip-keyed tuner cache (tune.cpp persistent-cache
+    # behavior): a fresh worker with a shared QUDA_TPU_RESOURCE_PATH
+    # serves its first solve from already-raced (platform, volume,
+    # form) winners — zero re-races, and the load is mirrored as a
+    # tune_cache_loaded trace event (after maybe_start, so it lands in
+    # the session)
+    usable = qtune.warm_start()
+    if usable:
+        qlog.printq(f"tuner warm cache: {usable} entries usable on "
+                    f"{qtune.platform_key()}", qlog.VERBOSE)
     _ctx["initialized"] = True
     qlog.printq("initialized", qlog.VERBOSE)
 

@@ -298,8 +298,10 @@ class DiracStaggeredPCPairs:
             else:
                 form = self._race_form()
                 _notice_staggered_form(
-                    form, None, "raced+cached "
-                    "(QUDA_TPU_STAGGERED_FORM=auto)")
+                    form, None,
+                    "warm cache (chip-keyed tunecache)"
+                    if getattr(self, "_form_from_warm_cache", False)
+                    else "raced+cached (QUDA_TPU_STAGGERED_FORM=auto)")
         elif form == "auto":
             # XLA stencil path: the form knob has no kernel to pick
             form = "two_pass"
@@ -400,10 +402,15 @@ class DiracStaggeredPCPairs:
         improved = self.long_eo_pp is not None
         cands = {k: jax.jit(f)
                  for k, f in self._form_candidates().items()}
+        aux = (f"{'fat_naik' if improved else 'fat'}|"
+               f"{jnp.dtype(self.store_dtype).name}")
+        # provenance for the construction notice: a winner already
+        # raced on THIS chip (platform-keyed tunecache) is served
+        # without re-racing
+        self._form_from_warm_cache = qtune.cached_param(
+            "staggered_eo_form", self.dims, aux=aux) is not None
         return qtune.tune(
-            "staggered_eo_form", self.dims, cands, (psi0,),
-            aux=f"{'fat_naik' if improved else 'fat'}|"
-                f"{jnp.dtype(self.store_dtype).name}")
+            "staggered_eo_form", self.dims, cands, (psi0,), aux=aux)
 
     # -- sharded dispatch (the QUDA_TPU_SHARDED_POLICY seam) ------------
     def _build_sharded_fn(self, target_parity, out_dtype, policy: str):
@@ -479,18 +486,21 @@ class DiracStaggeredPCPairs:
             NamedSharding(self._mesh, P(None, None, "t", "z", None)))
         mesh_shape = tuple(int(self._mesh.shape[a])
                            for a in self._mesh.axis_names)
+        aux = (f"{self._pallas_form}|mesh{mesh_shape}|"
+               f"{jnp.dtype(self.store_dtype).name}")
+        warm = qtune.cached_param("staggered_eo_sharded_policy",
+                                  self.dims, aux=aux)
         won = qtune.tune(
             "staggered_eo_sharded_policy", self.dims, cands,
-            self._sharded_args(target_parity) + (psi0,),
-            aux=f"{self._pallas_form}|mesh{mesh_shape}|"
-                f"{jnp.dtype(self.store_dtype).name}")
+            self._sharded_args(target_parity) + (psi0,), aux=aux)
         self._sharded_policy_winner = won
         key = (target_parity,
                jnp.dtype(out_dtype or self.store_dtype).name)
         self.__dict__.setdefault("_sharded_fns", {})[key] = cands[won]
-        _notice_staggered_form(self._pallas_form, won,
-                               "raced+cached "
-                               "(QUDA_TPU_SHARDED_POLICY=auto)")
+        _notice_staggered_form(
+            self._pallas_form, won,
+            "warm cache (chip-keyed tunecache)" if warm is not None
+            else "raced+cached (QUDA_TPU_SHARDED_POLICY=auto)")
         return won
 
     def _sharded_d_to(self, target_parity, out_dtype):

@@ -217,10 +217,11 @@ class _PairSloppyBase:
 _SHARDED_NOTICED = False
 
 
-def _notice_sharded_policy(version: int, policy: str, raced: bool):
+def _notice_sharded_policy(version: int, policy: str, src: str):
     """One-time provenance notice naming the mesh dslash configuration
-    actually selected (kernel form + halo policy + how it was chosen) —
-    a policy must never take effect without a trace (utils/config.py
+    actually selected (kernel form + halo policy + how it was chosen:
+    pinned, raced, or served from the chip-keyed tunecache warm cache)
+    — a policy must never take effect without a trace (utils/config.py
     fail-fast model; successor of the retired _notice_mesh_forces_v3,
     which existed because the sharded path could only run the v3
     scatter form — round 8 ported the measured-best v2 form, so the
@@ -230,8 +231,6 @@ def _notice_sharded_policy(version: int, policy: str, raced: bool):
         return
     _SHARDED_NOTICED = True
     from ..utils import logging as qlog
-    src = ("raced+cached (QUDA_TPU_SHARDED_POLICY=auto)" if raced
-           else "pinned")
     qlog.printq(
         f"mesh dslash: pallas v{version} eo interior, halo policy "
         f"{policy} ({src}); pin via QUDA_TPU_PALLAS_VERSION / "
@@ -331,7 +330,7 @@ class _PackedHopMixin:
                 self._resolve_sharded_policy(0, None)
             else:
                 _notice_sharded_policy(self._pallas_version,
-                                       self._sharded_policy, False)
+                                       self._sharded_policy, "pinned")
 
     def _d_to(self, psi_pp, target_parity, out_dtype):
         from ..ops import wilson_packed as wpk
@@ -414,7 +413,7 @@ class _PackedHopMixin:
         simply loses the race — tune skips failing candidates."""
         pol = self._sharded_policy
         if pol != "auto":
-            _notice_sharded_policy(self._pallas_version, pol, False)
+            _notice_sharded_policy(self._pallas_version, pol, "pinned")
             return pol
         won = getattr(self, "_sharded_policy_winner", None)
         if won is not None:
@@ -437,11 +436,16 @@ class _PackedHopMixin:
                           P(None, None, None, "t", "z", None)))
         mesh_shape = tuple(int(self._mesh.shape[a])
                            for a in self._mesh.axis_names)
+        aux = (f"v{self._pallas_version}|mesh{mesh_shape}|"
+               f"{jnp.dtype(self.store_dtype).name}")
+        # warm-cache provenance: a winner already raced on THIS chip
+        # (tune_key carries the platform component) is served without
+        # re-racing; the notice says which happened
+        warm = qtune.cached_param("wilson_eo_sharded_policy",
+                                  tuple(self.dims), aux=aux)
         won = qtune.tune(
             "wilson_eo_sharded_policy", tuple(self.dims), cands,
-            (uh, ub, psi0),
-            aux=f"v{self._pallas_version}|mesh{mesh_shape}|"
-                f"{jnp.dtype(self.store_dtype).name}")
+            (uh, ub, psi0), aux=aux)
         self._sharded_policy_winner = won
         # the winning candidate is already traced+compiled — seed the
         # hop cache with it so the first real application does not pay
@@ -451,7 +455,10 @@ class _PackedHopMixin:
         key = (target_parity,
                jnp.dtype(out_dtype or self.store_dtype).name)
         self.__dict__.setdefault("_sharded_fns", {})[key] = cands[won]
-        _notice_sharded_policy(self._pallas_version, won, True)
+        _notice_sharded_policy(
+            self._pallas_version, won,
+            "warm cache (chip-keyed tunecache)" if warm is not None
+            else "raced+cached (QUDA_TPU_SHARDED_POLICY=auto)")
         return won
 
     def _sharded_d_to(self, target_parity, out_dtype):

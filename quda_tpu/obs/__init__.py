@@ -20,6 +20,16 @@ home for that surface:
                         achieved-BW / %-of-demonstrated-peak rows per
                         kernel form, replacing hand arithmetic in the
                         bench harness and the round logs.
+* ``obs.history``     — committed BENCH_*/MULTICHIP_* artifacts parsed
+                        into canonical (metric, unit, platform, lattice,
+                        form, mesh) time series with best-credible
+                        (gate_row-passing) baselines and the trends.tsv
+                        table PERF.md cites.
+* ``obs.regress``     — the ``bench_suite --compare`` perf gate: diffs
+                        a run against the history baselines, fails
+                        loudly (rejection JSON rows + nonzero exit) on
+                        >tol throughput regression or solver-iteration
+                        inflation.
 """
 
-from . import convergence, roofline, trace  # noqa: F401
+from . import convergence, history, regress, roofline, trace  # noqa: F401

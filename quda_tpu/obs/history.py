@@ -1,0 +1,402 @@
+"""Bench-history layer: committed BENCH_*/MULTICHIP_* files as one
+canonical, gate-credible time series.
+
+PR 4 made every solve and bench row emit telemetry; this module is the
+half that CONSUMES it across runs (ROADMAP open item 5, the arXiv:
+1408.5925 cross-version performance-tracking discipline).  It parses
+every committed ``BENCH_*.json`` / ``MULTICHIP_*.json`` — the driver's
+per-round wrapper format ({"n", "rc", "tail", "parsed"}), bare bench.py
+records (BENCH_TPU_LAST.json), and raw bench_suite JSON-line streams —
+into canonical rows keyed by (metric, unit, platform, lattice, form,
+mesh), and computes the best-credible baseline per series from rows
+that pass ``bench.gate_row`` ONLY: round-5's 1.27e11-GFLOPS garbage can
+never become a baseline someone "regresses" against, and a CPU row can
+never set the bar for a TPU run (the PLQCD arXiv:1405.0700 lesson —
+perf state is only meaningful keyed to the hardware that measured it).
+
+Pure Python (no jax): tier-1 safe, and usable by the CI lint that keeps
+committed history consumable forever (tests/test_bench_json_lint.py).
+
+Consumers: ``obs.regress`` (the ``bench_suite --compare`` perf gate)
+and the trends.tsv table PERF.md rounds cite instead of hand-copied
+numbers.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# units where larger is better; everything else regresses upward
+THROUGHPUT_UNITS = ("gflops", "gbps", "msites_per_s")
+
+# suite-row fields that become canonical observations: (field, unit).
+# ordered — for the secs family only the FIRST present field is taken
+# (secs_per_call and secs are the same observable at different call
+# sites, and double-recording would duplicate the series)
+_VALUE_FIELDS = (("gflops", "gflops"), ("gbps", "gbps"),
+                 ("msites_per_s", "msites_per_s"), ("iters", "iters"))
+_SECS_FIELDS = (("secs_per_call", "secs"), ("secs", "secs"),
+                ("apply_secs", "apply_secs"))
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def _num(v) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def series_key(row: dict) -> tuple:
+    """Canonical identity of one time series: what must match for two
+    observations to be comparable across rounds."""
+    return (row["metric"], row["unit"], row["platform"],
+            row.get("lattice") or "", row.get("form") or "",
+            row.get("mesh") or "")
+
+
+def _fmt_list(v) -> str:
+    if isinstance(v, (list, tuple)):
+        return "x".join(str(x) for x in v)
+    return str(v) if v is not None else ""
+
+
+def _mk_row(metric, unit, value, platform, *, lattice=None, form=None,
+            mesh=None, suite="", source="", round_no=None, carried=False,
+            measured_at=None) -> dict:
+    return {"metric": metric, "unit": unit, "value": value,
+            "platform": platform, "lattice": _fmt_list(lattice),
+            "form": form or "", "mesh": _fmt_list(mesh), "suite": suite,
+            "source": source, "round": round_no, "carried": carried,
+            "measured_at": measured_at}
+
+
+def _gate(suite: str, row: dict) -> Tuple[bool, str]:
+    """bench.gate_row against the row's OWN platform banner: the secs
+    floor and roofline bounds still apply, and a row without a platform
+    fails the banner check — un-attributable rows are never credible.
+    (bench.py lives at the repo root next to the committed history; when
+    the package is imported without it, a minimal finite/positive check
+    stands in so the library layer stays importable.)"""
+    try:
+        import bench
+    except ImportError:
+        import math
+        for k in ("gflops", "gbps"):
+            v = row.get(k)
+            if v is not None and not (isinstance(v, (int, float))
+                                      and math.isfinite(v) and v >= 0):
+                return False, f"{k}={v!r} is not a finite throughput"
+        return bool(row.get("platform")), "no platform"
+    return bench.gate_row(suite, row,
+                          banner_platform=row.get("platform") or "?")
+
+
+def rows_from_record(rec: dict, source: str = "",
+                     round_no: Optional[int] = None,
+                     carried: bool = False,
+                     stats: Optional[dict] = None) -> List[dict]:
+    """Canonical rows from one bench.py headline record (including the
+    nested carried ``last_tpu`` measurement and the per-path GFLOPS
+    table).  Records without a ``platform`` are legacy (pre-gate
+    schema): counted, never recorded."""
+    stats = stats if stats is not None else {}
+    out: List[dict] = []
+    plat = rec.get("platform")
+    if not plat:
+        if _num(rec.get("value")):
+            stats["legacy"] = stats.get("legacy", 0) + 1
+        else:
+            stats["empty"] = stats.get("empty", 0) + 1
+    else:
+        lat = rec.get("lattice")
+        at = rec.get("measured_at")
+        v = _num(rec.get("value"))
+        if v is not None and v > 0:
+            cand = _mk_row(str(rec.get("metric",
+                                       "wilson_dslash_gflops_chip")),
+                           str(rec.get("unit", "GFLOPS")).lower(), v,
+                           plat, lattice=lat, form=rec.get("path"),
+                           suite="headline", source=source,
+                           round_no=round_no, carried=carried,
+                           measured_at=at)
+            ok, _ = _gate("dslash", {"name": cand["metric"],
+                                     "gflops": v, "platform": plat})
+            if ok:
+                out.append(cand)
+            else:
+                stats["ungated"] = stats.get("ungated", 0) + 1
+        for pname, pv in (rec.get("paths") or {}).items():
+            pv = _num(pv)
+            if pname.endswith("_error") or pv is None:
+                continue
+            ok, _ = _gate("dslash", {"name": pname, "gflops": pv,
+                                     "platform": plat})
+            if not ok:
+                stats["ungated"] = stats.get("ungated", 0) + 1
+                continue
+            out.append(_mk_row(f"dslash_path/{pname}", "gflops", pv,
+                               plat, lattice=lat, form=pname,
+                               suite="dslash", source=source,
+                               round_no=round_no, carried=carried,
+                               measured_at=at))
+    sub = rec.get("last_tpu")
+    if isinstance(sub, dict):
+        out.extend(rows_from_record(sub, source, round_no, carried=True,
+                                    stats=stats))
+    return out
+
+
+def rows_from_suite_row(row: dict, source: str = "",
+                        round_no: Optional[int] = None,
+                        stats: Optional[dict] = None) -> List[dict]:
+    """Canonical rows from one bench_suite JSON line.  Rejection/error/
+    skip rows are counted (they are part of the record, not data);
+    recorded rows must carry a platform and re-pass ``gate_row`` to
+    become baseline-eligible."""
+    stats = stats if stats is not None else {}
+
+    def bump(k):
+        stats[k] = stats.get(k, 0) + 1
+
+    if row.get("skipped"):
+        bump("skipped")
+        return []
+    if "rejected" in row:
+        bump("rejected")
+        return []
+    if "error" in row:
+        bump("error")
+        return []
+    suite, name = row.get("suite"), row.get("name")
+    if not suite or not name or suite == "harness":
+        bump("other")
+        return []
+    if not row.get("platform"):
+        bump("legacy")
+        return []
+    ok, _reason = _gate(suite, row)
+    if not ok:
+        bump("ungated")
+        return []
+    out = []
+    fields = list(_VALUE_FIELDS)
+    for f, u in _SECS_FIELDS:
+        if _num(row.get(f)) is not None:
+            fields.append((f, u))
+            break
+    for field, unit in fields:
+        v = _num(row.get(field))
+        if v is None:
+            continue
+        out.append(_mk_row(f"{suite}/{name}", unit, v, row["platform"],
+                           lattice=row.get("lattice"),
+                           form=row.get("form"), mesh=row.get("mesh"),
+                           suite=suite, source=source,
+                           round_no=round_no,
+                           measured_at=row.get("measured_at")))
+    if out:
+        bump("recorded")
+    return out
+
+
+def _json_objects_from_tail(tail: str) -> Iterable[dict]:
+    """JSON objects embedded in a captured-stdout tail: one per line,
+    tolerating log-prefix junk before the first '{' (the round-1 tail
+    carries a jax platform WARNING on the same stream)."""
+    for line in (tail or "").splitlines():
+        i = line.find("{")
+        if i < 0:
+            continue
+        try:
+            obj = json.loads(line[i:])
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            yield obj
+
+
+def _eat_obj(obj: dict, out: List[dict], source: str,
+             round_no: Optional[int], stats: dict):
+    if "suite" in obj:
+        out.extend(rows_from_suite_row(obj, source, round_no, stats))
+    elif "metric" in obj:
+        out.extend(rows_from_record(obj, source, round_no, stats=stats))
+    elif "tail" in obj or "parsed" in obj or "n_devices" in obj:
+        # driver wrapper (BENCH_rNN / MULTICHIP_rNN): rows live in the
+        # tail stream; "parsed" duplicates the tail's last JSON line,
+        # so it is only consulted when the tail yielded nothing (the
+        # History seen-set dedupes the overlap otherwise)
+        before = len(out)
+        for sub in _json_objects_from_tail(obj.get("tail") or ""):
+            _eat_obj(sub, out, source, round_no, stats)
+        parsed = obj.get("parsed")
+        if len(out) == before and isinstance(parsed, dict):
+            _eat_obj(parsed, out, source, round_no, stats)
+    else:
+        stats["other"] = stats.get("other", 0) + 1
+
+
+def parse_file(path: str) -> Tuple[List[dict], dict]:
+    """All canonical rows in one committed bench artifact, plus a stats
+    dict ({'recorded', 'legacy', 'ungated', 'rejected', 'error',
+    'skipped', 'empty', 'unparseable', ...}) describing what was seen
+    but not recorded."""
+    source = os.path.basename(path)
+    m = _ROUND_RE.search(source)
+    round_no = int(m.group(1)) if m else None
+    stats: dict = {}
+    out: List[dict] = []
+    try:
+        with open(path) as fh:
+            text = fh.read()
+    except OSError:
+        return [], {"unparseable": 1}
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict):
+        _eat_obj(doc, out, source, round_no, stats)
+    elif doc is None:
+        # JSON-lines stream (a bench_suite run teed to a file)
+        parsed_any = False
+        for obj in _json_objects_from_tail(text):
+            parsed_any = True
+            _eat_obj(obj, out, source, round_no, stats)
+        if not parsed_any:
+            stats["unparseable"] = stats.get("unparseable", 0) + 1
+    else:
+        stats["unparseable"] = stats.get("unparseable", 0) + 1
+    return out, stats
+
+
+class History:
+    """The canonical time series: series_key -> observations sorted by
+    round, with exact-duplicate suppression (the carried ``last_tpu``
+    record repeats verbatim across rounds until a fresh chip number
+    lands; the wrapper's ``parsed`` duplicates its tail line)."""
+
+    def __init__(self):
+        self.series: Dict[tuple, List[dict]] = {}
+        self.stats: dict = {}
+        self.files: List[str] = []
+        self._seen: set = set()
+
+    def add(self, row: dict):
+        key = series_key(row)
+        # carried rows (last_tpu) repeat verbatim across ROUNDS until a
+        # fresh measurement lands: their identity is the measurement
+        # itself, not the round that re-printed it
+        sig = (key, None if row.get("carried") else row.get("round"),
+               row["value"], row.get("measured_at"), row.get("carried"))
+        if sig in self._seen:
+            self.stats["duplicate"] = self.stats.get("duplicate", 0) + 1
+            return
+        self._seen.add(sig)
+        self.series.setdefault(key, []).append(row)
+
+    def without_round(self, round_no: int) -> "History":
+        """A copy of this history with one round's own (non-carried)
+        observations removed — the baseline the --latest dry mode diffs
+        that round against, built without re-parsing any files."""
+        h = History()
+        h.files = list(self.files)
+        h.stats = dict(self.stats)
+        for rows in self.series.values():
+            for r in rows:
+                if r.get("round") == round_no and not r.get("carried"):
+                    continue
+                h.add(r)
+        return h.finish()
+
+    def add_stats(self, stats: dict):
+        for k, v in stats.items():
+            self.stats[k] = self.stats.get(k, 0) + v
+
+    def finish(self):
+        for rows in self.series.values():
+            rows.sort(key=lambda r: (r.get("round") is not None,
+                                     r.get("round") or 0))
+        return self
+
+    def best(self, key: tuple) -> Optional[dict]:
+        """Best-credible observation for a series (gating already
+        happened at parse time): max for throughput units, min for
+        secs/iters — the baseline the compare gate diffs against."""
+        rows = self.series.get(key)
+        if not rows:
+            return None
+        if key[1] in THROUGHPUT_UNITS:
+            return max(rows, key=lambda r: r["value"])
+        return min(rows, key=lambda r: r["value"])
+
+    def latest(self, key: tuple) -> Optional[dict]:
+        rows = self.series.get(key)
+        return rows[-1] if rows else None
+
+    def max_round(self) -> Optional[int]:
+        rounds = [r.get("round") for rows in self.series.values()
+                  for r in rows if r.get("round") is not None]
+        return max(rounds) if rounds else None
+
+
+def history_files(dirpath: str) -> List[str]:
+    return sorted(glob.glob(os.path.join(dirpath, "BENCH_*.json"))
+                  + glob.glob(os.path.join(dirpath, "MULTICHIP_*.json")))
+
+
+def load_history(dirpath: str,
+                 exclude_rounds: Iterable[int] = ()) -> History:
+    """Parse every committed bench artifact under ``dirpath`` into one
+    History.  ``exclude_rounds`` drops whole rounds (the --latest dry
+    mode compares the newest round against the rest)."""
+    h = History()
+    excl = set(exclude_rounds)
+    for path in history_files(dirpath):
+        rows, stats = parse_file(path)
+        h.files.append(os.path.basename(path))
+        h.add_stats(stats)
+        for r in rows:
+            if r.get("round") in excl:
+                continue
+            h.add(r)
+    return h.finish()
+
+
+def trend_table(history: History,
+                current: Optional[List[dict]] = None) -> str:
+    """The TSV trend table PERF.md rounds cite instead of hand-copied
+    numbers: one line per series with its best-credible baseline, the
+    latest observation, and the compact per-round history."""
+    lines = ["metric\tunit\tplatform\tlattice\tform\tmesh\tn\t"
+             "best\tbest_src\tlatest\tlatest_src\tcurrent\thistory"]
+    cur_by_key: Dict[tuple, dict] = {}
+    for row in current or []:
+        cur_by_key[series_key(row)] = row
+    keys = set(history.series) | set(cur_by_key)
+    for key in sorted(keys, key=lambda k: tuple(str(x) for x in k)):
+        rows = history.series.get(key, [])
+        best = history.best(key)
+        latest = history.latest(key)
+        cur = cur_by_key.get(key)
+
+        def _src(r):
+            if r is None:
+                return ""
+            return (f"r{r['round']:02d}" if r.get("round") is not None
+                    else (r.get("source") or "?"))
+
+        hist = " ".join(f"{_src(r)}:{r['value']:g}" for r in rows)
+        metric, unit, platform, lattice, form, mesh = key
+        lines.append("\t".join([
+            metric, unit, platform, str(lattice), str(form), str(mesh),
+            str(len(rows)),
+            f"{best['value']:g}" if best else "", _src(best),
+            f"{latest['value']:g}" if latest else "", _src(latest),
+            f"{cur['value']:g}" if cur else "", hist]))
+    return "\n".join(lines) + "\n"

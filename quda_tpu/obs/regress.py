@@ -1,0 +1,247 @@
+"""Perf-regression gate over the committed bench history.
+
+The missing half of "measurement is part of the product" (ROADMAP open
+item 5): every bench row the repo ever committed is a baseline
+candidate (obs/history.py), and every new run is diffed against the
+best-credible baseline per (metric, unit, platform, lattice, form,
+mesh) series.  A current row more than ``tol`` below its throughput
+baseline — or a solver whose iteration count inflates past the same
+tolerance (a convergence regression hides easily inside a wall-time
+budget) — fails the gate LOUDLY: a rejection-style JSON row on stdout
+(the same grep surface as ``bench.record_row`` rejections) and a
+nonzero exit.  The regression discipline of "A Framework for Lattice
+QCD Calculations on GPUs" (arXiv:1408.5925), institutionalized.
+
+Entry points:
+* ``compare(current_rows, hist, ...)`` — the pure engine (tier-1 safe).
+* ``main(argv)``  — the CLI ``bench_suite.py --compare`` delegates to;
+  also runnable directly: ``python -m quda_tpu.obs.regress --latest``.
+
+Every invocation writes ``trends.tsv`` (under the resource path, else
+the history dir) so PERF.md rounds cite generated trend tables instead
+of hand-copied numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+from . import history as qhist
+
+
+def _conf(name):
+    from ..utils import config as qconf
+    return qconf.get(name, fresh=True)
+
+
+def default_history_dir() -> str:
+    """QUDA_TPU_BENCH_HISTORY_DIR, else the repo root (where the driver
+    commits BENCH_rNN.json / MULTICHIP_rNN.json)."""
+    d = _conf("QUDA_TPU_BENCH_HISTORY_DIR")
+    if d:
+        return d
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def compare(current_rows: List[dict], hist: qhist.History,
+            tol: Optional[float] = None,
+            iters_tol: Optional[float] = None) -> Tuple[int, List[dict]]:
+    """Diff canonical current rows against the history's best-credible
+    baselines.  Returns (n_failures, verdicts); each verdict dict
+    carries ``compare`` in {'ok', 'improved', 'regression',
+    'iteration_inflation', 'slowdown', 'no_baseline'} and failing ones
+    also carry a ``rejected`` reason string (record_row style)."""
+    tol = float(_conf("QUDA_TPU_BENCH_COMPARE_TOL")
+                if tol is None else tol)
+    iters_tol = float(_conf("QUDA_TPU_BENCH_COMPARE_ITERS_TOL")
+                      if iters_tol is None else iters_tol)
+    verdicts: List[dict] = []
+    failures = 0
+    for row in current_rows:
+        key = qhist.series_key(row)
+        base = hist.best(key)
+        v = {"compare": "ok", "metric": row["metric"],
+             "unit": row["unit"], "platform": row["platform"],
+             "lattice": row.get("lattice"), "form": row.get("form"),
+             "mesh": row.get("mesh"), "current": row["value"]}
+        if base is None:
+            v["compare"] = "no_baseline"
+            verdicts.append(v)
+            continue
+        bv = base["value"]
+        v["baseline"] = bv
+        v["baseline_source"] = base.get("source")
+        v["ratio"] = round(row["value"] / bv, 4) if bv else None
+        if row["unit"] in qhist.THROUGHPUT_UNITS:
+            lim = bv * (1.0 - tol)
+            if row["value"] < lim:
+                v["compare"] = "regression"
+                v["tol"] = tol
+                v["rejected"] = (
+                    f"throughput regression: {row['metric']} "
+                    f"[{row['unit']}] {row['value']:g} is "
+                    f"{(1 - row['value'] / bv) * 100:.1f}% below the "
+                    f"best-credible baseline {bv:g} "
+                    f"({base.get('source')}); tolerance {tol:.0%}")
+                failures += 1
+            elif row["value"] > bv:
+                v["compare"] = "improved"
+        elif row["unit"] == "iters":
+            lim = bv * (1.0 + iters_tol)
+            if row["value"] > lim:
+                v["compare"] = "iteration_inflation"
+                v["tol"] = iters_tol
+                v["rejected"] = (
+                    f"solver-iteration inflation: {row['metric']} took "
+                    f"{row['value']:g} iterations vs the baseline "
+                    f"{bv:g} ({base.get('source')}) — "
+                    f"{(row['value'] / bv - 1) * 100:.1f}% more; "
+                    f"tolerance {iters_tol:.0%}")
+                failures += 1
+            elif row["value"] < bv:
+                v["compare"] = "improved"
+        else:
+            # secs-family: slower-than-baseline is a slowdown, reported
+            # but NOT failing — wall-times on shared CI hosts are too
+            # noisy to gate on, and the throughput/iters gates already
+            # cover the attributable regressions
+            if row["value"] > bv * (1.0 + tol):
+                v["compare"] = "slowdown"
+            elif row["value"] < bv:
+                v["compare"] = "improved"
+        verdicts.append(v)
+    return failures, verdicts
+
+
+def canonicalize_recorded(recorded, stats: Optional[dict] = None
+                          ) -> List[dict]:
+    """(suite, row) pairs from bench.recorded_rows() -> canonical rows
+    for compare()."""
+    out: List[dict] = []
+    for suite, row in recorded:
+        out.extend(qhist.rows_from_suite_row(
+            dict(row, suite=suite), source="current", stats=stats))
+    return out
+
+
+def write_trends(hist: qhist.History, current: List[dict],
+                 path: Optional[str] = None) -> str:
+    """trends.tsv under the resource path (else the history dir):
+    the citable trend table."""
+    if not path:
+        base = (_conf("QUDA_TPU_RESOURCE_PATH")
+                or default_history_dir())
+        path = os.path.join(base, "trends.tsv")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write(qhist.trend_table(hist, current))
+    return path
+
+
+def run_compare(current_rows: List[dict], history_dir: str,
+                tol: Optional[float] = None,
+                iters_tol: Optional[float] = None,
+                trends_path: Optional[str] = None,
+                exclude_rounds=(), log=None,
+                hist: Optional[qhist.History] = None) -> int:
+    """The whole gate: load history (unless an already-built ``hist``
+    is passed), diff, print verdict JSON rows (failures carry
+    ``rejected``), write trends.tsv, return the exit code (number of
+    failing rows, capped at process-exit range)."""
+    if log is None:
+        log = lambda s: print(s, flush=True)
+    if hist is None:
+        hist = qhist.load_history(history_dir,
+                                  exclude_rounds=exclude_rounds)
+    failures, verdicts = compare(current_rows, hist, tol, iters_tol)
+    for v in verdicts:
+        if v["compare"] not in ("ok",):      # quiet on unremarkable rows
+            log(json.dumps(dict({"suite": "compare"}, **v)))
+    trends = write_trends(hist, current_rows, trends_path)
+    summary = {"suite": "compare", "history_files": len(hist.files),
+               "series": len(hist.series),
+               "current_rows": len(current_rows),
+               "failures": failures, "trends": trends,
+               "history_stats": hist.stats}
+    log(json.dumps(summary))
+    return min(failures, 120)
+
+
+def pop_opt(argv: List[str], flag: str, default=None):
+    """Pop ``--flag VALUE`` or ``--flag=VALUE`` from ``argv`` in place;
+    ``default`` when absent.  The ONE value-flag parser for this CLI
+    and bench_suite's passthrough — a flag with no value raises
+    ValueError instead of swallowing the next flag (or crashing)."""
+    if flag in argv:
+        i = argv.index(flag)
+        argv.pop(i)
+        if i >= len(argv) or argv[i].startswith("--"):
+            raise ValueError(f"{flag} needs a value")
+        return argv.pop(i)
+    for a in argv:
+        if a.startswith(flag + "="):
+            argv.remove(a)
+            return a.split("=", 1)[1]
+    return default
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI: ``python -m quda_tpu.obs.regress [--history DIR]
+    [--current FILE | --latest] [--tol X] [--iters-tol Y]
+    [--trends PATH]``.
+
+    --current FILE: canonical rows come from FILE (a driver wrapper, a
+      bare bench record, or a bench_suite JSON-lines stream).
+    --latest: the newest committed round plays "current" and is diffed
+      against the baseline built from every OTHER round — the dry mode
+      that gates already-committed history with zero measurements.
+    """
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    def _usage_error(msg: str) -> int:
+        print(json.dumps({"suite": "compare", "error": msg}),
+              flush=True)
+        return 2
+
+    try:
+        history_dir = pop_opt(argv, "--history") or default_history_dir()
+        current_file = pop_opt(argv, "--current")
+        tol = pop_opt(argv, "--tol")
+        iters_tol = pop_opt(argv, "--iters-tol")
+        trends_path = pop_opt(argv, "--trends")
+    except ValueError as e:
+        return _usage_error(str(e))
+    latest = "--latest" in argv
+    if latest:
+        argv.remove("--latest")
+    if argv:
+        return _usage_error(f"unknown arguments {argv}")
+    tol = float(tol) if tol is not None else None
+    iters_tol = float(iters_tol) if iters_tol is not None else None
+
+    hist = None
+    if current_file:
+        current_rows, stats = qhist.parse_file(current_file)
+        if stats.get("unparseable"):
+            return _usage_error(f"cannot parse {current_file}")
+    elif latest:
+        full = qhist.load_history(history_dir)
+        mr = full.max_round()
+        if mr is None:
+            return _usage_error(
+                f"no round-numbered history under {history_dir}")
+        current_rows = [r for rows in full.series.values() for r in rows
+                        if r.get("round") == mr and not r.get("carried")]
+        hist = full.without_round(mr)
+    else:
+        return _usage_error("need --current FILE or --latest")
+    return run_compare(current_rows, history_dir, tol, iters_tol,
+                       trends_path, hist=hist)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -265,6 +265,26 @@ for _n, _k, _d, _doc in (
          "0 disables them")):
     _register(_n, _k, _d, _doc, reference="tests/ benchmark CLI flags")
 
+# -- perf-regression gate (bench_suite --compare / obs.regress) --------------
+_register("QUDA_TPU_BENCH_COMPARE_TOL", "float", 0.10,
+          "throughput tolerance of the bench-history compare gate: a "
+          "current gflops/gbps row more than this fraction below its "
+          "best-credible committed baseline fails bench_suite "
+          "--compare with a rejection row and nonzero exit",
+          reference="cross-version perf tracking (arXiv:1408.5925 "
+                    "regression discipline)")
+_register("QUDA_TPU_BENCH_COMPARE_ITERS_TOL", "float", 0.10,
+          "solver-iteration tolerance of the compare gate: an iters "
+          "row more than this fraction ABOVE its baseline fails "
+          "(convergence regressions hide easily inside a wall-time "
+          "budget)",
+          reference="invert_test iteration-count reporting")
+_register("QUDA_TPU_BENCH_HISTORY_DIR", "str", "",
+          "directory holding the committed BENCH_*.json / "
+          "MULTICHIP_*.json history the compare gate baselines "
+          "against; empty = the repo root (next to bench.py)",
+          reference="QUDA_RESOURCE_PATH-style state directory")
+
 _register("QUDA_TPU_FORCE_CPU", "bool", False,
           "pin the CPU backend (and enable x64) in the embedded C-API "
           "interpreter", reference="QUDA_CPU_FIELD_LOCATION-style hosts")

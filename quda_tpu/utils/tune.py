@@ -1,28 +1,50 @@
-"""Autotuner with a persistent on-disk cache.
+"""Autotuner with a persistent, chip-keyed on-disk cache.
 
 Reference behavior: lib/tune.cpp (1167 LoC) + include/tune_quda.h — every
 kernel brute-force times its launch configurations once, caches the winner
 in $QUDA_RESOURCE_PATH/tunecache.tsv keyed by {volume, name, aux}, and
-doubles as the profiling system (profile_N.tsv).
+doubles as the profiling system (profile_N.tsv).  The reference cache also
+carries the hardware it was measured on and refuses to serve entries from
+a different device — a winner timed on one chip is NOISE on another.
 
 TPU analog: XLA already schedules fused kernels, so what remains tunable is
 the CHOICE among whole implementations (pure-XLA stencil vs Pallas kernel,
-Pallas block shapes, halo policies).  `tune` times jitted candidates
-(median of inner reps after warmup), persists winners to
+Pallas block shapes, halo policies, staggered kernel forms).  `tune` times
+jitted candidates (median of inner reps after warmup), persists winners to
 $QUDA_TPU_RESOURCE_PATH/tunecache.json, and records per-key call counts and
 timings for `save_profile`.
+
+Cache key schema (v2): ``platform|volume|name|aux`` where ``platform`` is
+:func:`platform_key` — backend, device kind, and visible device count — so
+a winner raced on CPU interpret is never silently reused on TPU (or vice
+versa), and a multi-host mesh does not serve a single-chip race.  Entries
+written by the pre-platform schema carry no ``platform`` field and are
+dropped at load with a one-time "stale schema, re-racing" notice (the
+QUDA_TUNE_VERSION_CHECK analog for the key layout itself).
+
+Warm start: :func:`warm_start` (called by ``init_quda``) re-loads the
+persistent cache under the current resource path and mirrors the load —
+entry counts, stale drops, platform — into the obs trace stream, so a
+fresh worker's first solve hits the raced winners of previous processes
+(policy races included: QUDA_TPU_SHARDED_POLICY / QUDA_TPU_STAGGERED_FORM
+auto-races go through `tune` and therefore through this store) without a
+compile/race storm, and the warm-start behavior is auditable in the
+chrome artifact next to the solves it accelerated.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import time
-from typing import Callable, Dict, Sequence, Tuple
+from typing import Callable, Dict, Optional, Sequence, Tuple
 
 _cache: Dict[str, dict] = {}
 _profile: Dict[str, dict] = {}
 _loaded_path = None
+_platform_key: Optional[str] = None
+_stale_noticed = False
 
 
 def _resource_path():
@@ -30,24 +52,90 @@ def _resource_path():
     return qconf.get("QUDA_TPU_RESOURCE_PATH", fresh=True)
 
 
+def platform_key() -> str:
+    """Stable id of the hardware this process races on: backend platform,
+    device kind, and visible device count (the mesh-capacity component),
+    e.g. ``tpu:TPU-v5-lite:n8`` or ``cpu:cpu:n1``.  Computed lazily (the
+    first call may initialise the jax backend) and cached per process;
+    '|' and whitespace are folded so the key splits cleanly."""
+    global _platform_key
+    if _platform_key is None:
+        try:
+            import jax
+            devs = jax.devices()
+            kind = str(getattr(devs[0], "device_kind", "")
+                       or devs[0].platform)
+            kind = re.sub(r"[\s|]+", "-", kind).strip("-")
+            _platform_key = f"{devs[0].platform}:{kind}:n{len(devs)}"
+        except Exception:
+            _platform_key = "unknown:unknown:n0"
+    return _platform_key
+
+
 def tune_key(name: str, volume, aux: str = "") -> str:
-    """TuneKey {volume, name, aux} analog (include/tune_key.h:56)."""
-    return f"{volume}|{name}|{aux}"
+    """TuneKey {volume, name, aux} analog (include/tune_key.h:56) with
+    the v2 platform/chip/mesh component prepended — see module docstring."""
+    return f"{platform_key()}|{volume}|{name}|{aux}"
 
 
-def load_cache():
+def cached_param(name: str, volume, aux: str = "") -> Optional[str]:
+    """The cached winner for this (platform, volume, name, aux), or None
+    when the race has not run on this hardware yet.  Lets call sites
+    report warm-cache-vs-raced provenance without a second race."""
+    e = _cache.get(tune_key(name, volume, aux))
+    return e.get("param") if isinstance(e, dict) else None
+
+
+def _notice_stale(n: int, path: str):
+    """One-time notice for pre-platform-schema entries: they are not
+    attributable to a chip, so they are invalidated (re-raced on first
+    use) rather than migrated into a key they were never measured under."""
+    global _stale_noticed
+    _obs_event("tune_cache_invalidated", count=n, path=path,
+               reason="stale schema: entry has no platform key")
+    if _stale_noticed:
+        return
+    _stale_noticed = True
+    try:
+        from . import logging as qlog
+        qlog.warningq(
+            f"tunecache {path}: dropped {n} entr"
+            f"{'y' if n == 1 else 'ies'} recorded under the pre-platform "
+            "key schema (not attributable to this chip); stale schema, "
+            "re-racing on first use")
+    except Exception:
+        pass
+
+
+def load_cache() -> Optional[dict]:
+    """Load tunecache.json under the current resource path into the
+    process cache.  Entries without a ``platform`` field (the pre-v2
+    un-keyed schema) are dropped with a one-time notice — a winner that
+    cannot name the hardware it was timed on must not be served.
+    Returns {'path', 'entries', 'stale'} stats (None when no resource
+    path is configured)."""
     global _loaded_path
     path = _resource_path()
     if not path:
-        return
+        return None
     f = os.path.join(path, "tunecache.json")
+    loaded = stale = 0
     if os.path.exists(f):
         try:
             with open(f) as fh:
-                _cache.update(json.load(fh))
+                raw = json.load(fh)
         except (json.JSONDecodeError, OSError):
-            pass
+            raw = {}
+        for k, v in raw.items():
+            if isinstance(v, dict) and v.get("platform"):
+                _cache[k] = v
+                loaded += 1
+            else:
+                stale += 1
+        if stale:
+            _notice_stale(stale, f)
     _loaded_path = f
+    return {"path": f, "entries": loaded, "stale": stale}
 
 
 def save_cache():
@@ -57,6 +145,22 @@ def save_cache():
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, "tunecache.json"), "w") as fh:
         json.dump(_cache, fh, indent=1, sort_keys=True)
+
+
+def warm_start() -> int:
+    """init_quda hook: (re)load the persistent cache so this process's
+    first solve serves already-raced (platform, volume, form) winners
+    with zero re-races, and mirror the load as a ``tune_cache_loaded``
+    trace event (counts + platform) so warm-start behavior is auditable
+    in the chrome artifact.  Returns the number of entries usable on
+    THIS hardware."""
+    stats = load_cache() or {"path": "", "entries": 0, "stale": 0}
+    here = platform_key()
+    usable = sum(1 for k in _cache if k.startswith(here + "|"))
+    _obs_event("tune_cache_loaded", path=stats["path"],
+               entries=len(_cache), usable_here=usable,
+               stale_dropped=stats["stale"], platform=here)
+    return usable
 
 
 def tuning_enabled() -> bool:
@@ -77,7 +181,7 @@ def _obs_event(name: str, **fields):
 
 def tune(name: str, volume, candidates: Dict[str, Callable], args: tuple,
          aux: str = "", reps: int = 3, inner: int = 5) -> str:
-    """Return the winning candidate key; time once, cache forever.
+    """Return the winning candidate key; time once per chip, cache forever.
 
     candidates: {param_string: jitted callable}; each is called as f(*args)
     and must return a jax array (block_until_ready used for timing).
@@ -116,7 +220,8 @@ def tune(name: str, volume, candidates: Dict[str, Callable], args: tuple,
             best, best_t = param, t
     if best is None:
         raise RuntimeError(f"no tuning candidate succeeded for {key}")
-    _cache[key] = {"param": best, "time": best_t}
+    _cache[key] = {"param": best, "time": best_t,
+                   "platform": platform_key()}
     _obs_event("tune_winner", key=key, param=best, seconds=best_t)
     save_cache()
     return best

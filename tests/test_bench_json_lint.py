@@ -1,0 +1,75 @@
+"""CI lint over the committed bench history: every BENCH_*.json /
+MULTICHIP_*.json the repo carries must stay consumable by the compare
+engine (obs/history.py) FOREVER — each file parses, every recorded row
+carries a platform and passed ``bench.gate_row``, and platform-less
+legacy rows are confined to a frozen allowlist of pre-gate rounds so no
+new round can quietly regress the history schema.
+
+Style of tests/test_env_knob_lint.py: a grep-level/static check with
+teeth, pure Python, tier-1 safe."""
+
+import math
+import os
+
+from quda_tpu.obs import history as qhist
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Rounds committed before the platform/gate schema existed.  FROZEN:
+# new files must never join this set — record rows through
+# bench.record_row (which stamps platform and gates) and they won't.
+LEGACY_OK = {"BENCH_r01.json"}
+
+
+def _files():
+    return qhist.history_files(REPO)
+
+
+def test_history_files_exist_and_parse():
+    files = _files()
+    assert files, "no committed BENCH_*/MULTICHIP_* history found"
+    for path in files:
+        rows, stats = qhist.parse_file(path)
+        assert not stats.get("unparseable"), (
+            f"{os.path.basename(path)} is not consumable by the "
+            "compare engine (obs/history.py)")
+
+
+def test_recorded_rows_are_platform_keyed_and_gated():
+    total = 0
+    for path in _files():
+        base = os.path.basename(path)
+        rows, stats = qhist.parse_file(path)
+        total += len(rows)
+        if base not in LEGACY_OK:
+            assert stats.get("legacy", 0) == 0, (
+                f"{base}: {stats['legacy']} recorded row(s) without a "
+                "platform — new rounds must record through "
+                "bench.record_row so history stays attributable; the "
+                "legacy allowlist is frozen")
+            assert stats.get("ungated", 0) == 0, (
+                f"{base}: {stats['ungated']} row(s) fail "
+                "bench.gate_row — impossible rates must die at record "
+                "time, never enter committed history")
+        for r in rows:
+            assert r["platform"], r
+            assert isinstance(r["value"], float)
+            assert math.isfinite(r["value"]) and r["value"] >= 0, r
+    assert total > 0, "committed history yields zero canonical rows"
+
+
+def test_history_yields_credible_baselines():
+    """The compare gate has something to stand on: at least one series
+    with a best-credible baseline exists in the committed history."""
+    hist = qhist.load_history(REPO)
+    assert hist.series
+    key = next(iter(sorted(hist.series, key=str)))
+    best = hist.best(key)
+    assert best is not None and best["value"] > 0
+
+
+def test_legacy_allowlist_is_not_growing():
+    """Every allowlisted file still exists (a stale allowlist entry
+    hides a rename that silently re-opens the legacy hole)."""
+    existing = {os.path.basename(p) for p in _files()}
+    assert LEGACY_OK <= existing

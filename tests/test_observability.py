@@ -287,6 +287,25 @@ def test_gated_bench_row_mirrors_into_trace(tmp_path):
     assert rows[0]["gflops"] == 1.0
 
 
+def test_rejected_bench_row_mirrors_into_trace(tmp_path):
+    """Gate failures are visible in the chrome artifact too: a refused
+    row lands in the stream as bench_row_rejected carrying the gate's
+    reason, not just in the text log."""
+    from bench import record_row
+    otr.start(str(tmp_path))
+    ok = record_row("blas", {"name": "bad_row", "gflops": 1.27e11,
+                             "secs_per_call": 1e-4, "platform": "tpu",
+                             "lattice": [4] * 4},
+                    banner_platform="tpu", log=lambda s: None)
+    assert not ok
+    paths = otr.stop()
+    lines = [json.loads(ln) for ln in open(paths["jsonl"])]
+    rej = [ln for ln in lines if ln.get("name") == "bench_row_rejected"]
+    assert rej and rej[0]["row_name"] == "bad_row"
+    assert "roofline" in rej[0]["rejected"]
+    assert not [ln for ln in lines if ln.get("name") == "bench_row"]
+
+
 def test_harvest_handles_dict_and_lane_histories():
     # synthetic results exercise the harvest shapes without a solver
     fake = types.SimpleNamespace(

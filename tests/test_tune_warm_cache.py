@@ -1,0 +1,141 @@
+"""Chip-keyed persistent tuner warm cache (utils/tune.py v2 schema):
+the platform/chip/mesh key component, stale un-keyed-entry
+invalidation with its one-time notice, cross-platform isolation (the
+CPU-interpret-poisons-TPU bug), warm_start's zero-re-race contract for
+a fresh process, and the trace-event audit trail through init_quda."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from quda_tpu.obs import trace as otr
+from quda_tpu.utils import config as qconf
+from quda_tpu.utils import tune
+
+
+@pytest.fixture(autouse=True)
+def _iso(monkeypatch):
+    """Fresh in-process cache + closed trace session around each test
+    (the module cache is process-global by design)."""
+    otr.stop(flush_files=False)
+    qconf.reset_cache()
+    monkeypatch.setattr(tune, "_cache", {})
+    monkeypatch.setattr(tune, "_stale_noticed", False)
+    yield
+    otr.stop(flush_files=False)
+    qconf.reset_cache()
+
+
+def test_platform_key_shape_and_stability():
+    k = tune.platform_key()
+    assert k == tune.platform_key()              # cached per process
+    parts = k.split(":")
+    assert len(parts) == 3 and parts[2].startswith("n")
+    assert "|" not in k and " " not in k         # splits cleanly
+
+
+def test_tune_key_carries_platform_component():
+    key = tune.tune_key("op", (4, 4), "aux")
+    assert key.startswith(tune.platform_key() + "|")
+    assert key.endswith("|(4, 4)|op|aux")
+
+
+def test_stale_unkeyed_entries_invalidated(tmp_path, monkeypatch,
+                                           capsys):
+    """Entries written by the pre-platform schema (tunecache poisoning
+    bug: a CPU-interpret winner silently served on TPU) are dropped at
+    load with a one-time 'stale schema, re-racing' notice, and the next
+    save purges them from disk."""
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    (tmp_path / "tunecache.json").write_text(json.dumps({
+        "(24, 24, 24, 24)|wilson_eo_sharded_policy|v2":
+            {"param": "fused_halo", "time": 0.001}}))
+    stats = tune.load_cache()
+    assert stats["stale"] == 1 and stats["entries"] == 0
+    assert tune._cache == {}
+    err = capsys.readouterr().err
+    assert "stale schema" in err and "re-racing" in err
+    tune.load_cache()                            # one-time notice only
+    assert "stale schema" not in capsys.readouterr().err
+    tune.save_cache()
+    assert json.loads((tmp_path / "tunecache.json").read_text()) == {}
+
+
+def test_other_platform_entry_is_not_served(tmp_path, monkeypatch):
+    """A winner raced on DIFFERENT hardware stays in the store (it is
+    valid there) but never satisfies this platform's lookup."""
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    alien = "tpu:TPU-v9:n4|(4, 4)|xplat_op|"
+    (tmp_path / "tunecache.json").write_text(json.dumps({
+        alien: {"param": "alien_win", "time": 1e-9,
+                "platform": "tpu:TPU-v9:n4"}}))
+    stats = tune.load_cache()
+    assert stats["entries"] == 1
+    assert tune.cached_param("xplat_op", (4, 4)) is None
+    x = jnp.ones((8, 8))
+    won = tune.tune("xplat_op", (4, 4),
+                    {"alien_win": jax.jit(lambda a: (a @ a) @ (a @ a)),
+                     "local": jax.jit(lambda a: a + 1.0)}, (x,))
+    # re-raced HERE; both the alien and the fresh local entry coexist
+    assert alien in tune._cache
+    local_key = tune.tune_key("xplat_op", (4, 4))
+    assert local_key in tune._cache and local_key != alien
+    assert tune._cache[local_key]["platform"] == tune.platform_key()
+    assert won == tune._cache[local_key]["param"]
+
+
+def test_warm_start_serves_with_zero_reraces(tmp_path, monkeypatch):
+    """The acceptance contract: a second process with a warmed resource
+    path emits tune_cache_loaded/tune_cached events and performs ZERO
+    re-races for already-keyed (platform, volume, form) entries —
+    candidates that would raise if timed prove it."""
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    x = jnp.ones((8, 8))
+    won = tune.tune("warm_op", (8, 8),
+                    {"slow": jax.jit(lambda a: (a @ a) @ (a @ a)),
+                     "fast": jax.jit(lambda a: a + 1.0)}, (x,), aux="k")
+    # ---- fresh-process simulation: empty in-memory cache ----
+    monkeypatch.setattr(tune, "_cache", {})
+    otr.start(str(tmp_path))
+    assert tune.warm_start() == 1
+
+    def boom(*a):
+        raise AssertionError("re-raced after warm start")
+
+    won2 = tune.tune("warm_op", (8, 8), {"slow": boom, "fast": boom},
+                     (x,), aux="k")
+    assert won2 == won
+    assert tune.cached_param("warm_op", (8, 8), aux="k") == won
+    paths = otr.stop()
+    lines = [json.loads(ln) for ln in open(paths["jsonl"])]
+    loaded = [ln for ln in lines if ln["name"] == "tune_cache_loaded"]
+    assert loaded and loaded[0]["usable_here"] == 1
+    assert loaded[0]["platform"] == tune.platform_key()
+    assert any(ln["name"] == "tune_cached" for ln in lines)
+
+
+def test_init_quda_preloads_warm_cache(tmp_path, monkeypatch):
+    """init_quda is the warm-start hook: the load event lands in the
+    QUDA_TPU_TRACE session and the first tune() after init is a cache
+    hit, not a race."""
+    from quda_tpu.interfaces.quda_api import end_quda, init_quda
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    monkeypatch.setenv("QUDA_TPU_TRACE", "1")
+    qconf.reset_cache()
+    x = jnp.ones((8, 8))
+    won = tune.tune("api_warm_op", (8, 8),
+                    {"fast": jax.jit(lambda a: a + 1.0)}, (x,))
+    monkeypatch.setattr(tune, "_cache", {})      # "new worker"
+    init_quda()
+
+    def boom(*a):
+        raise AssertionError("re-raced after init_quda warm start")
+
+    assert tune.tune("api_warm_op", (8, 8), {"fast": boom}, (x,)) == won
+    end_quda()
+    lines = [json.loads(ln) for ln in
+             open(tmp_path / "trace_events.jsonl")]
+    names = [ln["name"] for ln in lines]
+    assert "tune_cache_loaded" in names and "tune_cached" in names
