@@ -104,6 +104,11 @@ def gate_row(suite: str, row: dict, banner_platform: str = None):
         return False, (f"secs={secs!r} at/below the {MIN_MARGINAL_SECS:g}s "
                        "floor: a zero/negative marginal is noise, not a "
                        "measurement")
+    if row.get("converged") is False:
+        return False, ("unconverged solve: the row carries "
+                       "converged=False — a timing whose solve missed "
+                       "tol is not recordable throughput (quda_tpu/"
+                       "robust unconverged-flag contract)")
     lim = SUITE_ROOFLINES.get(suite, _DEFAULT_ROOFLINE)
     for key, unit in (("gflops", "GFLOPS"), ("gbps", "GB/s")):
         v = row.get(key)

@@ -119,6 +119,22 @@ class InvertParam:
     # cadence markers.  Empty on untraced solves (zero-overhead path).
     res_history: Sequence = ()
     events: Sequence = ()
+    # solve supervision (quda_tpu/robust): ``converged`` is ALWAYS
+    # maintained — a solve that exits at maxiter without meeting tol
+    # reports False (and warns once) instead of silently returning an
+    # unconverged answer; ``converged_multi`` is its per-RHS/per-shift
+    # form.  With QUDA_TPU_ROBUST != off, ``verified_res`` holds the
+    # true residual recomputed with the hi-precision XLA reference
+    # operator at the API boundary, ``solve_status`` classifies the
+    # exit ('converged' / 'unconverged' / 'breakdown:<reason>' /
+    # 'unverified' / 'degraded:<status>'), and ``solve_attempts``
+    # carries the escalation ladder's per-attempt provenance
+    # (robust/escalate.py).
+    converged: bool = True
+    converged_multi: Sequence = ()
+    verified_res: float = 0.0
+    solve_status: str = ""
+    solve_attempts: Sequence = ()
 
     def validate(self):
         _check(self.dslash_type in DSLASH_TYPES,

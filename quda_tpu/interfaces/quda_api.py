@@ -139,6 +139,35 @@ def load_gauge_quda(gauge, param: GaugeParam):
     g = jnp.asarray(gauge, dtype)
     if g.shape != (4,) + geom.lattice_shape + (3, 3):
         qlog.errorq(f"gauge shape {g.shape} != expected for {param.X}")
+    # gauge validation (robust/): a NaN link poisons every subsequent
+    # solve on this configuration, so reject non-finite input LOUDLY at
+    # the boundary; the fault site lets tests drill the rejection.
+    # Runs BEFORE the anisotropy fold — the unitarity screen must see
+    # the links as the user supplied them (folded spatial links are
+    # legitimately non-unitary)
+    from ..obs import trace as otr
+    from ..robust import faultinject as finj
+    g = finj.maybe_poison_gauge(g)
+    if not bool(jnp.all(jnp.isfinite(g))):
+        otr.event("gauge_rejected", cat="robust", reason="nonfinite",
+                  X=list(param.X))
+        qlog.errorq(
+            "load_gauge_quda: non-finite link values in the input "
+            "gauge field — rejected (a NaN link silently poisons every "
+            "subsequent solve); check the file/transfer and reload")
+    from ..utils import config as qconf
+    utol = float(qconf.get("QUDA_TPU_GAUGE_UNITARITY_TOL", fresh=True))
+    if utol > 0.0:
+        from ..ops.su3 import unitarity_deviation
+        dev = float(unitarity_deviation(g))
+        if dev > utol:
+            otr.event("gauge_unitarity", cat="robust", deviation=dev,
+                      tol=utol)
+            qlog.warningq(
+                f"load_gauge_quda: max unitarity deviation {dev:.2e} "
+                f"exceeds QUDA_TPU_GAUGE_UNITARITY_TOL={utol:g}; "
+                "repair with update_gauge_field_quda's reunitarize "
+                "(ops.su3.project_su3) or reload a clean configuration")
     if param.anisotropy != 1.0:
         # QUDA folds the Wilson anisotropy into the links at load time:
         # spatial links are divided by xi (GaugeFieldParam anisotropy)
@@ -493,6 +522,10 @@ def _invert_wilson_df64(b, param: InvertParam, d, sloppy_prec: str,
     # accounting note)
     sites = _ctx["geom"].volume // 2
     param.gflops = (param.iter_count * 2.0 * flops * sites) / 1e9
+    # param.true_res above is the df64 full-lattice residual — the
+    # deepest-precision verification this route can state
+    _solve_supervision(param, "invert_quda", res.converged,
+                       getattr(res, "breakdown", None))
     if recording:
         # the recorded curve is the normal-equation residual and the
         # solver ships its own |Mdag b|^2 in the history dict, which
@@ -504,6 +537,83 @@ def _invert_wilson_df64(b, param: InvertParam, d, sloppy_prec: str,
         f"invert_quda[wilson/cg/df64]: {param.iter_count} iters, "
         f"true_res {param.true_res:.2e}, {param.secs:.2f} s")
     return x_full
+
+
+def _solve_supervision(param, api: str, converged=None, breakdown=None,
+                       converged_multi=None):
+    """The verified-exit epilogue shared by every API solve.
+
+    ALWAYS (robust on or off): maintain ``param.converged`` (and
+    ``converged_multi``) from the solver's own convergence claim — a
+    solve that exits at maxiter without meeting tol is flagged and
+    warned about ONCE per (api, solver), never silently returned
+    (reference: invert_test reports per-solve convergence; a serving
+    fleet treats silence as success).  No new device ops: the flags are
+    host conversions of results every solver already computes.
+
+    With QUDA_TPU_ROBUST != off additionally record ``verified_res``
+    (the caller has already recomputed param.true_res against the
+    hi-precision reference operator at the API boundary — this is that
+    number, plus the fault-injection seam) and classify
+    ``solve_status``; breakdown/verification events land in the trace
+    stream (breakdown_detected / verify_mismatch)."""
+    import math
+
+    import numpy as np
+
+    from ..obs import trace as otr
+    from ..robust import faultinject as finj
+    from ..robust import sentinel as rsent
+    from ..utils import config as qconf
+
+    if converged_multi is not None:
+        param.converged_multi = [bool(c) for c in
+                                 np.asarray(converged_multi).reshape(-1)]
+        conv = all(param.converged_multi)
+    else:
+        conv = bool(np.asarray(jax.device_get(converged)).all())
+    param.converged = conv
+    bk = 0 if breakdown is None else int(np.asarray(breakdown))
+    if not conv and not bk:
+        qlog.warn_once(
+            f"unconverged:{api}:{param.inv_type}",
+            f"{api}[{param.dslash_type}/{param.inv_type}]: solve "
+            f"exited without meeting tol {param.tol:g} (achieved "
+            f"true_res {param.true_res:.2e}); InvertParam.converged="
+            "False — further occurrences are flagged silently on the "
+            "param")
+    if not rsent.active():
+        return
+    vres = finj.inflated_residual(float(param.true_res))
+    param.verified_res = vres
+    margin = float(qconf.get("QUDA_TPU_ROBUST_VERIFY_MARGIN",
+                             fresh=True))
+    if bk:
+        param.solve_status = f"breakdown:{rsent.reason(bk)}"
+        param.converged = False
+        otr.event("breakdown_detected", cat="robust", api=api,
+                  reason=rsent.reason(bk), solver=param.inv_type,
+                  iters=param.iter_count)
+        qlog.warn_once(
+            f"breakdown:{api}:{rsent.reason(bk)}",
+            f"{api}: breakdown sentinel tripped "
+            f"({rsent.reason(bk)}) after {param.iter_count} "
+            "iterations — clean exit, no NaN spin; see "
+            "InvertParam.solve_status")
+    elif not conv:
+        param.solve_status = "unconverged"
+    elif not (math.isfinite(vres) and vres <= margin * param.tol):
+        param.solve_status = "unverified"
+        param.converged = False
+        otr.event("verify_mismatch", cat="robust", api=api,
+                  verified_res=vres, tol=param.tol, margin=margin)
+        qlog.warn_once(
+            f"unverified:{api}",
+            f"{api}: solver claimed convergence but the recomputed "
+            f"true residual {vres:.2e} exceeds "
+            f"{margin:g} * tol — status 'unverified'")
+    else:
+        param.solve_status = "converged"
 
 
 def _solve_form(d) -> str:
@@ -556,13 +666,23 @@ def _solve_form(d) -> str:
 
 def invert_quda(source, param: InvertParam):
     """invertQuda: solve M x = b per param; returns x, mutates param
-    result fields (true_res, iter_count, secs, gflops; with
-    QUDA_TPU_TRACE also res_history/events — obs/convergence.py)."""
+    result fields (true_res, iter_count, secs, gflops, converged; with
+    QUDA_TPU_TRACE also res_history/events — obs/convergence.py; with
+    QUDA_TPU_ROBUST also verified_res/solve_status/solve_attempts —
+    quda_tpu/robust)."""
     _require_init()
     param.validate()
     from ..obs import trace as otr
+    from ..robust import escalate as resc
     with otr.api_span("invert_quda", dslash=param.dslash_type,
                       inv=param.inv_type, tol=param.tol):
+        if resc.enabled():
+            # QUDA_TPU_ROBUST=escalate: drive the attempt through the
+            # bounded retry ladder (robust/escalate.py) — breakdown,
+            # verification mismatch, or operator-construction failure
+            # escalates pallas -> XLA -> df64/BiCGStab
+            return resc.run_ladder(_invert_quda_body, source, param,
+                                   api="invert_quda")
         return _invert_quda_body(source, param)
 
 
@@ -788,6 +908,13 @@ def _invert_quda_body(source, param: InvertParam):
         sites = _ctx["geom"].volume // 2 if pc else _ctx["geom"].volume
         param.gflops = (param.iter_count * mv_applies * flops
                         * sites) / 1e9
+        # verified exit: param.true_res above IS the hi-precision XLA
+        # reference recomputation (d_full.M on the full lattice) — the
+        # supervision epilogue records it as verified_res and
+        # classifies the exit (robust/), and ALWAYS maintains
+        # param.converged + the one-time unconverged warning
+        _solve_supervision(param, "invert_quda", res.converged,
+                           getattr(res, "breakdown", None))
 
     from ..utils import timer as qtimer
     qtimer.add_flops(param.gflops * 1e9)
@@ -927,6 +1054,8 @@ def _invert_dispatch(param, d, d_full, b, rhs, sys_rhs, mv, mv_applies,
         else:
             r = b - d_full.M(x_full)
             param.true_res = float(jnp.sqrt(blas.norm2(r) / blas.norm2(b)))
+        _solve_supervision(param, "invert_quda", res.converged,
+                           getattr(res, "breakdown", None))
         return x_full
     else:
         qlog.errorq(f"inv_type {inv} not wired")
@@ -972,8 +1101,12 @@ def invert_multi_src_quda(sources, param: InvertParam):
     _require_init()
     param.validate()
     from ..obs import trace as otr
+    from ..robust import escalate as resc
     with otr.api_span("invert_multi_src_quda", dslash=param.dslash_type,
                       inv=param.inv_type, n_src=len(sources)):
+        if resc.enabled():
+            return resc.run_ladder(_invert_multi_src_body, sources,
+                                   param, api="invert_multi_src_quda")
         return _invert_multi_src_body(sources, param)
 
 
@@ -1031,12 +1164,29 @@ def _invert_multi_src_body(sources, param: InvertParam):
     else:
         flops_m = 2 * 1320 + 48
 
-    def _finish(x_full, iters_rhs, res_rhs, mv_applies):
+    def _finish(x_full, iters_rhs, res_rhs, mv_applies,
+                converged_rhs=None, breakdown=None):
+        import math
         param.iter_count_multi = [int(i) for i in iters_rhs]
         param.true_res_multi = [float(r) for r in res_rhs]
         param.iter_count = int(sum(param.iter_count_multi))
-        param.true_res = max(param.true_res_multi)
+        # np.max propagates a NaN lane into the headline (python max
+        # would silently skip it when NaN is not the last element)
+        param.true_res = float(np.max(np.asarray(param.true_res_multi)))
         param.secs = time.perf_counter() - t0
+        if converged_rhs is None:
+            # the route surfaced no per-lane convergence claim: the
+            # honest maxiter criterion (a lockstep solve that ran out
+            # of budget did NOT converge), plus a finiteness screen on
+            # the recomputed per-lane residual
+            converged_rhs = [int(i) < param.maxiter
+                             and math.isfinite(float(r))
+                             for i, r in zip(iters_rhs, res_rhs)]
+        # the per-RHS res_rhs above are recomputed with the full
+        # hi-precision operator (d_chk.M) — the verified exit
+        _solve_supervision(param, "invert_multi_src_quda",
+                           breakdown=breakdown,
+                           converged_multi=converged_rhs)
         flops = flops_m              # PC M cost (per updated site)
         sites = geom.volume // 2 if pc else geom.volume
         # per-RHS accounting, QUDA's per-source gflops convention.  The
@@ -1091,21 +1241,30 @@ def _invert_multi_src_body(sources, param: InvertParam):
             res = fused_cg(lambda v: d1.Mdag(d1.M(v)), nrm, tol=tol,
                            maxiter=maxiter)
             xe, xo = d1.reconstruct(res.x, be, bo)
-            return even_odd_join(xe, xo, geom), res.iters
+            # thread the solver's OWN convergence claim (and sentinel
+            # code) out of the vmapped lane: the maxiter heuristic
+            # cannot see a mid-solve breakdown exit, whose iters <
+            # maxiter would otherwise read as converged
+            return (even_odd_join(xe, xo, geom), res.iters,
+                    res.converged, res.breakdown)
 
         # pass the RAW resident gauge; each sub-grid folds the boundary
         # phase inside its own trace (DiracWilsonPC does it)
         with otr.phase("compute", "invert_multi_src_quda",
                        route="split_grid"):
-            x_full, iters = split_grid_solve(solve_one, _ctx["gauge"],
-                                             B, mesh)
+            x_full, iters, conv_l, bk_l = split_grid_solve(
+                solve_one, _ctx["gauge"], B, mesh)
         with otr.phase("epilogue", "invert_multi_src_quda"):
             d_chk = _build_dirac(param, False)
             res_rhs = [float(jnp.sqrt(blas.norm2(B[i]
                                                  - d_chk.M(x_full[i]))
                                       / blas.norm2(B[i])))
                        for i in range(n_src)]
-            return _finish(x_full, np.asarray(iters), res_rhs, 2.0)
+            bk = (None if bk_l is None
+                  else int(np.max(np.asarray(bk_l))))
+            return _finish(x_full, np.asarray(iters), res_rhs, 2.0,
+                           converged_rhs=np.asarray(conv_l),
+                           breakdown=bk)
 
     if mesh is None and batched_able:
         from ..solvers.block import (_per_rhs_dot, batched_cg_pairs,
@@ -1178,7 +1337,9 @@ def _invert_multi_src_body(sources, param: InvertParam):
                                                  - d_chk.M(x_full[i]))
                                       / blas.norm2(B[i])))
                        for i in range(n_src)]
-            x_out = _finish(x_full, iters_rhs, res_rhs, mv_applies)
+            x_out = _finish(x_full, iters_rhs, res_rhs, mv_applies,
+                            converged_rhs=conv,
+                            breakdown=getattr(res, "breakdown", None))
         if recording:
             # per-lane convergence histories (worst relative lane is
             # the headline; each lane normalized against its OWN b2)
@@ -1206,20 +1367,26 @@ def _invert_multi_src_body(sources, param: InvertParam):
     # generic fallback: per-source invert_quda loop (correct everywhere,
     # no gauge amortisation) — keeps the multi-source surface total
     import copy
-    xs, iters_rhs, res_rhs, gflops = [], [], [], 0.0
+    xs, iters_rhs, res_rhs, gflops, conv_rhs = [], [], [], 0.0, []
     for i in range(n_src):
         p_i = copy.copy(param)
         xs.append(invert_quda(B[i], p_i))
         iters_rhs.append(p_i.iter_count)
         res_rhs.append(p_i.true_res)
         gflops += p_i.gflops
+        conv_rhs.append(p_i.converged)
     x_full = jnp.stack(xs)
     param.iter_count_multi = list(iters_rhs)
     param.true_res_multi = [float(r) for r in res_rhs]
     param.iter_count = int(sum(iters_rhs))
-    param.true_res = max(param.true_res_multi)
+    param.true_res = float(np.max(np.asarray(param.true_res_multi)))
     param.secs = time.perf_counter() - t0
     param.gflops = gflops
+    # the inner invert_quda calls already ran their own supervision
+    # (and, under 'escalate', their own ladders) — roll their verdicts
+    # up onto the batch param
+    _solve_supervision(param, "invert_multi_src_quda",
+                       converged_multi=conv_rhs)
     qlog.printq(
         f"invert_multi_src_quda[{param.dslash_type}/{param.inv_type}] "
         f"(per-source fallback): {n_src} sources, iters "
@@ -1369,9 +1536,13 @@ def invert_multishift_quda(source, param: InvertParam):
     _require_init()
     param.validate()
     from ..obs import trace as otr
+    from ..robust import escalate as resc
     with otr.api_span("invert_multishift_quda",
                       dslash=param.dslash_type,
                       n_shifts=len(param.offset)):
+        if resc.enabled():
+            return resc.run_ladder(_invert_multishift_body, source,
+                                   param, api="invert_multishift_quda")
         return _invert_multishift_body(source, param)
 
 
@@ -1441,6 +1612,9 @@ def _invert_multishift_body(source, param: InvertParam):
                        + param.offset[0] * res.x[0].astype(jnp.float32))
         param.true_res = float(jnp.sqrt(blas.norm2(r0)
                                         / blas.norm2(rhs_pp)))
+        _solve_supervision(param, "invert_multishift_quda",
+                           breakdown=getattr(res, "breakdown", None),
+                           converged_multi=res.converged)
         return jnp.stack([ad.op._from_pairs(res.x[i], b.dtype)
                           for i in range(len(param.offset))])
 
@@ -1476,6 +1650,9 @@ def _invert_multishift_body(source, param: InvertParam):
                         + param.offset[0] * res.x[0].astype(jnp.float32))
         param.true_res = float(jnp.sqrt(blas.norm2(r0)
                                         / blas.norm2(nrm_rhs)))
+        _solve_supervision(param, "invert_multishift_quda",
+                           breakdown=getattr(res, "breakdown", None),
+                           converged_multi=res.converged)
         return jnp.stack([sl.solution_from_pairs(res.x[i], b.dtype)
                           for i in range(len(param.offset))])
 
@@ -1507,18 +1684,22 @@ def _invert_multishift_body(source, param: InvertParam):
                        "1e-4); per-shift precise refinement CGs follow "
                        "and are not recorded, so param.iter_count "
                        "exceeds this history's length")
-        xs, iters = [], int(res.iters)
+        xs, iters, conv_s = [], int(res.iters), []
         for i, s in enumerate(shifts):
             mv_s = (lambda sig: lambda v: mv(v) + sig * v)(s)
             ref = cg_solve(mv_s, rhs, x0=res.x[i].astype(rhs.dtype),
                            tol=param.tol, maxiter=param.maxiter)
             xs.append(ref.x)
             iters += int(ref.iters)
+            conv_s.append(bool(ref.converged))
         param.iter_count = iters
         param.secs = time.perf_counter() - t0
         _account()
         r0 = rhs - (mv(xs[0]) + shifts[0] * xs[0])
         param.true_res = float(jnp.sqrt(blas.norm2(r0) / blas.norm2(rhs)))
+        # convergence judged on the precise-level per-shift polish CGs
+        _solve_supervision(param, "invert_multishift_quda",
+                           converged_multi=conv_s)
         return jnp.stack(xs)
     with otr.phase("compute", "invert_multishift_quda"):
         res = multishift_cg(mv, rhs, shifts, tol=param.tol,
@@ -1529,6 +1710,9 @@ def _invert_multishift_body(source, param: InvertParam):
     _publish_multishift(res, rhs, param)
     r0 = rhs - (mv(res.x[0]) + shifts[0] * res.x[0])
     param.true_res = float(jnp.sqrt(blas.norm2(r0) / blas.norm2(rhs)))
+    _solve_supervision(param, "invert_multishift_quda",
+                       breakdown=getattr(res, "breakdown", None),
+                       converged_multi=res.converged)
     return res.x
 
 

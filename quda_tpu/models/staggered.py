@@ -227,6 +227,13 @@ class DiracStaggeredPCPairs:
             to_packed_pairs(spk.pack_links(g), store_dtype)
             for g in dpc.long_eo) if dpc.long_eo is not None else None)
         self.use_pallas = use_pallas
+        if use_pallas:
+            # pallas-construction fault seam (robust/faultinject.py) —
+            # the staggered construction-failure fallback: the
+            # escalation ladder catches this and re-solves on the XLA
+            # stencil form (same seam as models/wilson._setup_hop)
+            from ..robust import faultinject as finj
+            finj.maybe_raise("pallas_build")
         self._pallas_interpret = pallas_interpret
         self._fat_bw = self._long_bw = None
         improved = self.long_eo_pp is not None

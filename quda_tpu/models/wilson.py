@@ -256,6 +256,13 @@ class _PackedHopMixin:
         ``sharded_policy`` pins the mesh halo policy programmatically
         (else QUDA_TPU_SHARDED_POLICY decides; 'auto' races)."""
         from ..ops import wilson_packed as wpk
+        if use_pallas:
+            # pallas-construction fault seam (robust/faultinject.py):
+            # the pallas-compile / VMEM-budget / sharded-race failure
+            # class surfaces HERE, where the escalation ladder can
+            # catch it and fall back to the XLA stencil form
+            from ..robust import faultinject as finj
+            finj.maybe_raise("pallas_build")
         self.geom = geom
         self.dims = tuple(geom.lattice_shape)
         self.store_dtype = store_dtype

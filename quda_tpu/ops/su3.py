@@ -253,6 +253,17 @@ def inv_sqrt_herm3_pairs(h: jnp.ndarray) -> jnp.ndarray:
             + sc(d012) * mat_mul(h_l0, h_l1))
 
 
+def unitarity_deviation(u: jnp.ndarray) -> jnp.ndarray:
+    """max over links of max_ij |(U U^dag - I)_ij| — the load-time
+    unitarity screen (load_gauge_quda's QUDA_TPU_GAUGE_UNITARITY_TOL
+    gate).  A deviating-but-finite gauge can be repaired with
+    :func:`project_su3` (update_gauge_field_quda's reunitarize path);
+    this helper only measures, so the screen stays a warning."""
+    eye = jnp.eye(3, dtype=u.dtype)
+    d = jnp.einsum("...ab,...cb->...ac", u, jnp.conjugate(u)) - eye
+    return jnp.max(jnp.abs(d))
+
+
 def project_su3(u: jnp.ndarray, iters: int = 2) -> jnp.ndarray:
     """Project a near-SU(3) matrix back onto SU(3).
 

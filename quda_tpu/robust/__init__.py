@@ -1,0 +1,20 @@
+"""Solve supervision: breakdown sentinels, verified exits, an
+escalation ladder, and deterministic fault injection.
+
+The serving-fleet failure modes this subsystem closes (ROADMAP north
+star; the reference's production discipline per arXiv:1408.5925):
+
+* a solve NaN-spinning to maxiter          -> robust/sentinel.py
+* a silently-unconverged/wrong answer      -> verified exits
+  (interfaces/quda_api.py records verified_res + solve_status)
+* a worker crash on pallas construction    -> robust/escalate.py
+* all of the above untestable off-chip     -> robust/faultinject.py
+
+One knob drives it: ``QUDA_TPU_ROBUST`` in {off, verify, escalate}
+(utils/config.py).  'off' is the default and adds ZERO ops to the
+compiled solves (pinned by tests/test_robust.py raising stubs).
+"""
+
+from . import escalate, faultinject, sentinel  # noqa: F401
+
+__all__ = ["sentinel", "faultinject", "escalate"]

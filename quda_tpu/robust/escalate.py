@@ -1,0 +1,184 @@
+"""Bounded escalation ladder for API solves.
+
+Reference behavior: the reference's production posture is that a failing
+kernel candidate or an unconverged sloppy solve is an EVENT TO RECOVER
+FROM, not a crash — the autotuner skips throwing launches (lib/tune.cpp)
+and the mixed-precision solvers re-anchor on the precise operator when
+the sloppy system drifts (include/reliable_updates.h).  This module is
+the solve-level generalisation: when an attempt breaks down (sentinel),
+fails verification (verified exit), or cannot even construct its
+operator (pallas compile error, VMEM budget overflow, sharded-policy
+race crash), retry through a bounded, configurable ladder of
+progressively safer configurations:
+
+1. **as-requested** — whatever the knobs/param selected;
+2. **xla** — demote QUDA_TPU_PALLAS to '0': the XLA stencil form, no
+   hand-written kernels, no pallas construction;
+3. **df64-reliable** (Wilson CG) — force the extended-precision
+   reliable route (QUDA_TPU_DF64=1): the deepest-precision rung; or
+   **bicgstab** (other non-Hermitian families) — swap the solver.
+
+Knob demotion uses utils/config.py's scoped override stack, so a rung
+never mutates os.environ and the requested configuration is restored
+the moment the attempt exits.  Per-attempt provenance lands on
+``InvertParam.solve_attempts`` and the final ``solve_status``; every
+transition emits ``solve_retry`` / ``solve_degraded`` trace events
+(obs/trace.py) next to the solve spans they explain.
+
+Active only at ``QUDA_TPU_ROBUST=escalate``; at 'verify' the statuses
+are recorded but nothing retries; at 'off' this module is never called
+(invert_quda's dispatch bypasses it entirely).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, List
+
+from . import sentinel as rsent
+
+# InvertParam result fields an attempt produces and the winning attempt
+# must publish back onto the caller's param (x_df64_lo is set
+# dynamically by the df64 route, hence the getattr guard in _publish)
+_RESULT_FIELDS = ("true_res", "iter_count", "secs", "gflops",
+                  "true_res_multi", "iter_count_multi", "res_history",
+                  "events", "verified_res", "solve_status", "converged",
+                  "converged_multi", "x_df64_lo")
+
+
+def enabled() -> bool:
+    return rsent.mode() == "escalate"
+
+
+def ladder(param) -> List[dict]:
+    """The rung list for this solve: label + knob overrides (+ optional
+    solver swap), bounded by QUDA_TPU_ROBUST_MAX_RETRIES.  Rung 0 is
+    always the as-requested configuration."""
+    from ..utils import config as qconf
+    rungs = [{"label": "as-requested", "overrides": {}}]
+    # the XLA stencil form: no pallas kernels to construct or compile —
+    # the safe form for every operator family
+    rungs.append({"label": "xla",
+                  "overrides": {"QUDA_TPU_PALLAS": "0"}})
+    cg_family = param.inv_type in ("cg", "pcg", "cgnr", "cgne")
+    if (param.dslash_type == "wilson" and cg_family
+            and not param.num_offset):
+        # precision escalation: the df64 (float32-pair) reliable route —
+        # certifies the residual below the f32 floor with no pallas
+        rungs.append({"label": "df64-reliable",
+                      "overrides": {"QUDA_TPU_PALLAS": "0",
+                                    "QUDA_TPU_DF64": "1"}})
+    elif (cg_family and not param.num_offset
+          and param.dslash_type not in ("staggered", "asqtad", "hisq",
+                                        "laplace")):
+        # solver escalation for the non-Hermitian families: BiCGStab
+        # attacks the direct system with a different recurrence (the
+        # classic CG-breakdown fallback).  Multishift solves
+        # (num_offset) are excluded: their body has no per-inv_type
+        # dispatch, so the rung would re-run the identical solve under
+        # a false 'bicgstab' provenance
+        rungs.append({"label": "bicgstab",
+                      "overrides": {"QUDA_TPU_PALLAS": "0"},
+                      "inv_type": "bicgstab"})
+    cap = max(1, int(qconf.get("QUDA_TPU_ROBUST_MAX_RETRIES",
+                               fresh=True)))
+    return rungs[:cap]
+
+
+def _publish(param, attempt_param, attempts):
+    for f in _RESULT_FIELDS:
+        if hasattr(attempt_param, f):
+            setattr(param, f, getattr(attempt_param, f))
+    param.solve_attempts = list(attempts)
+
+
+def run_ladder(body: Callable, source, param, api: str = "invert_quda"):
+    """Drive ``body(source, param_copy)`` down the ladder until an
+    attempt verifies converged; publish the winner (or the best failed
+    attempt, status 'degraded') onto ``param``.  Construction/compile
+    exceptions fail the attempt; if EVERY rung raised, the last
+    exception propagates (there is no solution to degrade to)."""
+    from ..obs import trace as otr
+    from ..utils import config as qconf
+    from ..utils import logging as qlog
+
+    import math
+
+    rungs = ladder(param)
+    attempts: List[dict] = []
+    # best completed-but-unconverged attempt so far, scored by the
+    # VERIFIED residual (smaller wins; non-finite scores worst) — the
+    # exhausted-ladder path must publish the best effort, not simply
+    # the last rung tried
+    best = None          # (score, rung_label, x, attempt_param)
+    last_exc = None
+    for i, rung in enumerate(rungs):
+        p_i = copy.copy(param)
+        p_i.solve_attempts = ()
+        if rung.get("inv_type"):
+            p_i.inv_type = rung["inv_type"]
+        try:
+            with qconf.overrides(**rung["overrides"]):
+                x = body(source, p_i)
+        except Exception as e:      # noqa: BLE001 — construction class
+            last_exc = e
+            attempts.append({"attempt": i, "rung": rung["label"],
+                             "status":
+                                 f"construct_error:{type(e).__name__}",
+                             "error": str(e)[:200]})
+            if i + 1 < len(rungs):
+                otr.event("solve_retry", cat="robust", api=api,
+                          from_rung=rung["label"],
+                          to_rung=rungs[i + 1]["label"],
+                          reason=f"construct_error:{type(e).__name__}")
+                qlog.warningq(
+                    f"{api}: attempt {i} ({rung['label']}) failed to "
+                    f"construct ({type(e).__name__}: {str(e)[:120]}); "
+                    f"escalating to {rungs[i + 1]['label']}")
+            continue
+        status = p_i.solve_status or ("converged" if p_i.converged
+                                      else "unconverged")
+        attempts.append({"attempt": i, "rung": rung["label"],
+                         "status": status, "iters": p_i.iter_count,
+                         "verified_res": p_i.verified_res})
+        score = (p_i.verified_res
+                 if math.isfinite(p_i.verified_res or float("nan"))
+                 else float("inf"))
+        if best is None or score < best[0]:
+            best = (score, rung["label"], x, p_i)
+        if status == "converged":
+            _publish(param, p_i, attempts)
+            if i > 0:
+                # served from a fallback rung: the request is answered
+                # but the configured fast path is not — say so
+                otr.event("solve_degraded", cat="robust", api=api,
+                          rung=rung["label"], attempts=i + 1,
+                          status=status)
+                qlog.warningq(
+                    f"{api}: served from escalation rung "
+                    f"'{rung['label']}' after {i} failed attempt(s) "
+                    "(see InvertParam.solve_attempts)")
+            return x
+        if i + 1 < len(rungs):
+            otr.event("solve_retry", cat="robust", api=api,
+                      from_rung=rung["label"],
+                      to_rung=rungs[i + 1]["label"], reason=status)
+            qlog.warningq(
+                f"{api}: attempt {i} ({rung['label']}) exited "
+                f"{status}; escalating to {rungs[i + 1]['label']}")
+    if best is None:
+        param.solve_attempts = list(attempts)
+        param.solve_status = "failed"
+        raise last_exc
+    _, best_rung, x, p_i = best
+    _publish(param, p_i, attempts)
+    param.solve_status = f"degraded:{p_i.solve_status}"
+    param.converged = False
+    otr.event("solve_degraded", cat="robust", api=api, rung=best_rung,
+              attempts=len(attempts), status=param.solve_status)
+    qlog.warningq(
+        f"{api}: escalation ladder exhausted ({len(attempts)} "
+        f"attempts); returning the best effort (rung '{best_rung}') "
+        f"with status {param.solve_status} — see "
+        "InvertParam.solve_attempts")
+    return x

@@ -1,0 +1,179 @@
+"""Deterministic fault injection: every robustness path testable on CPU.
+
+A breakdown sentinel, verified exit, or escalation rung that is only
+exercised when a real chip corrupts a solve is dead code until the worst
+possible moment.  This registry arms deterministic faults at the exact
+seams the robust/ subsystem guards, so tests/test_robust.py drives every
+recovery path end to end on the CPU backend — the QUDA analog is the
+autotuner surviving failing kernel candidates by construction, not by
+hoping (lib/tune.cpp skips throwing launches).
+
+Sites (``QUDA_TPU_FAULT=<site>:<trigger>[,<site>:<trigger>...]`` or the
+programmatic :func:`arm`):
+
+* ``dslash:<k>``       — poison the operator-apply output at iteration k
+                         of the next solve (the mid-solve SDC / NaN-spin
+                         scenario; consumed at solver trace time);
+* ``gauge:<1>``        — poison one link of the next load_gauge_quda
+                         input (exercises the gauge-load validation);
+* ``pallas_build:<n>`` — raise InjectedFault from the next n pallas
+                         operator constructions (the pallas-compile /
+                         VMEM-budget / sharded-race failure class);
+* ``residual:<f>``     — inflate the next verified residual by factor f
+                         (the verification-mismatch escalation trigger).
+
+Every arm is ONE-SHOT (``pallas_build`` counts down its n): after firing
+it disarms, so an escalation retry sees a healthy system — transient
+faults are the scenario the ladder exists for.  Firings are recorded
+(:func:`fired`) and mirrored as ``fault_injected`` trace events so a
+drill is auditable in the chrome artifact.
+
+Zero-overhead: with nothing armed every probe is a dict lookup on an
+empty dict — no jax ops are ever built.  NEVER set QUDA_TPU_FAULT in
+production.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+SITES = ("dslash", "gauge", "pallas_build", "residual")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed construction-site fault (pallas_build)."""
+
+
+_armed: dict = {}
+_fired: List[dict] = []
+_env_parsed = False
+
+
+def _ensure_env():
+    """Parse QUDA_TPU_FAULT once per reset (one-shot consumption is
+    stateful; re-parsing per probe would re-arm consumed faults)."""
+    global _env_parsed
+    if _env_parsed:
+        return
+    _env_parsed = True
+    from ..utils import config as qconf
+    spec = str(qconf.get("QUDA_TPU_FAULT", fresh=True))
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, trig = part.partition(":")
+        arm(site.strip(), trig.strip() or "1")
+
+
+def arm(site: str, trigger: str = "1"):
+    """Arm one site programmatically (tests).  Unknown sites raise —
+    a typoed fault spec silently doing nothing would defeat the drill."""
+    if site not in SITES:
+        raise ValueError(f"unknown fault site {site!r}; known: {SITES}")
+    _armed[site] = str(trigger)
+
+
+def reset():
+    """Disarm everything and forget firings (test isolation).  The env
+    spec re-parses on the next probe."""
+    global _env_parsed
+    _armed.clear()
+    _fired.clear()
+    _env_parsed = False
+
+
+def armed(site: str) -> Optional[str]:
+    _ensure_env()
+    return _armed.get(site)
+
+
+def fired(site: Optional[str] = None) -> List[dict]:
+    """Record of fired faults (for test assertions)."""
+    if site is None:
+        return list(_fired)
+    return [f for f in _fired if f["site"] == site]
+
+
+def _record(site: str, trigger: str):
+    _fired.append({"site": site, "trigger": trigger})
+    try:
+        from ..obs import trace as otr
+        otr.event("fault_injected", cat="robust", site=site,
+                  trigger=trigger)
+    except Exception:
+        pass
+
+
+def iteration_fault(site: str = "dslash") -> Optional[int]:
+    """Consume an iteration-indexed arm at solver TRACE time: returns
+    the target iteration k (and disarms) when the site is armed, else
+    None.  The solver bakes :func:`corrupt` into this attempt's
+    computation; the next attempt traces clean — the one-shot transient
+    semantics the escalation ladder recovers from."""
+    if not _armed and _env_parsed:
+        return None
+    _ensure_env()
+    trig = _armed.pop(site, None)
+    if trig is None:
+        return None
+    k = int(float(trig))
+    _record(site, trig)
+    return k
+
+
+def corrupt(x, k, k_fault: int):
+    """Traced poison: the whole array goes NaN when the loop counter k
+    equals the armed iteration (jnp.where on a scalar predicate — the
+    deterministic, compiled form of a mid-solve SDC)."""
+    import jax.numpy as jnp
+    bad = jnp.full_like(x, float("nan"))
+    return jnp.where(jnp.equal(jnp.asarray(k, jnp.int32),
+                               jnp.int32(k_fault)), bad, x)
+
+
+def maybe_raise(site: str = "pallas_build"):
+    """Raise InjectedFault if the construction site is armed; the
+    trigger is a countdown (``pallas_build:2`` raises twice)."""
+    if not _armed and _env_parsed:
+        return
+    _ensure_env()
+    trig = _armed.get(site)
+    if trig is None:
+        return
+    n = int(float(trig))
+    if n <= 1:
+        _armed.pop(site, None)
+    else:
+        _armed[site] = str(n - 1)
+    _record(site, trig)
+    raise InjectedFault(
+        f"injected {site} failure (QUDA_TPU_FAULT drill)")
+
+
+def maybe_poison_gauge(g):
+    """One-shot link poison for the gauge-load validation drill: sets
+    the (0,0,...,0) matrix entry of the first direction to NaN."""
+    if not _armed and _env_parsed:
+        return g
+    _ensure_env()
+    trig = _armed.pop("gauge", None)
+    if trig is None:
+        return g
+    _record("gauge", trig)
+    idx = (0,) * (g.ndim - 2) + (0, 0)
+    return g.at[idx].set(float("nan"))
+
+
+def inflated_residual(value: float, site: str = "residual") -> float:
+    """One-shot verified-residual inflation (host-side float) — makes
+    the verification step disagree with the solver's own convergence
+    claim, driving the 'unverified' escalation path."""
+    if not _armed and _env_parsed:
+        return value
+    _ensure_env()
+    trig = _armed.pop(site, None)
+    if trig is None:
+        return value
+    _record(site, trig)
+    return float(value) * float(trig)

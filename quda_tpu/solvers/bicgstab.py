@@ -25,6 +25,10 @@ from .cg import SolverResult
 def bicgstab(matvec: Callable, b: jnp.ndarray,
              x0: Optional[jnp.ndarray] = None, tol: float = 1e-10,
              maxiter: int = 2000, record: bool = False) -> SolverResult:
+    from ..robust import faultinject as finj
+    from ..robust import sentinel as rsent
+    sent = rsent.make()
+    fault_k = finj.iteration_fault("dslash")
     b2 = blas.norm2(b)
     stop = (tol ** 2) * b2
     x = jnp.zeros_like(b) if x0 is None else x0
@@ -39,15 +43,22 @@ def bicgstab(matvec: Callable, b: jnp.ndarray,
     if record:
         state["hist"] = jnp.full((maxiter + 1,), jnp.nan,
                                  state["r2"].dtype)
+    if sent is not None:
+        state["sent"] = sent.init(state["r2"])
 
     def cond(c):
-        return jnp.logical_and(c["r2"] > stop, c["k"] < maxiter)
+        go = jnp.logical_and(c["r2"] > stop, c["k"] < maxiter)
+        if sent is not None:
+            go = jnp.logical_and(go, sent.ok(c["sent"]))
+        return go
 
     def body(c):
         rho_new = blas.cdot(rhat, c["r"])
         beta = (rho_new / c["rho"]) * (c["alpha"] / c["omega"])
         p = c["r"] + beta * (c["p"] - c["omega"] * c["v"])
         v = matvec(p)
+        if fault_k is not None:
+            v = finj.corrupt(v, c["k"], fault_k)
         alpha = rho_new / blas.cdot(rhat, v)
         s = c["r"] - alpha * v
         t = matvec(s)
@@ -59,11 +70,17 @@ def bicgstab(matvec: Callable, b: jnp.ndarray,
                    omega=omega, r2=blas.norm2(r), k=c["k"] + 1)
         if record:
             nxt["hist"] = c["hist"].at[c["k"]].set(nxt["r2"])
+        if sent is not None:
+            # rho/omega breakdown surfaces as a non-finite r2 within an
+            # iteration — the finiteness predicate catches both
+            nxt["sent"] = sent.step(c["sent"], nxt["r2"])
         return nxt
 
     out = jax.lax.while_loop(cond, body, state)
-    return SolverResult(out["x"], out["k"], out["r2"], out["r2"] <= stop,
-                        out["hist"] if record else None)
+    conv, bk = rsent.finalize(sent, out.get("sent"),
+                              out["r2"] <= stop)
+    return SolverResult(out["x"], out["k"], out["r2"], conv,
+                        out["hist"] if record else None, bk)
 
 
 def bicgstab_l(matvec: Callable, b: jnp.ndarray, L: int = 4,
@@ -72,6 +89,8 @@ def bicgstab_l(matvec: Callable, b: jnp.ndarray, L: int = 4,
     """BiCGStab(L); maxiter counts matvec applications (2L per cycle).
     ``record=True`` captures |r|^2 once per cycle (cadence 2L in the
     harvested history — each cycle IS 2L matvec applications)."""
+    from ..robust import sentinel as rsent
+    sent = rsent.make()
     b2 = blas.norm2(b)
     stop = (tol ** 2) * b2
     x = jnp.zeros_like(b) if x0 is None else x0
@@ -90,9 +109,14 @@ def bicgstab_l(matvec: Callable, b: jnp.ndarray, L: int = 4,
     if record:
         state["hist"] = jnp.full((maxiter // (2 * L) + 2,), jnp.nan,
                                  rdt)
+    if sent is not None:
+        state["sent"] = sent.init(state["r2"])
 
     def cond(c):
-        return jnp.logical_and(c["r2"] > stop, c["k"] < maxiter)
+        go = jnp.logical_and(c["r2"] > stop, c["k"] < maxiter)
+        if sent is not None:
+            go = jnp.logical_and(go, sent.ok(c["sent"]))
+        return go
 
     def body(c):
         x, r, u = c["x"], c["r"], c["u"]
@@ -127,8 +151,12 @@ def bicgstab_l(matvec: Callable, b: jnp.ndarray, L: int = 4,
                    r2=blas.norm2(rnew), k=c["k"] + 2 * L)
         if record:
             nxt["hist"] = c["hist"].at[c["k"] // (2 * L)].set(nxt["r2"])
+        if sent is not None:
+            nxt["sent"] = sent.step(c["sent"], nxt["r2"])
         return nxt
 
     out = jax.lax.while_loop(cond, body, state)
-    return SolverResult(out["x"], out["k"], out["r2"], out["r2"] <= stop,
-                        out["hist"] if record else None)
+    conv, bk = rsent.finalize(sent, out.get("sent"),
+                              out["r2"] <= stop)
+    return SolverResult(out["x"], out["k"], out["r2"], conv,
+                        out["hist"] if record else None, bk)

@@ -54,6 +54,9 @@ class BlockCGResult(NamedTuple):
     converged: jnp.ndarray   # (nrhs,)
     # optional (slots, nrhs) per-iteration |r|^2 lanes (record=True)
     history: object = None
+    # optional typed breakdown code (robust/sentinel.py; None on
+    # unguarded solves — see solvers/cg.SolverResult.breakdown)
+    breakdown: object = None
 
 
 def block_cg(matvec: Callable, B: jnp.ndarray, tol: float = 1e-10,
@@ -125,6 +128,9 @@ class BatchedCGResult(NamedTuple):
     converged: jnp.ndarray   # (nrhs,)
     # optional (slots, nrhs) per-check-point |r|^2 lanes (record=True)
     history: object = None
+    # optional typed breakdown code (robust/sentinel.py; None on
+    # unguarded solves — see solvers/cg.SolverResult.breakdown)
+    breakdown: object = None
 
 
 def _per_rhs_dot(u: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
@@ -155,10 +161,14 @@ def batched_cg_pairs(matvec_batch: Callable, B: jnp.ndarray,
     batched_cg's vmap), and ``iters`` records each RHS's first cadence
     boundary at convergence (unconverged lanes report the total).
     """
+    from ..robust import faultinject as finj
+    from ..robust import sentinel as rsent
     from .fused_iter import _resolve_check_every
     n = B.shape[0]
     _check_nrhs(n)
     check_every = _resolve_check_every(check_every)
+    sent = rsent.make()
+    fault_k = finj.iteration_fault("dslash")
     rdt = jnp.float32 if B.dtype == jnp.bfloat16 else B.dtype
     b2 = _per_rhs_dot(B.astype(rdt), B.astype(rdt))
     stop = (tol ** 2) * b2
@@ -169,8 +179,10 @@ def batched_cg_pairs(matvec_batch: Callable, B: jnp.ndarray,
     p = B
     rz = b2
 
-    def one_iter(x, r, p, rz):
+    def one_iter(x, r, p, rz, k):
         Ap = matvec_batch(p)
+        if fault_k is not None:
+            Ap = finj.corrupt(Ap, k, fault_k)
         pAp = _per_rhs_dot(p.astype(rdt), Ap.astype(rdt))
         alpha = rz / jnp.maximum(pAp, tiny)
         a = _bcast(alpha, x).astype(x.dtype)
@@ -179,33 +191,48 @@ def batched_cg_pairs(matvec_batch: Callable, B: jnp.ndarray,
         r2 = _per_rhs_dot(r.astype(rdt), r.astype(rdt))
         beta = r2 / jnp.maximum(rz, tiny)
         p = r + _bcast(beta, p).astype(p.dtype) * p
-        return x, r, p, r2
+        return x, r, p, r2, pAp
 
     def cond(carry):
         rz, k = carry[3], carry[4]
-        return jnp.logical_and(jnp.any(rz > stop), k < maxiter)
+        go = jnp.logical_and(jnp.any(rz > stop), k < maxiter)
+        if sent is not None:
+            go = jnp.logical_and(go, sent.ok(carry[-1]))
+        return go
 
     def body(carry):
         x, r, p, rz, k, it_conv = carry[:6]
-        for _ in range(check_every):
-            x, r, p, rz = one_iter(x, r, p, rz)
+        pAp = None
+        for j in range(check_every):
+            x, r, p, rz, pAp = one_iter(x, r, p, rz, k + j)
         k_new = k + check_every
         it_conv = jnp.where((it_conv < 0) & (rz <= stop), k_new, it_conv)
+        out = (x, r, p, rz, k_new, it_conv)
         if record:
-            hist = carry[6].at[k // check_every].set(rz)
-            return (x, r, p, rz, k_new, it_conv, hist)
-        return (x, r, p, rz, k_new, it_conv)
+            out = out + (carry[6].at[k // check_every].set(rz),)
+        if sent is not None:
+            # aggregate lanes into one scalar per predicate: the sum
+            # propagates any lane's NaN, the min pivot flags any
+            # non-HPD lane
+            out = out + (sent.step(carry[-1], jnp.sum(rz),
+                                   denom=jnp.min(pAp)),)
+        return out
 
     it_conv0 = jnp.full((n,), -1, jnp.int32)
     init = (x, r, p, rz, jnp.int32(0), it_conv0)
     if record:
         slots = maxiter // check_every + 2
         init = init + (jnp.full((slots, n), jnp.nan, rdt),)
+    if sent is not None:
+        init = init + (sent.init(jnp.sum(b2)),)
     out = jax.lax.while_loop(cond, body, init)
     x, r, p, rz, k, it_conv = out[:6]
     it_conv = jnp.where(it_conv < 0, k, it_conv)
-    return BatchedCGResult(x, it_conv, rz, rz <= stop,
-                           out[6] if record else None)
+    conv, bk = rsent.finalize(sent,
+                              out[-1] if sent is not None else None,
+                              rz <= stop)
+    return BatchedCGResult(x, it_conv, rz, conv,
+                           out[6] if record else None, bk)
 
 
 def block_cg_pairs(matvec_batch: Callable, B: jnp.ndarray,
@@ -229,8 +256,10 @@ def block_cg_pairs(matvec_batch: Callable, B: jnp.ndarray,
     unconverged (never garbage-as-success); dedupe the batch or use
     batched_cg_pairs (independent lanes are immune) for such inputs.
     """
+    from ..robust import sentinel as rsent
     n = B.shape[0]
     _check_nrhs(n)
+    sent = rsent.make()
     rdt = jnp.float32 if B.dtype == jnp.bfloat16 else B.dtype
     b2 = _per_rhs_dot(B.astype(rdt), B.astype(rdt))
     stop = (tol ** 2) * b2
@@ -252,10 +281,15 @@ def block_cg_pairs(matvec_batch: Callable, B: jnp.ndarray,
     def cond(c):
         # the finiteness guard turns a Gram-breakdown NaN into a clean
         # exit with converged=False instead of silent NaN solutions
-        return jnp.logical_and(
+        # (always on — it predates the opt-in sentinel and stays as the
+        # last line of defense at QUDA_TPU_ROBUST=off)
+        go = jnp.logical_and(
             jnp.logical_and(jnp.any(c["r2"] > stop),
                             jnp.all(jnp.isfinite(c["r2"]))),
             c["k"] < maxiter)
+        if sent is not None:
+            go = jnp.logical_and(go, sent.ok(c["sent"]))
+        return go
 
     def body(c):
         X, R, P = c["X"], c["R"], c["P"]
@@ -272,12 +306,19 @@ def block_cg_pairs(matvec_batch: Callable, B: jnp.ndarray,
                    k=c["k"] + 1)
         if record:
             nxt["hist"] = c["hist"].at[c["k"]].set(nxt["r2"])
+        if sent is not None:
+            # Gram-pivot breakdown: the sum propagates any lane's NaN
+            # (a singular Gram solve NaNs the whole block)
+            nxt["sent"] = sent.step(c["sent"], jnp.sum(nxt["r2"]))
         return nxt
 
     state = dict(X=X, R=R, P=P, r2=b2, k=jnp.int32(0))
     if record:
         state["hist"] = jnp.full((maxiter + 1, n), jnp.nan, rdt)
+    if sent is not None:
+        state["sent"] = sent.init(jnp.sum(b2))
     out = jax.lax.while_loop(cond, body, state)
-    return BlockCGResult(out["X"], out["k"], out["r2"],
-                         out["r2"] <= stop,
-                         out["hist"] if record else None)
+    conv, bk = rsent.finalize(sent, out.get("sent"),
+                              out["r2"] <= stop)
+    return BlockCGResult(out["X"], out["k"], out["r2"], conv,
+                         out["hist"] if record else None, bk)

@@ -32,6 +32,11 @@ class SolverResult(NamedTuple):
     # solver ran with record=True; None (the default) otherwise — the
     # zero-overhead path never allocates it
     history: Optional[object] = None
+    # optional typed breakdown code (robust/sentinel.py: 0 = clean exit,
+    # else NONFINITE/PIVOT/STAGNATION) when the solve ran with the
+    # breakdown sentinel threaded (QUDA_TPU_ROBUST != off); None (the
+    # default) on unguarded solves — same discipline as ``history``
+    breakdown: Optional[object] = None
 
 
 def cg(matvec: Callable, b: jnp.ndarray, x0: Optional[jnp.ndarray] = None,

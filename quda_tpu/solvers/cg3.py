@@ -23,6 +23,8 @@ from .cg import SolverResult, cg
 
 def cg3(matvec: Callable, b: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
         tol: float = 1e-10, maxiter: int = 2000) -> SolverResult:
+    from ..robust import sentinel as rsent
+    sent = rsent.make()
     b2 = blas.norm2(b)
     stop = (tol ** 2) * b2
     x = jnp.zeros_like(b) if x0 is None else x0
@@ -32,9 +34,14 @@ def cg3(matvec: Callable, b: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
     state = dict(x=x, x_old=x, r=r, r_old=r, r2=blas.norm2(r),
                  r2_old=jnp.ones((), rdt), rho=jnp.ones((), rdt),
                  k=jnp.int32(0))
+    if sent is not None:
+        state["sent"] = sent.init(state["r2"])
 
     def cond(c):
-        return jnp.logical_and(c["r2"] > stop, c["k"] < maxiter)
+        go = jnp.logical_and(c["r2"] > stop, c["k"] < maxiter)
+        if sent is not None:
+            go = jnp.logical_and(go, sent.ok(c["sent"]))
+        return go
 
     def body(c):
         ar = matvec(c["r"])
@@ -50,13 +57,18 @@ def cg3(matvec: Callable, b: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
             + (1.0 - rho) * c["x_old"]
         r_new = rho * (c["r"] - gamma.astype(b.dtype) * ar) \
             + (1.0 - rho) * c["r_old"]
-        return dict(x=x_new, x_old=c["x"], r=r_new, r_old=c["r"],
-                    r2=blas.norm2(r_new), r2_old=c["r2"], rho=rho,
-                    gamma_old=gamma, k=c["k"] + 1)
+        nxt = dict(x=x_new, x_old=c["x"], r=r_new, r_old=c["r"],
+                   r2=blas.norm2(r_new), r2_old=c["r2"], rho=rho,
+                   gamma_old=gamma, k=c["k"] + 1)
+        if sent is not None:
+            nxt["sent"] = sent.step(c["sent"], nxt["r2"], denom=rAr)
+        return nxt
 
     state["gamma_old"] = jnp.ones((), rdt)
     out = jax.lax.while_loop(cond, body, state)
-    return SolverResult(out["x"], out["k"], out["r2"], out["r2"] <= stop)
+    conv, bk = rsent.finalize(sent, out.get("sent"),
+                              out["r2"] <= stop)
+    return SolverResult(out["x"], out["k"], out["r2"], conv, None, bk)
 
 
 def cgnr(M: Callable, Mdag: Callable, b: jnp.ndarray, tol: float = 1e-10,
@@ -74,4 +86,7 @@ def cgne(M: Callable, Mdag: Callable, b: jnp.ndarray, tol: float = 1e-10,
     solver = cg3 if use_cg3 else cg
     mmdag = lambda v: M(Mdag(v))
     res = solver(mmdag, b, tol=tol, maxiter=maxiter)
-    return SolverResult(Mdag(res.x), res.iters, res.r2, res.converged)
+    # preserve the inner solve's history/breakdown fields — dropping
+    # them here would erase the sentinel's typed reason at the API
+    # layer (the supervision epilogue reads res.breakdown)
+    return res._replace(x=Mdag(res.x))

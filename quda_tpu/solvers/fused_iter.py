@@ -94,6 +94,14 @@ def fused_cg(matvec: Callable, b: jnp.ndarray,
     pallas_tail = _resolve_pallas_tail(use_pallas_tail, b)
     if pallas_interpret is None:
         pallas_interpret = jax.default_backend() != "tpu"
+    # breakdown sentinel (robust/sentinel.py): None when QUDA_TPU_ROBUST
+    # =off — the loop below then traces EXACTLY the unguarded
+    # computation (bit-identical compiled solve, pinned by test); the
+    # dslash fault site is consumed here at trace time (one-shot)
+    from ..robust import faultinject as finj
+    from ..robust import sentinel as rsent
+    sent = rsent.make()
+    fault_k = finj.iteration_fault("dslash")
 
     b2 = blas.norm2(b)
     rdt = b2.dtype
@@ -124,8 +132,10 @@ def fused_cg(matvec: Callable, b: jnp.ndarray,
             return blas.triple_cg_update(alpha.astype(x.dtype), p, Ap,
                                          x, r)
 
-    def one_iter(x, r, p, rz):
+    def one_iter(x, r, p, rz, k):
         Ap = matvec(p)
+        if fault_k is not None:
+            Ap = finj.corrupt(Ap, k, fault_k)
         pAp = blas.redot(p, Ap).astype(rdt)
         alpha = rz / jnp.maximum(pAp, tiny)
         x, r, r2 = tail(alpha, p, Ap, x, r)
@@ -137,7 +147,7 @@ def fused_cg(matvec: Callable, b: jnp.ndarray,
             rz_new = blas.redot(r, z).astype(rdt)
         beta = rz_new / jnp.maximum(rz, tiny)
         p = z + beta.astype(x.dtype) * p
-        return x, r, p, rz_new, r2
+        return x, r, p, rz_new, r2, pAp
 
     def not_done(x, r, r2):
         l2 = r2 > stop
@@ -148,22 +158,35 @@ def fused_cg(matvec: Callable, b: jnp.ndarray,
 
     def cond(carry):
         x, r, r2, k = carry[0], carry[1], carry[4], carry[5]
-        return jnp.logical_and(not_done(x, r, r2), k < maxiter)
+        go = jnp.logical_and(not_done(x, r, r2), k < maxiter)
+        if sent is not None:
+            go = jnp.logical_and(go, sent.ok(carry[-1]))
+        return go
 
     def body(carry):
         x, r, p, rz, r2, k = carry[:6]
-        for _ in range(check_every):
-            x, r, p, rz, r2 = one_iter(x, r, p, rz)
+        pAp = None
+        for j in range(check_every):
+            x, r, p, rz, r2, pAp = one_iter(x, r, p, rz, k + j)
+        out = (x, r, p, rz, r2, k + check_every)
         if record:
-            hist = carry[6].at[k // check_every].set(r2)
-            return (x, r, p, rz, r2, k + check_every, hist)
-        return (x, r, p, rz, r2, k + check_every)
+            out = out + (carry[6].at[k // check_every].set(r2),)
+        if sent is not None:
+            # one sentinel step per convergence check (the amortisation
+            # cadence the cond branch already runs at); the pivot check
+            # sees the LAST fused iteration's pAp — an earlier
+            # breakdown propagates into r2 by then
+            out = out + (sent.step(carry[-1], r2, denom=pAp),)
+        return out
 
     init = (x, r, p, rz, r2, jnp.int32(0))
     if record:
         slots = maxiter // check_every + 2
         init = init + (jnp.full((slots,), jnp.nan, rdt),)
+    if sent is not None:
+        init = init + (sent.init(r2),)
     out = jax.lax.while_loop(cond, body, init)
     x, r, p, rz, r2, k = out[:6]
-    done = jnp.logical_not(not_done(x, r, r2))
-    return SolverResult(x, k, r2, done, out[6] if record else None)
+    done, bk = rsent.finalize(sent, out[-1] if sent is not None else None,
+                              jnp.logical_not(not_done(x, r, r2)))
+    return SolverResult(x, k, r2, done, out[6] if record else None, bk)

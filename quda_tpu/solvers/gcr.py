@@ -60,6 +60,9 @@ def _gcr_cycle(matvec, K, nkrylov: int, dtype_name: str):
 def gcr(matvec: Callable, b: jnp.ndarray, precond: Optional[Callable] = None,
         x0: Optional[jnp.ndarray] = None, tol: float = 1e-10,
         nkrylov: int = 10, max_restarts: int = 50) -> SolverResult:
+    import math
+
+    from ..robust import sentinel as rsent
     b2 = blas.norm2(b)
     stop = float((tol ** 2) * b2)
     K = _identity if precond is None else precond
@@ -73,12 +76,28 @@ def gcr(matvec: Callable, b: jnp.ndarray, precond: Optional[Callable] = None,
     r = b if x0 is None else b - matvec(x)
     total = 0
     r2 = blas.norm2(r)
+    # gcr restarts on the HOST, so the breakdown sentinel is a plain
+    # python check between cycles (robust/sentinel.py; off = unchanged)
+    guard = rsent.active()
+    bk = None
     for _ in range(max_restarts):
+        if guard and not math.isfinite(float(r2)):
+            break
         if float(r2) <= stop:
             break
         x, r, r2 = cycle(x, r)
         total += nkrylov
-    return SolverResult(x, jnp.int32(total), r2, r2 <= stop)
+    if guard:
+        # checked AFTER the loop too: the final cycle (or the
+        # max_restarts-th) can be the one that NaNs, and it must not
+        # exit classified 'none'
+        bk = jnp.int32(rsent.NONFINITE
+                       if not math.isfinite(float(r2))
+                       else rsent.NONE)
+    conv = r2 <= stop
+    if bk is not None:
+        conv = jnp.logical_and(conv, bk == rsent.NONE)
+    return SolverResult(x, jnp.int32(total), r2, conv, None, bk)
 
 
 def gcr_fixed(matvec: Callable, b: jnp.ndarray, nkrylov: int = 8,
@@ -111,27 +130,42 @@ def mr(matvec: Callable, b: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
        tol: float = 1e-10, maxiter: int = 100,
        omega: float = 1.0) -> SolverResult:
     """Minimal residual iteration (the MG smoother; omega = relaxation)."""
+    from ..robust import sentinel as rsent
+    sent = rsent.make()
     b2 = blas.norm2(b)
     stop = (tol ** 2) * b2
     x = jnp.zeros_like(b) if x0 is None else x0
     r = b if x0 is None else b - matvec(x)
 
     def cond(c):
-        x, r, r2, k = c
-        return jnp.logical_and(r2 > stop, k < maxiter)
+        x, r, r2, k = c[:4]
+        go = jnp.logical_and(r2 > stop, k < maxiter)
+        if sent is not None:
+            go = jnp.logical_and(go, sent.ok(c[-1]))
+        return go
 
     def body(c):
-        x, r, r2, k = c
+        x, r, r2, k = c[:4]
         ar = matvec(r)
         alpha = blas.cdot(ar, r) / jnp.maximum(
             blas.norm2(ar), jnp.finfo(r2.dtype).tiny).astype(b.dtype)
         x = x + omega * alpha * r
         r = r - omega * alpha * ar
-        return (x, r, blas.norm2(r), k + 1)
+        r2n = blas.norm2(r)
+        out = (x, r, r2n, k + 1)
+        if sent is not None:
+            out = out + (sent.step(c[-1], r2n),)
+        return out
 
-    x, r, r2, k = jax.lax.while_loop(cond, body,
-                                     (x, r, blas.norm2(r), jnp.int32(0)))
-    return SolverResult(x, k, r2, r2 <= stop)
+    init = (x, r, blas.norm2(r), jnp.int32(0))
+    if sent is not None:
+        init = init + (sent.init(init[2]),)
+    out = jax.lax.while_loop(cond, body, init)
+    x, r, r2, k = out[:4]
+    conv, bk = rsent.finalize(sent,
+                              out[-1] if sent is not None else None,
+                              r2 <= stop)
+    return SolverResult(x, k, r2, conv, None, bk)
 
 
 def mr_fixed(matvec: Callable, b: jnp.ndarray, n_iters: int,
@@ -154,23 +188,39 @@ def mr_fixed(matvec: Callable, b: jnp.ndarray, n_iters: int,
 def sd(matvec: Callable, b: jnp.ndarray, x0=None, tol: float = 1e-10,
        maxiter: int = 100) -> SolverResult:
     """Steepest descent for Hermitian positive-definite matvec."""
+    from ..robust import sentinel as rsent
+    sent = rsent.make()
     b2 = blas.norm2(b)
     stop = (tol ** 2) * b2
     x = jnp.zeros_like(b) if x0 is None else x0
     r = b if x0 is None else b - matvec(x)
 
     def cond(c):
-        x, r, r2, k = c
-        return jnp.logical_and(r2 > stop, k < maxiter)
+        x, r, r2, k = c[:4]
+        go = jnp.logical_and(r2 > stop, k < maxiter)
+        if sent is not None:
+            go = jnp.logical_and(go, sent.ok(c[-1]))
+        return go
 
     def body(c):
-        x, r, r2, k = c
+        x, r, r2, k = c[:4]
         ar = matvec(r)
-        alpha = (r2 / blas.redot(r, ar)).astype(b.dtype)
+        rAr = blas.redot(r, ar)
+        alpha = (r2 / rAr).astype(b.dtype)
         x = x + alpha * r
         r = r - alpha * ar
-        return (x, r, blas.norm2(r), k + 1)
+        r2n = blas.norm2(r)
+        out = (x, r, r2n, k + 1)
+        if sent is not None:
+            out = out + (sent.step(c[-1], r2n, denom=rAr),)
+        return out
 
-    x, r, r2, k = jax.lax.while_loop(cond, body,
-                                     (x, r, blas.norm2(r), jnp.int32(0)))
-    return SolverResult(x, k, r2, r2 <= stop)
+    init = (x, r, blas.norm2(r), jnp.int32(0))
+    if sent is not None:
+        init = init + (sent.init(init[2]),)
+    out = jax.lax.while_loop(cond, body, init)
+    x, r, r2, k = out[:4]
+    conv, bk = rsent.finalize(sent,
+                              out[-1] if sent is not None else None,
+                              r2 <= stop)
+    return SolverResult(x, k, r2, conv, None, bk)

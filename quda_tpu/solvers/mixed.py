@@ -163,6 +163,13 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
         if sloppy_dtype is None:
             raise ValueError("cg_reliable needs sloppy_dtype or codec")
         codec = dtype_codec(sloppy_dtype, b.dtype)
+    # breakdown sentinel + dslash fault site (robust/): None/None at
+    # QUDA_TPU_ROBUST=off & nothing armed — the loop then traces the
+    # exact unguarded computation
+    from ..robust import faultinject as finj
+    from ..robust import sentinel as rsent
+    sent = rsent.make()
+    fault_k = finj.iteration_fault("dslash")
     b2 = blas.norm2(b)
     stop = (tol ** 2) * b2
 
@@ -175,10 +182,15 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
     rdt = jnp.zeros((), b.dtype).real.dtype
 
     def cond(c):
-        return jnp.logical_and(c["r2"] > stop, c["k"] < maxiter)
+        go = jnp.logical_and(c["r2"] > stop, c["k"] < maxiter)
+        if sent is not None:
+            go = jnp.logical_and(go, sent.ok(c["sent"]))
+        return go
 
     def body(c):
         Ap = matvec_lo(c["p"])
+        if fault_k is not None:
+            Ap = finj.corrupt(Ap, c["k"], fault_k)
         pAp = codec.redot(c["p"], Ap).astype(rdt)
         alpha = c["r2_lo"] / jnp.maximum(pAp, jnp.finfo(rdt).tiny)
         x_lo = codec.axpy(alpha, c["p"], c["x_lo"])
@@ -193,6 +205,8 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
         beta = r2_new / c["r2_lo"]
         p = codec.axpy(beta, c["p"], r_lo)
         r2max = jnp.maximum(c["r2max"], r2_new)
+        st_new = (sent.step(c["sent"], r2_new, denom=pAp)
+                  if sent is not None else None)
 
         do_reliable = jnp.logical_or(r2_new < (delta ** 2) * r2max,
                                      r2_new < stop)
@@ -214,6 +228,8 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
             if record:
                 d["hist"] = c["hist"].at[c["k"]].set(r2_true)
                 d["rel"] = c["rel"].at[c["k"]].set(True)
+            if sent is not None:
+                d["sent"] = st_new
             return d
 
         def keep(_):
@@ -222,6 +238,8 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
             if record:
                 d["hist"] = c["hist"].at[c["k"]].set(r2_new.astype(rdt))
                 d["rel"] = c["rel"]
+            if sent is not None:
+                d["sent"] = st_new
             return d
 
         return jax.lax.cond(do_reliable, reliable, keep, None)
@@ -231,6 +249,8 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
     if record:
         init["hist"] = jnp.full((maxiter + 1,), jnp.nan, rdt)
         init["rel"] = jnp.zeros((maxiter + 1,), bool)
+    if sent is not None:
+        init["sent"] = sent.init(r2.astype(rdt))
     out = jax.lax.while_loop(cond, body, init)
     # final fold of any un-injected sloppy contribution
     x_fin = out["x"] + codec.up(out["x_lo"])
@@ -238,7 +258,8 @@ def cg_reliable(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray,
     r2_fin = blas.norm2_comp(r_fin)
     hist = ({"r2": out["hist"], "reliable": out["rel"]} if record
             else None)
-    return SolverResult(x_fin, out["k"], r2_fin, r2_fin <= stop, hist)
+    conv, bk = rsent.finalize(sent, out.get("sent"), r2_fin <= stop)
+    return SolverResult(x_fin, out["k"], r2_fin, conv, hist, bk)
 
 
 def cg_reliable_df(op_df, matvec_lo: Callable, rhs_df, codec: StorageCodec,
@@ -268,6 +289,8 @@ def cg_reliable_df(op_df, matvec_lo: Callable, rhs_df, codec: StorageCodec,
     refinement cycles.
     """
     from ..ops import df64 as dfm
+    from ..robust import sentinel as rsent
+    sent = rsent.make()
 
     f32 = jnp.float32
     b2d = dfm.to_f32(dfm.norm2(rhs_df)).astype(f32)
@@ -284,7 +307,10 @@ def cg_reliable_df(op_df, matvec_lo: Callable, rhs_df, codec: StorageCodec,
     rn2 = codec.norm2(r_lo).astype(f32)
 
     def cond(c):
-        return jnp.logical_and(c["d2"] > stop_d, c["k"] < maxiter)
+        go = jnp.logical_and(c["d2"] > stop_d, c["k"] < maxiter)
+        if sent is not None:
+            go = jnp.logical_and(go, sent.ok(c["sent"]))
+        return go
 
     def body(c):
         Ap = matvec_lo(c["p"])
@@ -300,6 +326,8 @@ def cg_reliable_df(op_df, matvec_lo: Callable, rhs_df, codec: StorageCodec,
         beta = r2_new / c["r2_lo"]
         p = codec.axpy(beta, c["p"], r_lo)
         r2max = jnp.maximum(c["r2max"], r2_new)
+        st_new = (sent.step(c["sent"], r2_new, denom=pAp)
+                  if sent is not None else None)
 
         do_reliable = jnp.logical_or(r2_new < (delta ** 2) * r2max,
                                      r2_new < c["stop_n"])
@@ -322,6 +350,8 @@ def cg_reliable_df(op_df, matvec_lo: Callable, rhs_df, codec: StorageCodec,
                 r_lo=codec.down(rn), p=codec.down(rn),
                 x_lo=jnp.zeros_like(x_lo),
                 r2_lo=rn2_true, r2max=rn2_true, k=c["k"] + 1)
+            if sent is not None:
+                d["sent"] = st_new
             if record:
                 # record the TRUE normal-equation residual, not d2: the
                 # keep branch records sloppy normal-eq norms, and one
@@ -338,6 +368,8 @@ def cg_reliable_df(op_df, matvec_lo: Callable, rhs_df, codec: StorageCodec,
             if record:
                 d["hist"] = c["hist"].at[c["k"]].set(r2_new)
                 d["rel"] = c["rel"]
+            if sent is not None:
+                d["sent"] = st_new
             return d
 
         return jax.lax.cond(do_reliable, reliable, keep, None)
@@ -347,6 +379,8 @@ def cg_reliable_df(op_df, matvec_lo: Callable, rhs_df, codec: StorageCodec,
     if record:
         init["hist"] = jnp.full((maxiter + 1,), jnp.nan, f32)
         init["rel"] = jnp.zeros((maxiter + 1,), bool)
+    if sent is not None:
+        init["sent"] = sent.init(rn2)
     out = jax.lax.while_loop(cond, body, init)
     x_fin = dfm.add(out["x"], dfm.promote(codec.up(out["x_lo"])))
     d_df = op_df.residual_df(rhs_df, x_fin)
@@ -357,7 +391,8 @@ def cg_reliable_df(op_df, matvec_lo: Callable, rhs_df, codec: StorageCodec,
     # recorded system instead of the caller's direct-system b2
     hist = ({"r2": out["hist"], "reliable": out["rel"], "b2": bn2}
             if record else None)
-    return SolverResult(x_fin, out["k"], d2_fin, d2_fin <= stop_d, hist)
+    conv, bk = rsent.finalize(sent, out.get("sent"), d2_fin <= stop_d)
+    return SolverResult(x_fin, out["k"], d2_fin, conv, hist, bk)
 
 
 def solve_refined(matvec_hi: Callable, inner_solve: Callable, b: jnp.ndarray,

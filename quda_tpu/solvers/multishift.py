@@ -30,6 +30,9 @@ class MultiShiftResult(NamedTuple):
     # optional per-iteration history (record=True): {"r2": base-system
     # norms, "shift_r2": (slots, n_shifts) analytic shifted residuals}
     history: object = None
+    # optional typed breakdown code (robust/sentinel.py; None on
+    # unguarded solves — see solvers/cg.SolverResult.breakdown)
+    breakdown: object = None
 
 
 def multishift_cg(matvec: Callable, b: jnp.ndarray,
@@ -47,6 +50,10 @@ def multishift_cg(matvec: Callable, b: jnp.ndarray,
     norms and the analytically-known per-shift residuals
     (|r_s|^2 = zeta_s^2 |r|^2) as ``history`` for obs/convergence.py.
     """
+    from ..robust import faultinject as finj
+    from ..robust import sentinel as rsent
+    sent = rsent.make()
+    fault_k = finj.iteration_fault("dslash")
     shifts = tuple(float(s) for s in shifts)
     ns = len(shifts)
     s0 = min(shifts)
@@ -75,16 +82,24 @@ def multishift_cg(matvec: Callable, b: jnp.ndarray,
     if record:
         state["hist"] = jnp.full((maxiter + 1,), jnp.nan, rdt)
         state["shist"] = jnp.full((maxiter + 1, ns), jnp.nan, rdt)
+    if sent is not None:
+        state["sent"] = sent.init(b2)
 
     def shift_r2(c):
         return (c["zeta"] ** 2) * c["r2"]
 
     def cond(c):
-        return jnp.logical_and(jnp.max(shift_r2(c)) > stop, c["k"] < maxiter)
+        go = jnp.logical_and(jnp.max(shift_r2(c)) > stop,
+                             c["k"] < maxiter)
+        if sent is not None:
+            go = jnp.logical_and(go, sent.ok(c["sent"]))
+        return go
 
     def body(c):
         p0 = c["p"][0]
         Ap = base(p0)
+        if fault_k is not None:
+            Ap = finj.corrupt(Ap, c["k"], fault_k)
         pAp = blas.redot(p0, Ap).astype(rdt)
         alpha = c["r2"] / pAp
 
@@ -114,10 +129,14 @@ def multishift_cg(matvec: Callable, b: jnp.ndarray,
             nxt["hist"] = c["hist"].at[c["k"]].set(r2_new)
             nxt["shist"] = c["shist"].at[c["k"]].set(
                 (zeta_new ** 2) * r2_new)
+        if sent is not None:
+            nxt["sent"] = sent.step(c["sent"], r2_new, denom=pAp)
         return nxt
 
     out = jax.lax.while_loop(cond, body, state)
     conv = shift_r2(out) <= stop
     hist = ({"r2": out["hist"], "shift_r2": out["shist"]} if record
             else None)
-    return MultiShiftResult(out["x"], out["k"], out["r2"], conv, hist)
+    conv, bk = rsent.finalize(sent, out.get("sent"), conv)
+    return MultiShiftResult(out["x"], out["k"], out["r2"], conv, hist,
+                            bk)

@@ -289,6 +289,65 @@ _register("QUDA_TPU_FORCE_CPU", "bool", False,
           "pin the CPU backend (and enable x64) in the embedded C-API "
           "interpreter", reference="QUDA_CPU_FIELD_LOCATION-style hosts")
 
+# -- solve supervision (quda_tpu/robust) ------------------------------------
+_register("QUDA_TPU_ROBUST", "choice", "off",
+          "solve supervision level (quda_tpu/robust): 'off' = the "
+          "compiled solves are bit-identical to the unguarded loops "
+          "(zero ops added — pinned by test); 'verify' = in-loop "
+          "breakdown sentinels (non-finite residual, pivot/Gram "
+          "breakdown, stagnation) thread the solver while_loops and "
+          "every API solve records verified_res + a solve_status on "
+          "InvertParam; 'escalate' = verify plus the bounded retry "
+          "ladder (pallas -> XLA stencil form; f32 sloppy -> df64 "
+          "reliable; CG -> BiCGStab) on breakdown, verification "
+          "mismatch, or operator-construction failure",
+          ("off", "verify", "escalate"),
+          reference="reliable updates + invert_test true-residual "
+                    "checks (arXiv:1408.5925 production discipline)")
+_register("QUDA_TPU_ROBUST_STAGNATION", "int", 0,
+          "breakdown-sentinel stagnation window: flag a solve whose "
+          "residual has not improved for this many consecutive "
+          "convergence checks as a 'stagnation' breakdown (0 = "
+          "disabled; stagnation is workload-dependent, so it is opt-in "
+          "unlike the always-on finiteness/pivot predicates)",
+          reference="solver convergence monitoring (lib/solver.cpp "
+                    "PrintStats discipline)")
+_register("QUDA_TPU_ROBUST_VERIFY_MARGIN", "float", 100.0,
+          "verified-exit acceptance margin: a solve whose recomputed "
+          "true residual exceeds margin * tol is recorded 'unverified' "
+          "(and retried under 'escalate').  The margin absorbs the "
+          "legitimate gap between the iterated system's stopping "
+          "criterion (e.g. the normal equations) and the direct-system "
+          "true residual",
+          reference="invert_test residual verification")
+_register("QUDA_TPU_ROBUST_MAX_RETRIES", "int", 3,
+          "bound on escalation-ladder attempts per API solve "
+          "(including the as-requested first attempt)",
+          reference="bounded retry: a serving fleet must fail fast, "
+                    "not loop")
+_register("QUDA_TPU_FAULT", "str", "",
+          "deterministic fault injection (quda_tpu/robust/faultinject):"
+          " comma-separated <site>:<trigger> arms, e.g. 'dslash:5' "
+          "(poison the dslash output at iteration 5 of the next "
+          "solve), 'pallas_build:1' (raise on the next pallas operator"
+          " construction), 'gauge:1' (poison a link at the next gauge "
+          "load), 'residual:1e3' (inflate the next verified residual "
+          "by 1e3).  Faults are one-shot: each arm fires once, then "
+          "disarms — so an escalation retry sees a healthy system, "
+          "modeling a transient fault.  TEST/DRILL KNOB: never set in "
+          "production",
+          reference="fault-injection testing of the reliable-update/"
+                    "autotuner failure paths")
+_register("QUDA_TPU_GAUGE_UNITARITY_TOL", "float", 0.0,
+          "load_gauge_quda unitarity screen: warn (trace event "
+          "gauge_unitarity) when any link's max |U Udag - I| exceeds "
+          "this tolerance (0 = disabled).  Non-finite links are "
+          "ALWAYS rejected loudly regardless of this knob; a "
+          "deviating-but-finite gauge can be repaired with "
+          "update_gauge_field_quda's reunitarize (ops/su3.project_su3)",
+          reference="checkGauge / unitarize_links_quda tolerance "
+                    "(include/svd_quda.h)")
+
 # CUDA-runtime knobs deliberately not carried over: the replacing
 # subsystem answers "where did it go".
 SUBSUMED = {
@@ -310,6 +369,37 @@ SUBSUMED = {
 }
 
 _cache: dict[str, object] = {}
+
+# Scoped override stack (robust/escalate.py retry rungs): each layer maps
+# knob name -> raw string value and WINS over os.environ while pushed, so
+# a ladder rung can demote e.g. QUDA_TPU_PALLAS without mutating the
+# process environment (and without racing other readers of it).
+_overrides: list = []
+
+
+def overrides(**kv):
+    """Context manager: push a layer of knob overrides (raw string
+    values, validated like env input) that takes precedence over
+    os.environ until the context exits.  Unknown knob names raise
+    immediately — an override silently doing nothing is the same
+    failure mode the registry exists to kill."""
+    import contextlib
+
+    for name in kv:
+        if name not in _REGISTRY:
+            raise KeyError(f"override of unregistered knob {name!r}")
+
+    @contextlib.contextmanager
+    def _ctx():
+        _overrides.append({k: str(v) for k, v in kv.items()})
+        _cache.clear()
+        try:
+            yield
+        finally:
+            _overrides.pop()
+            _cache.clear()
+
+    return _ctx()
 
 
 def _parse(knob: Knob, raw: str):
@@ -341,6 +431,10 @@ def get(name: str, *, fresh: bool = False):
         return _cache[name]
     knob = _REGISTRY[name]
     raw = os.environ.get(name)
+    for layer in reversed(_overrides):
+        if name in layer:
+            raw = layer[name]
+            break
     val = knob.default if raw is None or raw == "" else _parse(knob, raw)
     _cache[name] = val
     return val

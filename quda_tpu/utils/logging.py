@@ -89,6 +89,20 @@ def warningq(msg: str):
         _emit("WARNING: " + msg)
 
 
+_warned_once: set = set()
+
+
+def warn_once(key: str, msg: str):
+    """One-time warning per process per key (the unconverged-solve /
+    degraded-race notices: loud the first time, not a log flood under
+    serving traffic).  Returns True iff the warning was emitted."""
+    if key in _warned_once:
+        return False
+    _warned_once.add(key)
+    warningq(msg)
+    return True
+
+
 class QudaError(RuntimeError):
     pass
 

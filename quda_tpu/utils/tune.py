@@ -219,7 +219,24 @@ def tune(name: str, volume, candidates: Dict[str, Callable], args: tuple,
         if t < best_t:
             best, best_t = param, t
     if best is None:
-        raise RuntimeError(f"no tuning candidate succeeded for {key}")
+        # every candidate raised (a race mid-chip-window can lose all
+        # its entrants to a transient): degrade to the STATIC DEFAULT —
+        # the first registered candidate, by the same convention
+        # tuning-disabled uses — with a one-time notice, and do NOT
+        # cache: the degraded choice was never timed, so the next
+        # process re-races (tune.cpp skips failing launches the same
+        # way; an all-fail race aborting the solve would turn a tuning
+        # hiccup into an outage)
+        default = next(iter(candidates))
+        _obs_event("tune_race_all_failed", key=key, fallback=default,
+                   n_candidates=len(candidates))
+        from . import logging as qlog
+        qlog.warn_once(
+            f"tune_all_failed:{name}",
+            f"tune: every candidate failed for {key}; degrading to "
+            f"the static default {default!r} (not cached — re-raced "
+            "next time)")
+        return default
     _cache[key] = {"param": best, "time": best_t,
                    "platform": platform_key()}
     _obs_event("tune_winner", key=key, param=best, seconds=best_t)

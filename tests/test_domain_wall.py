@@ -376,9 +376,9 @@ def test_mobius_pairs_api_adapter_selected(monkeypatch):
     captured = {}
     orig = api._PairOpSolve.__init__
 
-    def spy(self, dpc, use_pallas):
+    def spy(self, dpc, use_pallas, pallas_interpret=False):
         captured["hit"] = True
-        orig(self, dpc, use_pallas)
+        orig(self, dpc, use_pallas, pallas_interpret)
 
     monkeypatch.setattr(api._PairOpSolve, "__init__", spy)
     monkeypatch.setenv("QUDA_TPU_PACKED", "1")
@@ -433,9 +433,9 @@ def test_dw5dpc_pairs_api_adapter_selected(monkeypatch):
     captured = {}
     orig = api._PairOpSolve.__init__
 
-    def spy(self, dpc, use_pallas):
+    def spy(self, dpc, use_pallas, pallas_interpret=False):
         captured["hit"] = True
-        orig(self, dpc, use_pallas)
+        orig(self, dpc, use_pallas, pallas_interpret)
 
     monkeypatch.setattr(api._PairOpSolve, "__init__", spy)
     monkeypatch.setenv("QUDA_TPU_PACKED", "1")

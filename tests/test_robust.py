@@ -1,0 +1,603 @@
+"""Solve supervision (quda_tpu/robust): breakdown sentinels, verified
+exits, the escalation ladder, and the deterministic fault-injection
+harness.
+
+The acceptance contract (ISSUE 8): an injected mid-solve NaN and a
+forced pallas-construction failure each produce a VERIFIED-CONVERGED
+solution via the escalation ladder, with per-attempt provenance on
+InvertParam and solve_retry / breakdown_detected events in the trace
+artifact; with QUDA_TPU_ROBUST=off the compiled solve runs none of the
+robust machinery (raising-stub pin, the obs zero-overhead discipline).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.obs import trace as otr
+from quda_tpu.robust import escalate as resc
+from quda_tpu.robust import faultinject as finj
+from quda_tpu.robust import sentinel as rsent
+from quda_tpu.utils import config as qconf
+from quda_tpu.utils import logging as qlog
+
+
+@pytest.fixture(autouse=True)
+def _iso(monkeypatch):
+    """Every test starts disarmed, untraced, with a fresh config cache
+    and a fresh one-time-warning set."""
+    finj.reset()
+    otr.stop(flush_files=False)
+    qconf.reset_cache()
+    monkeypatch.setattr(qlog, "_warned_once", set())
+    yield
+    finj.reset()
+    otr.stop(flush_files=False)
+    qconf.reset_cache()
+
+
+def _diag_system(n=96, lo=0.5, hi=2.0, dtype=jnp.float32):
+    d = jnp.linspace(lo, hi, n).astype(dtype)
+    return (lambda v: d * v), jnp.ones((n,), dtype)
+
+
+# -- sentinel unit level -----------------------------------------------------
+
+def test_sentinel_off_is_none(monkeypatch):
+    monkeypatch.delenv("QUDA_TPU_ROBUST", raising=False)
+    assert rsent.make() is None
+    assert not rsent.active() and rsent.mode() == "off"
+
+
+def test_sentinel_codes_and_reasons(monkeypatch):
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    s = rsent.make()
+    st = s.init(jnp.float32(4.0))
+    st = s.step(st, jnp.float32(1.0), denom=jnp.float32(2.0))
+    assert int(s.code(st)) == rsent.NONE and bool(s.ok(st))
+    # non-finite residual
+    st2 = s.step(st, jnp.float32(float("nan")))
+    assert int(s.code(st2)) == rsent.NONFINITE and not bool(s.ok(st2))
+    # finite non-positive pivot names PIVOT even when r2 overflowed in
+    # the same step (the original cause, not the downstream symptom)
+    st3 = s.step(st, jnp.float32(float("inf")),
+                 denom=jnp.float32(-1.0))
+    assert int(s.code(st3)) == rsent.PIVOT
+    # a non-finite denominator is the NONFINITE class
+    st4 = s.step(st, jnp.float32(1.0),
+                 denom=jnp.float32(float("nan")))
+    assert int(s.code(st4)) == rsent.NONFINITE
+    # first breakdown is sticky
+    st5 = s.step(st2, jnp.float32(0.5), denom=jnp.float32(-1.0))
+    assert int(s.code(st5)) == rsent.NONFINITE
+    assert rsent.reason(rsent.PIVOT) == "pivot"
+    assert rsent.reason(rsent.STAGNATION) == "stagnation"
+
+
+def test_sentinel_stagnation_window(monkeypatch):
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    monkeypatch.setenv("QUDA_TPU_ROBUST_STAGNATION", "3")
+    qconf.reset_cache()
+    s = rsent.make()
+    assert s.stagnation_checks == 3
+    st = s.init(jnp.float32(4.0))
+    st = s.step(st, jnp.float32(1.0))       # improvement resets
+    for _ in range(2):
+        st = s.step(st, jnp.float32(1.0))
+        assert int(s.code(st)) == rsent.NONE
+    st = s.step(st, jnp.float32(1.0))       # 3rd check w/o improvement
+    assert int(s.code(st)) == rsent.STAGNATION
+    # an improving sequence never trips
+    st = s.init(jnp.float32(4.0))
+    r2 = 4.0
+    for _ in range(10):
+        r2 *= 0.5
+        st = s.step(st, jnp.float32(r2))
+    assert int(s.code(st)) == rsent.NONE
+
+
+# -- sentinel threaded through every solver ---------------------------------
+
+def test_fused_cg_clean_exit_on_injected_nan(monkeypatch):
+    from quda_tpu.solvers.fused_iter import fused_cg
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    mv, b = _diag_system()
+    finj.arm("dslash", "5")
+    res = fused_cg(mv, b, tol=1e-12, maxiter=400)
+    # exits within one check of the fault, NOT at maxiter
+    assert int(res.iters) <= 7
+    assert int(res.breakdown) == rsent.NONFINITE
+    assert not bool(res.converged)
+    assert finj.fired("dslash")
+    # off path: breakdown not even allocated
+    monkeypatch.delenv("QUDA_TPU_ROBUST")
+    res2 = fused_cg(mv, b, tol=1e-6, maxiter=400)
+    assert res2.breakdown is None and bool(res2.converged)
+
+
+def test_fused_cg_pivot_breakdown(monkeypatch):
+    from quda_tpu.solvers.fused_iter import fused_cg
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    mv, b = _diag_system()
+    res = fused_cg(lambda v: -v, b, tol=1e-12, maxiter=100)
+    assert int(res.breakdown) == rsent.PIVOT
+    assert int(res.iters) <= 2 and not bool(res.converged)
+
+
+def test_cg_reliable_sentinel(monkeypatch):
+    from quda_tpu.solvers.mixed import cg_reliable
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    n = 96
+    d = jnp.linspace(0.5, 2.0, n)
+    b = jnp.ones((n,), jnp.complex128)
+    mv = lambda v: d * v
+    mv_lo = lambda v: (d.astype(jnp.complex64) * v).astype(jnp.complex64)
+    finj.arm("dslash", "4")
+    res = cg_reliable(mv, mv_lo, b, sloppy_dtype=jnp.complex64,
+                      tol=1e-8, maxiter=400)
+    assert int(res.breakdown) == rsent.NONFINITE
+    assert int(res.iters) <= 6 and not bool(res.converged)
+    # clean solve still converges with the sentinel threaded
+    res2 = cg_reliable(mv, mv_lo, b, sloppy_dtype=jnp.complex64,
+                       tol=1e-8, maxiter=400)
+    assert bool(res2.converged) and int(res2.breakdown) == rsent.NONE
+
+
+def test_cg_reliable_df_sentinel(monkeypatch):
+    from quda_tpu.solvers.mixed import cg_reliable_df, pair_inplace_codec
+    from quda_tpu.ops import df64 as dfm
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    n = 64
+    d = jnp.linspace(0.5, 2.0, n).astype(jnp.float32)
+
+    class _Op:
+        def Mdag(self, x_df):
+            return (d * x_df[0], d * x_df[1])
+
+        def residual_df(self, rhs_df, x_df):
+            mx = (d * x_df[0], d * x_df[1])
+            return dfm.add(rhs_df, (-mx[0], -mx[1]))
+
+    rhs = dfm.promote(jnp.ones((n,), jnp.float32))
+    # the toy operator computes at plain f32 (no real df64 stencil), so
+    # judge at an f32-reachable tolerance — the wiring under test is the
+    # sentinel carry, not df64 arithmetic
+    res = cg_reliable_df(_Op(), lambda v: d * d * v, rhs,
+                         pair_inplace_codec(jnp.float32), tol=1e-6,
+                         maxiter=400)
+    assert bool(res.converged) and int(res.breakdown) == rsent.NONE
+
+
+def test_bicgstab_and_multishift_sentinel(monkeypatch):
+    from quda_tpu.solvers.bicgstab import bicgstab
+    from quda_tpu.solvers.multishift import multishift_cg
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    mv, b = _diag_system(dtype=jnp.float64)
+    finj.arm("dslash", "3")
+    res = bicgstab(mv, b, tol=1e-10, maxiter=400)
+    assert int(res.breakdown) == rsent.NONFINITE
+    assert int(res.iters) <= 5 and not bool(res.converged)
+    mv32, b32 = _diag_system()
+    finj.reset()
+    finj.arm("dslash", "3")
+    rms = multishift_cg(mv32, b32, (0.0, 0.4), tol=1e-10, maxiter=400)
+    assert int(rms.breakdown) == rsent.NONFINITE
+    assert int(rms.iters) <= 5
+    assert not np.asarray(rms.converged).any()
+
+
+def test_batched_and_block_pairs_sentinel(monkeypatch):
+    from quda_tpu.solvers.block import batched_cg_pairs, block_cg_pairs
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    n = 96
+    d = jnp.linspace(0.5, 2.0, n).astype(jnp.float32)
+    B = jnp.stack([jnp.ones((n,)), 2.0 * jnp.ones((n,))]
+                  ).astype(jnp.float32)
+    finj.arm("dslash", "2")
+    res = batched_cg_pairs(lambda V: d[None] * V, B, tol=1e-10,
+                           maxiter=400, check_every=1)
+    assert int(res.breakdown) == rsent.NONFINITE
+    assert not np.asarray(res.converged).any()
+    # block CG: duplicate sources -> singular Gram -> typed breakdown
+    Bdup = jnp.stack([jnp.ones((n,)), jnp.ones((n,))]
+                     ).astype(jnp.float32)
+    res2 = block_cg_pairs(lambda V: d[None] * V, Bdup, tol=1e-10,
+                          maxiter=100)
+    assert int(res2.breakdown) == rsent.NONFINITE
+    assert not np.asarray(res2.converged).any()
+
+
+def test_cg3_mr_sd_sentinel(monkeypatch):
+    from quda_tpu.solvers.cg3 import cg3
+    from quda_tpu.solvers.gcr import mr, sd
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    mv, b = _diag_system(dtype=jnp.float64)
+    for solver in (cg3, mr, sd):
+        res = solver(mv, b, tol=1e-8, maxiter=300)
+        assert bool(res.converged), solver.__name__
+        assert int(res.breakdown) == rsent.NONE, solver.__name__
+
+
+# -- the API end-to-end acceptance paths ------------------------------------
+
+def _unit_gauge(L):
+    return np.broadcast_to(np.eye(3, dtype=np.complex64),
+                           (4, L, L, L, L, 3, 3)).copy()
+
+
+def _wilson_param(**kw):
+    from quda_tpu.interfaces.params import InvertParam
+    kw.setdefault("dslash_type", "wilson")
+    kw.setdefault("inv_type", "cg")
+    kw.setdefault("solve_type", "normop-pc")
+    kw.setdefault("kappa", 0.12)
+    kw.setdefault("tol", 1e-6)
+    kw.setdefault("maxiter", 300)
+    kw.setdefault("cuda_prec", "single")
+    return InvertParam(**kw)
+
+
+def _rand_src(L, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((L, L, L, L, 4, 3))
+            + 1j * rng.standard_normal((L, L, L, L, 4, 3))
+            ).astype(np.complex64)
+
+
+@pytest.fixture
+def _api(tmp_path, monkeypatch):
+    """Initialised 4^4 Wilson setup with tracing + escalate mode on."""
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              load_gauge_quda)
+    from quda_tpu.interfaces.params import GaugeParam
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "escalate")
+    monkeypatch.setenv("QUDA_TPU_TRACE", "1")
+    monkeypatch.setenv("QUDA_TPU_TRACE_PATH", str(tmp_path))
+    qconf.reset_cache()
+    init_quda()
+    L = 4
+    load_gauge_quda(_unit_gauge(L), GaugeParam(X=(L,) * 4,
+                                               cuda_prec="single"))
+    yield L, tmp_path
+    end_quda()
+
+
+def _trace_names(tmp_path):
+    otr.flush()
+    path = tmp_path / "trace_events.jsonl"
+    return [json.loads(ln) for ln in open(path)]
+
+
+def test_acceptance_injected_nan_recovers_via_ladder(_api):
+    """ISSUE 8 acceptance #1: a mid-solve NaN at iteration k trips the
+    sentinel (clean exit), the ladder re-solves on the fallback rung,
+    the final residual verifies, and the provenance + trace events
+    match."""
+    from quda_tpu.interfaces.quda_api import invert_quda
+    L, tmp_path = _api
+    finj.arm("dslash", "5")
+    p = _wilson_param()
+    x = invert_quda(_rand_src(L), p)
+    assert p.solve_status == "converged"
+    assert p.converged
+    assert p.verified_res <= 100 * p.tol
+    assert np.isfinite(np.asarray(x)).all()
+    # per-attempt provenance: breakdown at rung 0, converged at rung 1
+    assert len(p.solve_attempts) == 2
+    assert p.solve_attempts[0]["rung"] == "as-requested"
+    assert p.solve_attempts[0]["status"] == "breakdown:nonfinite"
+    assert p.solve_attempts[0]["iters"] <= 7        # not a maxiter spin
+    assert p.solve_attempts[1]["status"] == "converged"
+    # trace artifact: fault_injected + breakdown_detected + solve_retry
+    names = [e["name"] for e in _trace_names(tmp_path)]
+    for want in ("fault_injected", "breakdown_detected", "solve_retry",
+                 "solve_degraded"):
+        assert want in names, want
+    retry = [e for e in _trace_names(tmp_path)
+             if e["name"] == "solve_retry"][0]
+    assert retry["reason"] == "breakdown:nonfinite"
+    assert retry["to_rung"] == "xla"
+
+
+def test_acceptance_pallas_build_failure_recovers(_api, monkeypatch):
+    """ISSUE 8 acceptance #2: a forced pallas-construction failure is
+    caught by the ladder, which re-solves on the XLA stencil rung to a
+    verified-converged solution."""
+    from quda_tpu.interfaces.quda_api import invert_quda
+    L, tmp_path = _api
+    # force the pallas-in-solver route so rung 0 actually constructs a
+    # pallas operator on this CPU host (interpret mode)
+    monkeypatch.setenv("QUDA_TPU_PALLAS", "1")
+    monkeypatch.setenv("QUDA_TPU_PACKED", "1")
+    qconf.reset_cache()
+    finj.arm("pallas_build", "1")
+    p = _wilson_param()
+    x = invert_quda(_rand_src(L), p)
+    assert p.solve_status == "converged"
+    assert p.verified_res <= 100 * p.tol
+    assert np.isfinite(np.asarray(x)).all()
+    assert p.solve_attempts[0]["status"] == \
+        "construct_error:InjectedFault"
+    assert p.solve_attempts[1]["rung"] == "xla"
+    assert p.solve_attempts[1]["status"] == "converged"
+    names = [e["name"] for e in _trace_names(tmp_path)]
+    assert "solve_retry" in names and "fault_injected" in names
+
+
+def test_acceptance_residual_inflation_retries(_api):
+    """A verification mismatch (solver claims converged, recomputed
+    residual says otherwise) escalates instead of being served."""
+    from quda_tpu.interfaces.quda_api import invert_quda
+    L, tmp_path = _api
+    finj.arm("residual", "1e6")
+    p = _wilson_param()
+    invert_quda(_rand_src(L), p)
+    assert p.solve_attempts[0]["status"] == "unverified"
+    assert p.solve_status == "converged"
+    names = [e["name"] for e in _trace_names(tmp_path)]
+    assert "verify_mismatch" in names and "solve_retry" in names
+
+
+def test_verify_mode_records_status_without_retry(tmp_path, monkeypatch):
+    """QUDA_TPU_ROBUST=verify: statuses recorded, no ladder."""
+    from quda_tpu.interfaces.params import GaugeParam
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              invert_quda,
+                                              load_gauge_quda)
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    qconf.reset_cache()
+    init_quda()
+    L = 4
+    load_gauge_quda(_unit_gauge(L), GaugeParam(X=(L,) * 4,
+                                               cuda_prec="single"))
+    finj.arm("dslash", "5")
+    p = _wilson_param()
+    invert_quda(_rand_src(L), p)
+    assert p.solve_status == "breakdown:nonfinite"
+    assert not p.converged
+    assert p.solve_attempts == ()      # no ladder ran
+    # clean solve: verified converged
+    p2 = _wilson_param()
+    invert_quda(_rand_src(L), p2)
+    assert p2.solve_status == "converged" and p2.converged
+    assert 0.0 < p2.verified_res <= 100 * p2.tol
+    end_quda()
+
+
+# -- zero-overhead: off means off -------------------------------------------
+
+def test_robust_off_runs_no_robust_code(tmp_path, monkeypatch):
+    """QUDA_TPU_ROBUST=off (the default) must add NOTHING to the
+    compiled solve: no sentinel construction, no sentinel steps, no
+    fault corruption, no ladder — enforced raising-stub style (the
+    tests/test_observability.py discipline).  The solver result carries
+    breakdown=None, so the loop carry is the pre-robust structure."""
+    from quda_tpu.interfaces.params import GaugeParam
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              invert_quda,
+                                              load_gauge_quda)
+    monkeypatch.delenv("QUDA_TPU_ROBUST", raising=False)
+    monkeypatch.delenv("QUDA_TPU_FAULT", raising=False)
+    qconf.reset_cache()
+
+    def _boom(*a, **kw):
+        raise AssertionError("robust code ran with QUDA_TPU_ROBUST=off")
+
+    monkeypatch.setattr(rsent.Sentinel, "__init__", _boom)
+    monkeypatch.setattr(rsent.Sentinel, "step", _boom)
+    monkeypatch.setattr(finj, "corrupt", _boom)
+    monkeypatch.setattr(resc, "run_ladder", _boom)
+    init_quda()
+    L = 4
+    load_gauge_quda(_unit_gauge(L), GaugeParam(X=(L,) * 4,
+                                               cuda_prec="single"))
+    p = _wilson_param()
+    x = invert_quda(_rand_src(L), p)
+    # results as today; no robust fields were populated
+    assert p.true_res <= 1e-5
+    assert p.solve_status == "" and p.solve_attempts == ()
+    assert p.verified_res == 0.0
+    # the always-on unconverged flag still works (no new device ops)
+    assert p.converged is True
+    assert np.isfinite(np.asarray(x)).all()
+    end_quda()
+
+    # solver level: breakdown is structurally absent at off
+    from quda_tpu.solvers.fused_iter import fused_cg
+    mv, b = _diag_system()
+    res = fused_cg(mv, b, tol=1e-6, maxiter=200)
+    assert res.breakdown is None
+
+
+# -- unconverged results are no longer silent --------------------------------
+
+def test_unconverged_flag_and_one_time_warning(tmp_path, monkeypatch,
+                                               capsys):
+    """A solve exiting at maxiter without meeting tol sets
+    converged=False and warns ONCE — with robust fully off."""
+    from quda_tpu.interfaces.params import GaugeParam
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              invert_quda,
+                                              load_gauge_quda)
+    monkeypatch.delenv("QUDA_TPU_ROBUST", raising=False)
+    qconf.reset_cache()
+    init_quda()
+    L = 4
+    load_gauge_quda(_unit_gauge(L), GaugeParam(X=(L,) * 4,
+                                               cuda_prec="single"))
+    p = _wilson_param(tol=1e-10, maxiter=3)     # cannot converge in 3
+    invert_quda(_rand_src(L), p)
+    assert p.converged is False
+    assert p.iter_count >= 3
+    err = capsys.readouterr().err
+    assert "without meeting tol" in err
+    # second unconverged solve: flagged on the param, quiet on stderr
+    p2 = _wilson_param(tol=1e-10, maxiter=3)
+    invert_quda(_rand_src(L), p2)
+    assert p2.converged is False
+    assert "without meeting tol" not in capsys.readouterr().err
+    # a converged solve keeps the default True
+    p3 = _wilson_param()
+    invert_quda(_rand_src(L), p3)
+    assert p3.converged is True
+    end_quda()
+
+
+def test_bench_gate_rejects_unconverged_rows():
+    """bench_suite solver rows carry converged; the gate refuses a
+    converged=False row so unconverged timings cannot be laundered."""
+    from bench import gate_row
+    row = {"name": "cg_x", "iters": 600, "secs": 1.0, "gflops": 10.0,
+           "converged": False, "platform": "cpu", "lattice": [16] * 4}
+    ok, reason = gate_row("solver", row, banner_platform="cpu")
+    assert not ok and "unconverged" in reason
+    row["converged"] = True
+    ok, _ = gate_row("solver", row, banner_platform="cpu")
+    assert ok
+    # rows without the key (non-solver suites) are unaffected
+    ok, _ = gate_row("blas", {"name": "axpy", "gbps": 1.0,
+                              "secs_per_call": 0.01, "platform": "cpu"},
+                     banner_platform="cpu")
+    assert ok
+
+
+# -- gauge-load validation ---------------------------------------------------
+
+def test_gauge_load_rejects_nonfinite(tmp_path, monkeypatch):
+    from quda_tpu.interfaces.params import GaugeParam
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              load_gauge_quda)
+    from quda_tpu.utils.logging import QudaError
+    monkeypatch.setenv("QUDA_TPU_TRACE", "1")
+    monkeypatch.setenv("QUDA_TPU_TRACE_PATH", str(tmp_path))
+    qconf.reset_cache()
+    init_quda()
+    L = 4
+    bad = _unit_gauge(L)
+    bad[0, 0, 0, 0, 0, 0, 0] = np.nan
+    with pytest.raises(QudaError, match="non-finite link"):
+        load_gauge_quda(bad, GaugeParam(X=(L,) * 4,
+                                        cuda_prec="single"))
+    names = [e["name"] for e in _trace_names(tmp_path)]
+    assert "gauge_rejected" in names
+    # the fault site drills the same rejection on clean input
+    finj.arm("gauge", "1")
+    with pytest.raises(QudaError, match="non-finite link"):
+        load_gauge_quda(_unit_gauge(L), GaugeParam(X=(L,) * 4,
+                                                   cuda_prec="single"))
+    assert finj.fired("gauge")
+    end_quda()
+
+
+def test_gauge_load_unitarity_screen(monkeypatch, capsys):
+    from quda_tpu.interfaces.params import GaugeParam
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              load_gauge_quda)
+    from quda_tpu.ops.su3 import project_su3, unitarity_deviation
+    monkeypatch.setenv("QUDA_TPU_GAUGE_UNITARITY_TOL", "1e-3")
+    qconf.reset_cache()
+    init_quda()
+    L = 4
+    g = _unit_gauge(L)
+    g[1] *= 1.05                       # finite but 5% off unitary
+    load_gauge_quda(g, GaugeParam(X=(L,) * 4, cuda_prec="single"))
+    err = capsys.readouterr().err
+    assert "unitarity deviation" in err and "reunitarize" in err
+    # the reunitarize machinery repairs it below the screen
+    fixed = np.asarray(project_su3(jnp.asarray(g)))
+    assert float(unitarity_deviation(jnp.asarray(fixed))) < 1e-3
+    load_gauge_quda(fixed, GaugeParam(X=(L,) * 4, cuda_prec="single"))
+    assert "unitarity deviation" not in capsys.readouterr().err
+    end_quda()
+
+
+# -- multi-src / multishift statuses ----------------------------------------
+
+def test_multishift_supervision(tmp_path, monkeypatch):
+    from quda_tpu.interfaces.params import GaugeParam, InvertParam
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              invert_multishift_quda,
+                                              load_gauge_quda)
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    qconf.reset_cache()
+    init_quda()
+    L = 4
+    load_gauge_quda(_unit_gauge(L), GaugeParam(X=(L,) * 4,
+                                               cuda_prec="single"))
+    p = InvertParam(dslash_type="wilson", inv_type="multi-shift-cg",
+                    solve_type="normop-pc", kappa=0.12, tol=1e-6,
+                    maxiter=400, cuda_prec="single", num_offset=2,
+                    offset=(0.05, 0.3))
+    invert_multishift_quda(_rand_src(L), p)
+    assert p.converged_multi == [True, True]
+    assert p.converged and p.solve_status == "converged"
+    end_quda()
+
+
+def test_multi_src_supervision_and_fallback_rollup(tmp_path,
+                                                   monkeypatch):
+    from quda_tpu.interfaces.params import GaugeParam
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              invert_multi_src_quda,
+                                              load_gauge_quda)
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    monkeypatch.setenv("QUDA_TPU_MULTI_SRC_SPLIT", "0")
+    qconf.reset_cache()
+    init_quda()
+    L = 4
+    load_gauge_quda(_unit_gauge(L), GaugeParam(X=(L,) * 4,
+                                               cuda_prec="single"))
+    srcs = np.stack([_rand_src(L, seed=i) for i in range(2)])
+    p = _wilson_param()
+    invert_multi_src_quda(srcs, p)
+    assert p.converged_multi == [True, True]
+    assert p.converged and p.solve_status == "converged"
+    end_quda()
+
+
+# -- fault-injection registry ------------------------------------------------
+
+def test_fault_registry_parse_arm_reset(monkeypatch):
+    monkeypatch.setenv("QUDA_TPU_FAULT", "dslash:7, residual:1e3")
+    qconf.reset_cache()
+    finj.reset()
+    assert finj.armed("dslash") == "7"
+    assert finj.iteration_fault("dslash") == 7
+    assert finj.iteration_fault("dslash") is None      # one-shot
+    assert finj.inflated_residual(1e-8) == pytest.approx(1e-5)
+    assert finj.inflated_residual(1e-8) == 1e-8        # one-shot
+    assert [f["site"] for f in finj.fired()] == ["dslash", "residual"]
+    finj.reset()
+    monkeypatch.delenv("QUDA_TPU_FAULT")
+    qconf.reset_cache()
+    assert finj.armed("dslash") is None
+    with pytest.raises(ValueError, match="unknown fault site"):
+        finj.arm("dslah", "1")
+
+
+def test_fault_pallas_build_countdown():
+    finj.arm("pallas_build", "2")
+    for _ in range(2):
+        with pytest.raises(finj.InjectedFault):
+            finj.maybe_raise("pallas_build")
+    finj.maybe_raise("pallas_build")       # disarmed: no raise
+    assert len(finj.fired("pallas_build")) == 2
+
+
+# -- config override stack ---------------------------------------------------
+
+def test_config_overrides_scoped(monkeypatch):
+    monkeypatch.setenv("QUDA_TPU_PALLAS", "1")
+    qconf.reset_cache()
+    assert qconf.get("QUDA_TPU_PALLAS", fresh=True) == "1"
+    with qconf.overrides(QUDA_TPU_PALLAS="0"):
+        assert qconf.get("QUDA_TPU_PALLAS", fresh=True) == "0"
+        with qconf.overrides(QUDA_TPU_PALLAS="1"):
+            assert qconf.get("QUDA_TPU_PALLAS", fresh=True) == "1"
+        assert qconf.get("QUDA_TPU_PALLAS", fresh=True) == "0"
+    assert qconf.get("QUDA_TPU_PALLAS", fresh=True) == "1"
+    with pytest.raises(KeyError, match="unregistered"):
+        qconf.overrides(QUDA_TPU_NOT_A_KNOB="1")

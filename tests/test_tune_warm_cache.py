@@ -139,3 +139,79 @@ def test_init_quda_preloads_warm_cache(tmp_path, monkeypatch):
              open(tmp_path / "trace_events.jsonl")]
     names = [ln["name"] for ln in lines]
     assert "tune_cache_loaded" in names and "tune_cached" in names
+
+
+# -- race resilience (robust round: failing candidates never win) ------------
+
+def test_raising_candidate_is_skipped_and_never_cached(tmp_path,
+                                                       monkeypatch):
+    """A candidate that raises ON-CHIP mid-race is marked failed
+    (tune_candidate_failed event) and the race still returns a usable
+    winner; the failed candidate must never be the cached param."""
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    qconf.reset_cache()
+    otr.start(str(tmp_path))
+    x = jnp.ones((8, 8))
+
+    calls = {"n": 0}
+
+    def mid_race_boom(a):
+        # raises AFTER a successful warmup call — the mid-race (not
+        # at-construction) failure mode: the timing loop itself throws
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise RuntimeError("device raised mid-race")
+        return a + 1.0
+
+    won = tune.tune("race_op", (8, 8),
+                    {"breaks": mid_race_boom,
+                     "works": jax.jit(lambda a: a * 2.0)}, (x,),
+                    aux="resil")
+    assert won == "works"
+    assert tune.cached_param("race_op", (8, 8), aux="resil") == "works"
+    paths = otr.stop()
+    lines = [json.loads(ln) for ln in open(paths["jsonl"])]
+    failed = [ln for ln in lines if ln["name"] == "tune_candidate_failed"]
+    assert failed and failed[0]["param"] == "breaks"
+    winner = [ln for ln in lines if ln["name"] == "tune_winner"]
+    assert winner and winner[0]["param"] == "works"
+
+
+def test_all_candidates_fail_degrades_to_static_default(tmp_path,
+                                                        monkeypatch,
+                                                        capsys):
+    """An all-candidates-fail race must DEGRADE to the static default
+    (the first registered candidate — the tuning-disabled convention)
+    with a one-time notice instead of raising, and must NOT cache the
+    untimed fallback (the next process re-races)."""
+    from quda_tpu.utils import logging as qlog
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    qconf.reset_cache()
+    monkeypatch.setattr(qlog, "_warned_once", set())
+    otr.start(str(tmp_path))
+    x = jnp.ones((8, 8))
+
+    def boom_a(a):
+        raise RuntimeError("a failed")
+
+    def boom_b(a):
+        raise RuntimeError("b failed")
+
+    won = tune.tune("allfail_op", (8, 8),
+                    {"default": boom_a, "other": boom_b}, (x,),
+                    aux="af")
+    assert won == "default"
+    # the degraded choice was never timed -> not cached, re-raced later
+    assert tune.cached_param("allfail_op", (8, 8), aux="af") is None
+    err = capsys.readouterr().err
+    assert "every candidate failed" in err
+    assert "static default" in err
+    # one-time: a second all-fail race stays quiet on stderr
+    tune.tune("allfail_op", (8, 8), {"default": boom_a}, (x,), aux="af2")
+    assert "every candidate failed" not in capsys.readouterr().err
+    paths = otr.stop()
+    lines = [json.loads(ln) for ln in open(paths["jsonl"])]
+    allfail = [ln for ln in lines if ln["name"] == "tune_race_all_failed"]
+    assert len(allfail) == 2 and allfail[0]["fallback"] == "default"
+    assert len([ln for ln in lines
+                if ln["name"] == "tune_candidate_failed"]) == 3
