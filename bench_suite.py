@@ -46,15 +46,20 @@ def _emit(suite, name, secs, flops, bytes_, platform, lattice,
     # HERE, loudly.  secs is rounded to 9 digits so a genuine ~1 us
     # marginal cannot quantize DOWN to the gate's 1e-6 floor and be
     # rejected as noise.
+    from quda_tpu.obs import metrics as qmet
     from quda_tpu.obs.roofline import achieved
     th = achieved(flops, bytes_, secs)
-    record_row(suite, {
+    ok = record_row(suite, {
         "name": name,
         "gflops": th["gflops"],
         "gbps": th["gbps"],
         "secs_per_call": round(secs, 9),
         "platform": platform, "lattice": list(lattice), **extra,
     }, banner_platform=banner)
+    # count only rows the gate actually recorded — a rejected row in
+    # the counter would overstate a partially-failing suite's output
+    if ok:
+        qmet.inc("bench_rows_total", suite=suite)
 
 
 def _bench_op(fn, arg, consts=(), n1=8, n2=200, reps=3):
@@ -149,6 +154,10 @@ def main(argv):
     do_compare = "--compare" in argv
     dry = "--dry" in argv
 
+    # --metrics: serving-metrics registry over the whole run (also on
+    # when the QUDA_TPU_METRICS knob is set), exported at suite end
+    do_metrics = "--metrics" in argv or bool(_conf("QUDA_TPU_METRICS"))
+
     # value flags are popped up front with the regress CLI's own parser
     # (one parser, both entry points, --flag X and --flag=X forms) so a
     # space-separated value can never be mistaken for a suite name
@@ -215,6 +224,13 @@ def main(argv):
     if do_trace:
         from quda_tpu.obs import trace as qtrace
         qtrace.start(os.getcwd(), prefix="bench_trace")
+    if do_metrics:
+        # --metrics (or QUDA_TPU_METRICS=1): run the suite under the
+        # serving-metrics registry — bench row counts, tuner warm-cache
+        # hit/miss, compile accounting — and export metrics.prom /
+        # metrics.tsv / fleet_report.txt next to the bench output
+        from quda_tpu.obs import metrics as qmet
+        qmet.start(os.getcwd())
 
     def suite_guard(suite: str) -> bool:
         """Window hygiene (VERDICT r7 #10): every suite re-checks the
@@ -1166,6 +1182,12 @@ def main(argv):
         paths = qtrace.stop()
         if paths:
             print(json.dumps({"suite": "harness", "trace": paths}),
+                  flush=True)
+    from quda_tpu.obs import metrics as qmet
+    if qmet.enabled():
+        paths = qmet.stop()
+        if paths:
+            print(json.dumps({"suite": "harness", "metrics": paths}),
                   flush=True)
 
     rc = 0

@@ -39,6 +39,7 @@ _ctx = {
 
 def init_quda(device: int = 0):
     """initQuda analog (device selection is PJRT's job on TPU)."""
+    from ..obs import metrics as omet
     from ..obs import trace as otr
     from ..utils import config as qconf
     from ..utils import monitor as qmon
@@ -46,6 +47,7 @@ def init_quda(device: int = 0):
     qconf.check_environment()  # warn on typoed / CUDA-era env knobs
     qmon.start_default()       # QUDA_TPU_ENABLE_MONITOR sampling thread
     otr.maybe_start()          # QUDA_TPU_TRACE span/event session
+    omet.maybe_start()         # QUDA_TPU_METRICS counter/gauge registry
     # warm-start the chip-keyed tuner cache (tune.cpp persistent-cache
     # behavior): a fresh worker with a shared QUDA_TPU_RESOURCE_PATH
     # serves its first solve from already-raced (platform, volume,
@@ -92,23 +94,50 @@ def end_quda():
         _ctx[k] = None if k != "initialized" else False
     _ctx["gauge_epoch"] = keep_epoch
     _ctx["mg_epoch"] = -1
-    from ..utils import monitor as qmon
-    qmon.stop_default()
     # shutdown telemetry flush (endQuda summary semantics): the timer
     # summary + profile.tsv, the tuner's profiler half (profile_0.tsv),
-    # the roofline rows, and the trace session artifacts
-    from ..utils.timer import print_summary
-    print_summary()
-    from ..utils import tune as qtune
-    qtune.save_profile()
+    # the roofline rows, the metrics export + fleet report, and the
+    # trace session artifacts.  Every step runs even when an earlier
+    # one raises (a broken profile writer must not eat the trace of the
+    # crashed session it would explain) — the first error is re-raised
+    # AFTER the epilogue completes.
+    from ..obs import memory as omem
+    from ..obs import metrics as omet
     from ..obs import roofline as orf
     from ..obs import trace as otr
-    orf.save()
-    orf.reset()     # a later init/end cycle must not re-dump these rows
-    paths = otr.stop()
-    if paths:
-        qlog.printq(f"trace artifacts: {paths['chrome']} / "
-                    f"{paths['jsonl']}", qlog.SUMMARIZE)
+    from ..utils import monitor as qmon
+    from ..utils import tune as qtune
+    from ..utils.timer import print_summary
+
+    def _flush_metrics():
+        try:
+            paths = omet.stop()
+            if paths:
+                qlog.printq(f"metrics artifacts: {paths['prom']} / "
+                            f"{paths['report']}", qlog.SUMMARIZE)
+        finally:
+            # the ledger follows the resident fields _ctx drops — even
+            # when the flush raised (unwritable path), or the next
+            # session would report this one's fields as still resident
+            omem.reset()
+
+    def _flush_trace():
+        paths = otr.stop()
+        if paths:
+            qlog.printq(f"trace artifacts: {paths['chrome']} / "
+                        f"{paths['jsonl']}", qlog.SUMMARIZE)
+
+    errors = []
+    for step in (qmon.stop_default, print_summary, qtune.save_profile,
+                 orf.save,
+                 orf.reset,  # a later init/end must not re-dump rows
+                 _flush_metrics, _flush_trace):
+        try:
+            step()
+        except Exception as e:   # noqa: BLE001 — epilogue must finish
+            errors.append(e)
+    if errors:
+        raise errors[0]
 
 
 def _require_init():
@@ -118,9 +147,13 @@ def _require_init():
 
 def _set_resident_gauge(g):
     """Every resident-gauge mutation goes through here so the MG
-    staleness guard (gauge_epoch) can never miss one."""
+    staleness guard (gauge_epoch) can never miss one — and so the HBM
+    ledger re-tracks the resident bytes on every mutation (smear, HMC
+    update, gauss) with one row, not a leak."""
     _ctx["gauge"] = g
     _ctx["gauge_epoch"] += 1
+    from ..obs import memory as omem
+    omem.track("gauge", "resident_gauge", g)
 
 
 def load_gauge_quda(gauge, param: GaugeParam):
@@ -181,6 +214,8 @@ def load_gauge_quda(gauge, param: GaugeParam):
 
 def free_gauge_quda():
     _ctx["gauge"] = None
+    from ..obs import memory as omem
+    omem.release("gauge", "resident_gauge")
 
 
 def _antiperiodic():
@@ -497,6 +532,7 @@ def _invert_wilson_df64(b, param: InvertParam, d, sloppy_prec: str,
         sl = dpk.pairs(store, use_pallas=_pallas_enabled(on_tpu),
                        pallas_interpret=_pallas_interpret(on_tpu))
         codec = solvers.pair_inplace_codec(store)
+    t_solve0 = time.perf_counter()
     with otr.phase("compute", "invert_quda"), \
             otr.span("solve:cg_reliable_df64", cat="solver",
                      tol=param.tol):
@@ -504,6 +540,7 @@ def _invert_wilson_df64(b, param: InvertParam, d, sloppy_prec: str,
             op, sl.MdagM_pairs, rhs_df, codec, tol=param.tol,
             maxiter=param.maxiter, delta=param.reliable_delta,
             record=recording)
+    t_solve = time.perf_counter() - t_solve0
 
     xe_df, xo_df = op.reconstruct_df(res.x, be, bo)
     fr2 = float(dfm.to_f32(op.full_residual_norm2(xe_df, xo_df, be, bo)))
@@ -516,6 +553,9 @@ def _invert_wilson_df64(b, param: InvertParam, d, sloppy_prec: str,
     param.x_df64_lo = _join(xe_lo, xo_lo, param)
     param.iter_count = int(res.iters)
     param.secs = time.perf_counter() - t0
+    _record_solve_metrics("invert_quda", "wilson_df64",
+                          "cg-reliable-df64", t_solve,
+                          param.dslash_type, param.cuda_prec)
     flops = getattr(dpk, "flops_per_site_M", lambda: 0)()
     # PC operator: flops_per_site_M counts per UPDATED site, and a PC
     # operator updates one parity — volume/2 sites (see invert_quda's
@@ -561,10 +601,24 @@ def _solve_supervision(param, api: str, converged=None, breakdown=None,
 
     import numpy as np
 
+    from ..obs import metrics as omet
     from ..obs import trace as otr
     from ..robust import faultinject as finj
     from ..robust import sentinel as rsent
     from ..utils import config as qconf
+
+    def _count_solve():
+        # fleet solve accounting (metrics off -> single-global-load
+        # no-ops): one solves_total increment per supervised attempt,
+        # labeled by the FINAL status (solve_status when robust
+        # classified the exit, the convergence claim otherwise)
+        status = (getattr(param, "solve_status", None)
+                  or ("converged" if param.converged else "unconverged"))
+        omet.inc("solves_total", api=api, family=param.dslash_type,
+                 status=status)
+        omet.inc("solve_iterations_total",
+                 float(getattr(param, "iter_count", 0) or 0),
+                 api=api, family=param.dslash_type)
 
     if converged_multi is not None:
         param.converged_multi = [bool(c) for c in
@@ -583,6 +637,7 @@ def _solve_supervision(param, api: str, converged=None, breakdown=None,
             "False — further occurrences are flagged silently on the "
             "param")
     if not rsent.active():
+        _count_solve()
         return
     vres = finj.inflated_residual(float(param.true_res))
     param.verified_res = vres
@@ -594,6 +649,7 @@ def _solve_supervision(param, api: str, converged=None, breakdown=None,
         otr.event("breakdown_detected", cat="robust", api=api,
                   reason=rsent.reason(bk), solver=param.inv_type,
                   iters=param.iter_count)
+        omet.inc("breakdowns_total", api=api, reason=rsent.reason(bk))
         qlog.warn_once(
             f"breakdown:{api}:{rsent.reason(bk)}",
             f"{api}: breakdown sentinel tripped "
@@ -614,6 +670,7 @@ def _solve_supervision(param, api: str, converged=None, breakdown=None,
             f"{margin:g} * tol — status 'unverified'")
     else:
         param.solve_status = "converged"
+    _count_solve()
 
 
 def _solve_form(d) -> str:
@@ -675,7 +732,8 @@ def invert_quda(source, param: InvertParam):
     from ..obs import trace as otr
     from ..robust import escalate as resc
     with otr.api_span("invert_quda", dslash=param.dslash_type,
-                      inv=param.inv_type, tol=param.tol):
+                      inv=param.inv_type, tol=param.tol), \
+            _hbm_sampled("invert_quda"):
         if resc.enabled():
             # QUDA_TPU_ROBUST=escalate: drive the attempt through the
             # bounded retry ladder (robust/escalate.py) — breakdown,
@@ -684,6 +742,55 @@ def invert_quda(source, param: InvertParam):
             return resc.run_ladder(_invert_quda_body, source, param,
                                    api="invert_quda")
         return _invert_quda_body(source, param)
+
+
+import contextlib
+
+# ledger families whose fields live only for the duration of one API
+# call (clover terms rebuilt per _build_dirac; eig workspaces handed to
+# the caller at return) — released when the call exits so "resident
+# now" stays honest while the family HIGH-WATER keeps the peak signal.
+# gauge/fat_naik/mg are genuinely resident (_ctx) and are NOT listed.
+_TRANSIENT_FAMILIES = ("clover", "eig")
+
+
+@contextlib.contextmanager
+def _hbm_sampled(api: str):
+    """HBM sampling around an API solve (metrics-gated: zero work when
+    QUDA_TPU_METRICS is off): all-local-device memory_stats snapshots
+    on entry and exit feed the per-device gauges and the session
+    high-water marks of the memory ledger (obs/memory.py).  Transient
+    per-call ledger families are released on exit."""
+    from ..obs import memory as omem
+    from ..obs import metrics as omet
+    if omet.enabled():
+        omem.sample(f"{api}:enter")
+    try:
+        yield
+    finally:
+        for fam in _TRANSIENT_FAMILIES:
+            omem.release_family(fam)
+        if omet.enabled():
+            omem.sample(f"{api}:exit")
+
+
+def _record_solve_metrics(api: str, form: str, solver: str,
+                          secs: float, family: str, prec: str):
+    """The ONE home for per-route compile/execution accounting: first
+    execution of a distinct (api, form, shape, prec, solver) key
+    counts a compile (obs/metrics.record_execution), every execution
+    lands a solve_seconds sample.  INVARIANT carried here so no route
+    can drift: ``secs`` is the COMPUTE-PHASE time of the route (never
+    the full API wall incl. setup), or cross-form histogram
+    comparisons — the compile/race-storm instrument — are skewed.
+    No-op when QUDA_TPU_METRICS is off."""
+    from ..obs import metrics as omet
+    if not omet.enabled():
+        return
+    geom = _ctx["geom"]
+    shape = geom.lattice_shape if geom is not None else ()
+    omet.record_execution(api, form, shape, prec, solver, secs)
+    omet.observe("solve_seconds", secs, api=api, family=family)
 
 
 def _invert_quda_body(source, param: InvertParam):
@@ -883,6 +990,12 @@ def _invert_quda_body(source, param: InvertParam):
     res, publish_sys_rhs = res
     t_solve = time.perf_counter() - t_solve0
 
+    # compile/executable-cache accounting: the first compute phase of a
+    # distinct (form, shape, prec, solver) key paid the XLA compile
+    # inside t_solve
+    _record_solve_metrics("invert_quda", _solve_form(d), inv, t_solve,
+                          param.dslash_type, param.cuda_prec)
+
     with otr.phase("epilogue", "invert_quda"):
         x_sys = back(res.x)
         if pc:
@@ -1038,10 +1151,18 @@ def _invert_dispatch(param, d, d_full, b, rhs, sys_rhs, mv, mv_applies,
         res = fn(mv, sys_rhs, tol=param.tol,
                  max_cycles=max(1, param.maxiter // 8))
     elif inv == "gcr-mg":
+        t_mg0 = time.perf_counter()
         res, pair_true_res = _solve_mg(d_full, b, param)
+        t_mg = time.perf_counter() - t_mg0
         x_full = res.x
         param.iter_count = int(res.iters)
         param.secs = time.perf_counter() - t0
+        # this route returns before _invert_quda_body's shared
+        # accounting call — record here or MG (the costliest compile in
+        # the system) stays invisible to the compile/race-storm
+        # instrument; t_mg is the setup+solve call only
+        _record_solve_metrics("invert_quda", "gcr_mg", inv, t_mg,
+                              param.dslash_type, param.cuda_prec)
         # fine-operator work only (V-cycle smoother/coarse flops not
         # charged — same convention as QUDA's outer-solver gflops)
         param.gflops = (param.iter_count
@@ -1103,7 +1224,8 @@ def invert_multi_src_quda(sources, param: InvertParam):
     from ..obs import trace as otr
     from ..robust import escalate as resc
     with otr.api_span("invert_multi_src_quda", dslash=param.dslash_type,
-                      inv=param.inv_type, n_src=len(sources)):
+                      inv=param.inv_type, n_src=len(sources)), \
+            _hbm_sampled("invert_multi_src_quda"):
         if resc.enabled():
             return resc.run_ladder(_invert_multi_src_body, sources,
                                    param, api="invert_multi_src_quda")
@@ -1250,10 +1372,15 @@ def _invert_multi_src_body(sources, param: InvertParam):
 
         # pass the RAW resident gauge; each sub-grid folds the boundary
         # phase inside its own trace (DiracWilsonPC does it)
+        t_solve0 = time.perf_counter()
         with otr.phase("compute", "invert_multi_src_quda",
                        route="split_grid"):
             x_full, iters, conv_l, bk_l = split_grid_solve(
                 solve_one, _ctx["gauge"], B, mesh)
+        _record_solve_metrics("invert_multi_src_quda",
+                              "wilson_split_grid", param.inv_type,
+                              time.perf_counter() - t_solve0,
+                              param.dslash_type, param.cuda_prec)
         with otr.phase("epilogue", "invert_multi_src_quda"):
             d_chk = _build_dirac(param, False)
             res_rhs = [float(jnp.sqrt(blas.norm2(B[i]
@@ -1320,6 +1447,11 @@ def _invert_multi_src_body(sources, param: InvertParam):
                                        record=recording)
                 iters_rhs = np.asarray(res.iters)
         t_solve = time.perf_counter() - t_solve0
+        _record_solve_metrics(
+            "invert_multi_src_quda",
+            ("staggered" if stag_family else "wilson")
+            + "_batched_pairs",
+            solver_name, t_solve, param.dslash_type, param.cuda_prec)
         conv = np.asarray(res.converged)
         if not conv.all():
             qlog.warningq(
@@ -1478,6 +1610,8 @@ def _solve_mg(d_full, b, param: InvertParam, mg_param=None):
                                  mg=mg)
         _ctx["mg"] = mg
         _ctx["mg_epoch"] = _ctx["gauge_epoch"]
+        from ..obs import memory as omem
+        omem.track("mg", "hierarchy", mg)
         # true residual in pair arithmetic (no complex op on device) —
         # measured against the operator the outer solve targeted
         # (M_std_full = fat+Naik for improved staggered)
@@ -1495,6 +1629,8 @@ def _solve_mg(d_full, b, param: InvertParam, mg_param=None):
                        nkrylov=param.gcrNkrylov, mg=mg)
     _ctx["mg"] = mg
     _ctx["mg_epoch"] = _ctx["gauge_epoch"]
+    from ..obs import memory as omem
+    omem.track("mg", "hierarchy", mg)
     return res, None
 
 
@@ -1514,6 +1650,8 @@ def new_multigrid_quda(mg_param: MultigridParamAPI, invert_param: InvertParam):
     else:
         _ctx["mg"] = MG(d, _ctx["geom"], params)
     _ctx["mg_epoch"] = _ctx["gauge_epoch"]
+    from ..obs import memory as omem
+    omem.track("mg", "hierarchy", _ctx["mg"])
     return _ctx["mg"]
 
 
@@ -1529,6 +1667,8 @@ def update_multigrid_quda(mg_param: MultigridParamAPI,
 
 def destroy_multigrid_quda():
     _ctx["mg"] = None
+    from ..obs import memory as omem
+    omem.release("mg", "hierarchy")
 
 
 def invert_multishift_quda(source, param: InvertParam):
@@ -1539,7 +1679,8 @@ def invert_multishift_quda(source, param: InvertParam):
     from ..robust import escalate as resc
     with otr.api_span("invert_multishift_quda",
                       dslash=param.dslash_type,
-                      n_shifts=len(param.offset)):
+                      n_shifts=len(param.offset)), \
+            _hbm_sampled("invert_multishift_quda"):
         if resc.enabled():
             return resc.run_ladder(_invert_multishift_body, source,
                                    param, api="invert_multishift_quda")
@@ -1587,6 +1728,9 @@ def _invert_multishift_body(source, param: InvertParam):
         mv_per_iter = 1.0 if getattr(d, "hermitian", False) else 2.0
         param.gflops = ((param.iter_count * mv_per_iter + n_extra_mv)
                         * flops * sites) / 1e9
+        _record_solve_metrics("invert_multishift_quda", _solve_form(d),
+                              "multishift-cg", param.secs,
+                              param.dslash_type, param.cuda_prec)
 
     on_tpu = jax.default_backend() == "tpu"
     if (param.dslash_type in ("staggered", "asqtad", "hisq")
@@ -1743,7 +1887,8 @@ def eigensolve_quda(eig_param: EigParamAPI, invert_param: InvertParam):
     from ..obs import trace as otr
     with otr.api_span("eigensolve_quda", eig_type=eig_param.eig_type,
                       n_ev=eig_param.n_ev,
-                      dslash=invert_param.dslash_type):
+                      dslash=invert_param.dslash_type), \
+            _hbm_sampled("eigensolve_quda"):
         return _eigensolve_body(eig_param, invert_param)
 
 
@@ -1799,9 +1944,20 @@ def _eigensolve_body(eig_param: EigParamAPI, invert_param: InvertParam):
             ex_pp = jnp.zeros((3, 2, T, Z, Y * X // 2), jnp.float32)
             pair_axis = 1
             conv = ad.op._from_pairs
+        t_eig0 = time.perf_counter()
         with otr.phase("compute", "eigensolve_quda",
                        solver="trlm_pairs"):
             res = trlm_pairs(mv, ex_pp, p, pair_axis)
+        from ..obs import memory as omem
+        from ..obs import metrics as omet
+        _record_solve_metrics("eigensolve_quda", "trlm_pairs",
+                              eig_param.eig_type,
+                              time.perf_counter() - t_eig0,
+                              invert_param.dslash_type,
+                              invert_param.cuda_prec)
+        omet.inc("eigensolves_total", family=invert_param.dslash_type,
+                 eig_type=eig_param.eig_type)
+        omem.track("eig", "evecs_trlm_pairs", res.evecs)
         if res.evecs.shape[0] < eig_param.n_ev:
             qlog.printq(
                 f"eigensolve (pair route): only {res.evecs.shape[0]} of "
@@ -1832,6 +1988,7 @@ def _eigensolve_body(eig_param: EigParamAPI, invert_param: InvertParam):
         op = d.M if getattr(d, "hermitian", False) else d.MdagM
     else:
         op = d.M
+    t_eig0 = time.perf_counter()
     with otr.phase("compute", "eigensolve_quda",
                    solver=eig_param.eig_type):
         if eig_param.eig_type == "trlm":
@@ -1843,6 +2000,16 @@ def _eigensolve_body(eig_param: EigParamAPI, invert_param: InvertParam):
                                hermitian=eig_param.use_norm_op)
         else:
             res = iram(op, example, p)
+    from ..obs import memory as omem
+    from ..obs import metrics as omet
+    _record_solve_metrics("eigensolve_quda", eig_param.eig_type,
+                          eig_param.eig_type,
+                          time.perf_counter() - t_eig0,
+                          invert_param.dslash_type,
+                          invert_param.cuda_prec)
+    omet.inc("eigensolves_total", family=invert_param.dslash_type,
+             eig_type=eig_param.eig_type)
+    omem.track("eig", f"evecs_{eig_param.eig_type}", res.evecs)
     if eig_param.vec_outfile:
         from ..utils.io import save_vectors
         save_vectors(eig_param.vec_outfile, res.evecs, res.evals)
@@ -1935,18 +2102,24 @@ def compute_ks_link_quda(naik_eps: float = 0.0):
     """computeKSLinkQuda: HISQ fatten the resident gauge; keep fat/long
     resident for staggered inverts."""
     from ..gauge.hisq import hisq_fattening
+    from ..obs import memory as omem
     _require_init()
     links = hisq_fattening(_ctx["gauge"], naik_eps)
     _ctx["fat"] = links.fat
     _ctx["long"] = links.long
+    omem.track("fat_naik", "fat_links", links.fat)
+    omem.track("fat_naik", "long_links", links.long)
     return links
 
 
 def load_fat_long_quda(fat, long_links):
+    from ..obs import memory as omem
     _require_init()
     dtype = _ctx["gauge"].dtype if _ctx["gauge"] is not None else None
     _ctx["fat"] = jnp.asarray(fat, dtype)
     _ctx["long"] = jnp.asarray(long_links, dtype)
+    omem.track("fat_naik", "fat_links", _ctx["fat"])
+    omem.track("fat_naik", "long_links", _ctx["long"])
 
 
 def save_gauge_field_quda(path: str, precision: int = 64):

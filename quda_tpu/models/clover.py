@@ -40,6 +40,8 @@ class DiracClover(Dirac):
         # F_munu leaves use the PHYSICAL links (no BC phase): QUDA computes
         # the clover term before applying fermion boundary conditions.
         self.clover = clover_blocks(gauge, kappa * csw / 2.0)
+        from ..obs import memory as omem
+        omem.track("clover", "clover_blocks", self.clover)
 
     def D(self, psi):
         return wops.dslash_full(self.gauge, psi)
@@ -80,6 +82,9 @@ class DiracCloverPC(DiracPC):
         self.clover = (a_e, a_o)
         q = 1 - matpc
         self.clover_inv_q = invert_clover(self.clover[q])
+        from ..obs import memory as omem
+        omem.track("clover", "clover_eo_blocks",
+                   (self.clover, self.clover_inv_q))
 
     def D_to(self, psi, target_parity):
         return wops.dslash_eo(self.gauge_eo, psi, self.geom, target_parity)

@@ -386,6 +386,8 @@ class DiracTwistedClover(Dirac):
         self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
         self.antiperiodic_t = antiperiodic_t
         self.clover = clover_blocks(gauge, kappa * csw / 2.0)
+        from ..obs import memory as omem
+        omem.track("clover", "tw_clover_blocks", self.clover)
 
     def D(self, psi):
         return wops.dslash_full(self.gauge, psi)
@@ -435,6 +437,8 @@ class DiracTwistedCloverPC(DiracPC):
         blocks = clover_blocks(gauge, kappa * csw / 2.0)
         a_e, a_o = even_odd_split(blocks, geom)
         self.clover = (a_e, a_o)
+        from ..obs import memory as omem
+        omem.track("clover", "tw_clover_eo_blocks", self.clover)
         q = 1 - matpc
         self.tw_inv_q = {
             +1: jnp.linalg.inv(twisted_clover_blocks(self.clover[q],
@@ -505,6 +509,8 @@ class DiracNdegTwistedClover(Dirac):
         self.gauge = apply_t_boundary(gauge, geom, -1 if antiperiodic_t else 1)
         self.antiperiodic_t = antiperiodic_t
         self.clover = clover_blocks(gauge, kappa * csw / 2.0)
+        from ..obs import memory as omem
+        omem.track("clover", "ndeg_tw_clover_blocks", self.clover)
 
     def D(self, psi):
         out = jnp.stack([wops.dslash_full(self.gauge, psi[..., f, :, :])
@@ -563,6 +569,8 @@ class DiracNdegTwistedCloverPC(DiracPC):
         blocks = clover_blocks(gauge, kappa * csw / 2.0)
         a_e, a_o = even_odd_split(blocks, geom)
         self.clover = (a_e, a_o)
+        from ..obs import memory as omem
+        omem.track("clover", "ndeg_tw_clover_eo_blocks", self.clover)
         q = 1 - matpc
         aq = self.clover[q]
         eye = jnp.eye(6, dtype=aq.dtype)

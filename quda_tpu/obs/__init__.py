@@ -30,6 +30,23 @@ home for that surface:
                         loudly (rejection JSON rows + nonzero exit) on
                         >tol throughput regression or solver-iteration
                         inflation.
+* ``obs.metrics``     — serving-grade labeled counter/gauge/histogram
+                        registry (QUDA_TPU_METRICS; off = zero-overhead
+                        no-op calls): solves by family/status, compile
+                        vs warm-executable accounting, tuner warm-cache
+                        hit/miss, retry-ladder counters; exported as
+                        Prometheus text + metrics.tsv by end_quda.
+* ``obs.memory``      — HBM field ledger (every resident field tracked
+                        at load/free with per-family bytes + high-water),
+                        all-local-device memory_stats sampling around
+                        solve phases, and the pallas VMEM budget audit.
+* ``obs.report``      — the human-readable end-of-session fleet report
+                        (fleet_report.txt) rendered from the two above.
+* ``obs.schema``      — the canonical registry of every trace-event and
+                        metric name (linted bidirectionally by
+                        tests/test_obs_schema_lint.py; the metrics
+                        registry also validates names at record time).
 """
 
-from . import convergence, history, regress, roofline, trace  # noqa: F401
+from . import (convergence, history, memory, metrics, regress,  # noqa: F401
+               report, roofline, schema, trace)

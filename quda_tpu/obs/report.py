@@ -1,0 +1,148 @@
+"""End-of-session fleet report: the one page an operator reads.
+
+Renders the metrics registry (obs/metrics.py) + HBM ledger
+(obs/memory.py) into a human-readable summary — solves by family and
+status, resident-field bytes with high-water marks, compile count vs
+warm-executable and tuner warm-cache hits, retry-ladder usage, and the
+VMEM budget audit.  ``end_quda`` writes it as ``fleet_report.txt``
+next to ``metrics.prom`` when QUDA_TPU_METRICS is on; the same text is
+what a serving fleet's rollout review quotes before scaling a worker
+image (ROADMAP item 2's "first solve without a compile/race storm" is
+checked HERE: compiles_total vs executions_total vs tune cache hits).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from . import memory as omem
+from . import metrics as omet
+
+
+def _mb(nbytes) -> str:
+    return f"{nbytes / 2 ** 20:.2f} MB"
+
+
+def _by_name(snap: dict, kind: str, name: str) -> list:
+    """[(labels_dict, value)] for one metric name, label-sorted."""
+    return sorted(((dict(labels), v)
+                   for (n, labels), v in snap[kind].items()
+                   if n == name),
+                  key=lambda x: sorted(x[0].items()))
+
+
+def _counter_total(snap: dict, name: str, **match) -> float:
+    tot = 0.0
+    for labels, v in _by_name(snap, "counters", name):
+        if all(labels.get(k) == v2 for k, v2 in match.items()):
+            tot += v
+    return tot
+
+
+def render(snap: Optional[dict] = None) -> str:
+    """The fleet report as text.  Works from a snapshot so a single
+    flush renders exactly what it exported."""
+    snap = snap or omet.snapshot()
+    lines = ["# quda_tpu fleet report",
+             f"# generated {time.strftime('%Y-%m-%d %H:%M:%S')}", ""]
+
+    # -- solves by family / status --
+    lines.append("## Solves (by api / family / status)")
+    solves = _by_name(snap, "counters", "solves_total")
+    if solves:
+        for labels, v in solves:
+            lines.append(f"  {labels.get('api', '?'):28s} "
+                         f"{labels.get('family', '?'):16s} "
+                         f"{labels.get('status', '?'):24s} {v:g}")
+        iters = _counter_total(snap, "solve_iterations_total")
+        lines.append(f"  total solver iterations: {iters:g}")
+    else:
+        lines.append("  (no API solves recorded)")
+    eig = _by_name(snap, "counters", "eigensolves_total")
+    for labels, v in eig:
+        lines.append(f"  eigensolve {labels.get('family', '?')}/"
+                     f"{labels.get('eig_type', '?')}: {v:g}")
+    lines.append("")
+
+    # -- HBM ledger --
+    lines.append("## HBM field ledger (resident now / session "
+                 "high-water)")
+    fam = omem.family_bytes()
+    high = omem.high_water()
+    if fam or high:
+        for family in sorted(set(fam) | set(high)):
+            lines.append(f"  {family:12s} {_mb(fam.get(family, 0)):>12s}"
+                         f"  high-water {_mb(high.get(family, 0))}")
+        for row in omem.ledger():
+            lines.append(f"    {row['family']}/{row['field']}: "
+                         f"{_mb(row['bytes'])}")
+    else:
+        lines.append("  (no resident fields tracked)")
+    dev_high = omem.device_high_water()
+    for dev in sorted(dev_high):
+        lines.append(f"  device {dev}: high-water "
+                     f"{_mb(dev_high[dev])} (memory_stats)")
+    lines.append("")
+
+    # -- compile / cache accounting --
+    lines.append("## Compile & cache accounting")
+    compiles = _counter_total(snap, "compiles_total")
+    execs = _counter_total(snap, "executions_total")
+    lines.append(f"  first-execution compiles: {compiles:g} distinct "
+                 f"(api, form, shape, dtype, solver) keys")
+    for labels, v in _by_name(snap, "counters", "compiles_total"):
+        lines.append(f"    {labels.get('api', '?')}/"
+                     f"{labels.get('form', '?')}: {v:g}")
+    lines.append(f"  compute-phase executions: {execs:g} "
+                 f"(warm-executable after the first: "
+                 f"{max(0.0, execs - compiles):g})")
+    hits = _counter_total(snap, "tune_cache_hits_total")
+    misses = _counter_total(snap, "tune_cache_misses_total")
+    races = _counter_total(snap, "tune_races_total")
+    race_fail = _counter_total(snap, "tune_race_failures_total")
+    lines.append(f"  tuner warm-cache: {hits:g} hits / {misses:g} "
+                 f"misses ({races:g} races timed, {race_fail:g} "
+                 "all-candidates-failed)")
+    for labels, v in _by_name(snap, "gauges", "tune_cache_entries"):
+        lines.append(f"    warm-start entries [{labels.get('scope')}]: "
+                     f"{v:g}")
+    lines.append("")
+
+    # -- retry ladder / robustness --
+    lines.append("## Retry ladder (QUDA_TPU_ROBUST)")
+    retries = _counter_total(snap, "solve_retries_total")
+    degraded = _counter_total(snap, "solve_degraded_total")
+    breakdowns = _counter_total(snap, "breakdowns_total")
+    if retries or degraded or breakdowns:
+        for labels, v in _by_name(snap, "counters",
+                                  "solve_retries_total"):
+            lines.append(f"  retry {labels.get('api', '?')} "
+                         f"[{labels.get('reason', '?')}]: {v:g}")
+        lines.append(f"  degraded solves: {degraded:g}; breakdown "
+                     f"exits: {breakdowns:g}")
+    else:
+        lines.append("  (no retries, degradations, or breakdowns)")
+    lines.append("")
+
+    # -- VMEM budget audit --
+    lines.append("## Pallas VMEM budgets (single-buffer, vs "
+                 f"{omem.SCOPED_VMEM_MB:g} MB scoped limit)")
+    for row in omem.audit_vmem_budgets():
+        note = ("ok" if row["double_buffer_ok"]
+                else "leaves < half the scoped limit for Mosaic's "
+                     "double buffering — measured-knob territory")
+        last = ""
+        if row["last_block_bytes"] is not None:
+            last = (f"; last block {_mb(row['last_block_bytes'])} "
+                    f"(bz={row['last_bz']})")
+        lines.append(f"  {row['knob']}: {row['budget_mb']:g} MB "
+                     f"[{note}]{last}")
+    return "\n".join(lines) + "\n"
+
+
+def save(path: str, snap: Optional[dict] = None) -> str:
+    """Write the report to ``path`` (metrics.flush hook)."""
+    with open(path, "w") as fh:
+        fh.write(render(snap))
+    return path

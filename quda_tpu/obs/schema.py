@@ -1,0 +1,175 @@
+"""Canonical observability schema: every trace-event and metric name.
+
+Dashboards, scrape configs and trend queries key on NAMES.  A renamed
+or ad-hoc event/metric breaks them silently — the exact failure mode
+the env-knob registry (utils/config.py) exists to kill for knobs.  This
+module is the same discipline for the telemetry surface:
+
+* ``TRACE_EVENTS`` — every instant-event name the package may emit into
+  the JSONL/chrome stream (obs/trace.event), with the category it
+  belongs to and a one-line meaning;
+* ``METRICS``      — every metric the registry (obs/metrics.py) may
+  record, with its type (counter | gauge | histogram) and help string
+  (exported verbatim into the Prometheus ``# HELP`` lines).
+
+``tests/test_obs_schema_lint.py`` AST-harvests every emission site in
+the package and asserts BOTH directions: no emitted name missing here,
+and no registered name that nothing emits (schema rot).  The metrics
+registry additionally validates at record time, so an unregistered
+name fails the first time its code path runs even outside CI.
+"""
+
+from __future__ import annotations
+
+# -- trace events (obs/trace.event instant events) --------------------------
+
+TRACE_EVENTS: dict[str, dict] = {
+    # convergence recording (obs/convergence.py)
+    "residual": {"cat": "residual",
+                 "doc": "per-iteration solver residual (headline lane)"},
+    "residual_lane": {"cat": "residual",
+                      "doc": "per-RHS/per-shift lane residual"},
+    # roofline attribution (obs/roofline.py)
+    "roofline": {"cat": "roofline",
+                 "doc": "one achieved-GFLOPS/BW attribution row"},
+    # bench harness (bench.py record_row)
+    "bench_row": {"cat": "bench", "doc": "gate-passing bench row"},
+    "bench_row_rejected": {"cat": "bench",
+                           "doc": "bench row refused by gate_row"},
+    # autotuner (utils/tune.py)
+    "tune_cached": {"cat": "tune", "doc": "race served from the cache"},
+    "tune_candidate": {"cat": "tune", "doc": "one candidate timing"},
+    "tune_candidate_failed": {"cat": "tune",
+                              "doc": "candidate raised mid-race"},
+    "tune_winner": {"cat": "tune", "doc": "race winner cached"},
+    "tune_race_all_failed": {"cat": "tune",
+                             "doc": "every candidate raised; static "
+                                    "default served uncached"},
+    "tune_cache_invalidated": {"cat": "tune",
+                               "doc": "stale-schema entries dropped at "
+                                      "load"},
+    "tune_cache_loaded": {"cat": "tune",
+                          "doc": "warm-start load stats (init_quda)"},
+    # solve supervision (quda_tpu/robust + interfaces/quda_api)
+    "solve_retry": {"cat": "robust",
+                    "doc": "escalation-ladder rung transition"},
+    "solve_degraded": {"cat": "robust",
+                       "doc": "solve served from a fallback rung"},
+    "breakdown_detected": {"cat": "robust",
+                           "doc": "in-loop breakdown sentinel tripped"},
+    "verify_mismatch": {"cat": "robust",
+                        "doc": "claimed convergence failed the "
+                               "recomputed-residual check"},
+    "gauge_rejected": {"cat": "robust",
+                       "doc": "non-finite gauge refused at load"},
+    "gauge_unitarity": {"cat": "robust",
+                        "doc": "unitarity screen exceeded tolerance"},
+    "fault_injected": {"cat": "robust",
+                       "doc": "QUDA_TPU_FAULT arm fired (drill)"},
+    # serving-grade accounting (obs/metrics.py / obs/memory.py)
+    "compile": {"cat": "metrics",
+                "doc": "first execution of a (api, form, shape, dtype, "
+                       "solver) key — compile time included in seconds"},
+    "hbm_field_tracked": {"cat": "memory",
+                          "doc": "resident field (re)registered in the "
+                                 "HBM ledger"},
+    "hbm_field_released": {"cat": "memory",
+                           "doc": "resident field freed from the HBM "
+                                  "ledger"},
+}
+
+# -- metrics (obs/metrics.py registry) --------------------------------------
+
+COUNTER, GAUGE, HISTOGRAM = "counter", "gauge", "histogram"
+
+METRICS: dict[str, dict] = {
+    # fleet solve accounting (interfaces/quda_api._solve_supervision;
+    # under 'escalate' every ladder ATTEMPT counts — retries are visible
+    # as extra attempts next to solve_retries_total)
+    "solves_total": {
+        "type": COUNTER,
+        "help": "API solve attempts by api/family/status"},
+    "solve_iterations_total": {
+        "type": COUNTER,
+        "help": "solver iterations executed, by api/family"},
+    "solve_seconds": {
+        "type": HISTOGRAM,
+        "help": "wall seconds per API solve attempt, by api/family"},
+    "eigensolves_total": {
+        "type": COUNTER,
+        "help": "eigensolve_quda calls by family/eig_type"},
+    # compile / executable-cache accounting
+    "compiles_total": {
+        "type": COUNTER,
+        "help": "first executions (compile included) per distinct "
+                "(api, operator form, shape, dtype, solver) key, "
+                "by api/form"},
+    "compile_seconds": {
+        "type": HISTOGRAM,
+        "help": "first-execution wall seconds (compile + run), by api"},
+    "executions_total": {
+        "type": COUNTER,
+        "help": "compute-phase executions per api/form (warm "
+                "executable after the first)"},
+    # tuner warm-cache accounting (utils/tune.py)
+    "tune_cache_hits_total": {
+        "type": COUNTER,
+        "help": "tune() decisions served from the warm cache, by kernel"},
+    "tune_cache_misses_total": {
+        "type": COUNTER,
+        "help": "tune() keys not in the warm cache, by kernel"},
+    "tune_races_total": {
+        "type": COUNTER,
+        "help": "candidate races actually timed, by kernel"},
+    "tune_race_failures_total": {
+        "type": COUNTER,
+        "help": "races whose every candidate raised (static default "
+                "served), by kernel"},
+    "tune_cache_entries": {
+        "type": GAUGE,
+        "help": "persistent tunecache entries at warm start, by scope "
+                "(total | usable_here | stale_dropped)"},
+    # robust subsystem (robust/escalate.py + _solve_supervision)
+    "solve_retries_total": {
+        "type": COUNTER,
+        "help": "escalation-ladder rung transitions, by api/reason"},
+    "solve_degraded_total": {
+        "type": COUNTER,
+        "help": "solves served from a fallback rung (or best-effort "
+                "after ladder exhaustion), by api"},
+    "breakdowns_total": {
+        "type": COUNTER,
+        "help": "breakdown-sentinel exits, by api/reason"},
+    # HBM field ledger (obs/memory.py)
+    "hbm_field_bytes": {
+        "type": GAUGE,
+        "help": "resident bytes of one registered field, by family/field"},
+    "hbm_family_bytes": {
+        "type": GAUGE,
+        "help": "resident bytes per field family"},
+    "hbm_family_high_water_bytes": {
+        "type": GAUGE,
+        "help": "session high-water resident bytes per field family"},
+    "hbm_device_bytes_in_use": {
+        "type": GAUGE,
+        "help": "backend bytes_in_use per local device (last sample)"},
+    "hbm_device_high_water_bytes": {
+        "type": GAUGE,
+        "help": "session high-water bytes_in_use per local device"},
+    # VMEM budget audit (obs/memory.py vs QUDA_TPU_PALLAS_VMEM_MB*)
+    "vmem_budget_bytes": {
+        "type": GAUGE,
+        "help": "configured single-buffer pallas VMEM budget, by knob"},
+    "vmem_block_bytes": {
+        "type": GAUGE,
+        "help": "selected z-block working-set bytes (last _pick_bz "
+                "decision), by knob"},
+    # bench harness (bench_suite.py)
+    "bench_rows_total": {
+        "type": COUNTER,
+        "help": "bench rows emitted, by suite"},
+}
+
+
+def metric_type(name: str) -> str:
+    return METRICS[name]["type"]

@@ -362,7 +362,17 @@ def _pick_bz(Z: int, YX: int, dtype=jnp.float32, planes: int = 288,
             f"no z-block of Z={Z} fits the VMEM budget at YX={YX} "
             f"(min working set {min_ws:.1f} MB){hint}; fall back to the "
             "XLA packed stencil for this operator")
-    return max(fitting)[1]
+    _, bz, bz_pad = max(fitting)
+    try:
+        # audit the decision against its budget knob (obs/memory.py):
+        # selected single-buffer working set -> vmem_block_bytes gauge
+        # + the fleet report's VMEM section (no-op when metrics off)
+        from ..obs import memory as omem
+        omem.vmem_audit(vmem_knob, planes * bz_pad * yx_pad * nbytes,
+                        budget, bz=bz)
+    except Exception:
+        pass
+    return bz
 
 
 @functools.partial(jax.jit,

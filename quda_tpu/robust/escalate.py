@@ -98,6 +98,7 @@ def run_ladder(body: Callable, source, param, api: str = "invert_quda"):
     attempt, status 'degraded') onto ``param``.  Construction/compile
     exceptions fail the attempt; if EVERY rung raised, the last
     exception propagates (there is no solution to degrade to)."""
+    from ..obs import metrics as omet
     from ..obs import trace as otr
     from ..utils import config as qconf
     from ..utils import logging as qlog
@@ -131,6 +132,8 @@ def run_ladder(body: Callable, source, param, api: str = "invert_quda"):
                           from_rung=rung["label"],
                           to_rung=rungs[i + 1]["label"],
                           reason=f"construct_error:{type(e).__name__}")
+                omet.inc("solve_retries_total", api=api,
+                         reason="construct_error")
                 qlog.warningq(
                     f"{api}: attempt {i} ({rung['label']}) failed to "
                     f"construct ({type(e).__name__}: {str(e)[:120]}); "
@@ -154,6 +157,7 @@ def run_ladder(body: Callable, source, param, api: str = "invert_quda"):
                 otr.event("solve_degraded", cat="robust", api=api,
                           rung=rung["label"], attempts=i + 1,
                           status=status)
+                omet.inc("solve_degraded_total", api=api)
                 qlog.warningq(
                     f"{api}: served from escalation rung "
                     f"'{rung['label']}' after {i} failed attempt(s) "
@@ -163,6 +167,7 @@ def run_ladder(body: Callable, source, param, api: str = "invert_quda"):
             otr.event("solve_retry", cat="robust", api=api,
                       from_rung=rung["label"],
                       to_rung=rungs[i + 1]["label"], reason=status)
+            omet.inc("solve_retries_total", api=api, reason=status)
             qlog.warningq(
                 f"{api}: attempt {i} ({rung['label']}) exited "
                 f"{status}; escalating to {rungs[i + 1]['label']}")
@@ -176,6 +181,7 @@ def run_ladder(body: Callable, source, param, api: str = "invert_quda"):
     param.converged = False
     otr.event("solve_degraded", cat="robust", api=api, rung=best_rung,
               attempts=len(attempts), status=param.solve_status)
+    omet.inc("solve_degraded_total", api=api)
     qlog.warningq(
         f"{api}: escalation ladder exhausted ({len(attempts)} "
         f"attempts); returning the best effort (rung '{best_rung}') "

@@ -223,6 +223,16 @@ _register("QUDA_TPU_TRACE_EVENTS_MAX", "int", 200000,
           "cap are dropped and counted in the flushed trace's "
           "otherData.dropped_events",
           reference="bounded profiling buffers")
+_register("QUDA_TPU_METRICS", "bool", False,
+          "enable the serving-grade metrics registry (obs/metrics.py): "
+          "labeled solve/compile/tuner-cache/retry counters, the HBM "
+          "field ledger + all-device memory sampling, and the "
+          "end_quda export (metrics.prom Prometheus text, metrics.tsv, "
+          "fleet_report.txt under the resource path); off (default) = "
+          "zero-overhead no-op recording calls and bit-identical "
+          "compiled solves (pinned by raising-stub test)",
+          reference="tunecache/profile accounting (lib/tune.cpp:"
+                    "450-610) + device_malloc ledger (lib/malloc.cpp)")
 _register("QUDA_TPU_ENABLE_MONITOR", "bool", False,
           "periodically sample device/host memory into the monitor log",
           reference="QUDA_ENABLE_MONITOR")

@@ -4,10 +4,14 @@ Reference behavior: lib/monitor.cpp — a host thread samples power, energy,
 temperature and clocks every QUDA_ENABLE_MONITOR_PERIOD microseconds into
 monitor_n<rank>_<time>.tsv; solvers integrate energy over their window.
 
-TPU analog: no NVML — we sample wall time, device memory stats
-(jax.local_devices()[0].memory_stats() when the backend provides them) and
-host RSS.  The same start/stop/integration API shape is kept so solver
-reports can attach resource usage.
+TPU analog: no NVML — we sample wall time, device memory stats across
+ALL local devices (obs/memory.device_snapshot; sampling only device 0
+left a sharded solve's other shards invisible — round-12 fix) and host
+RSS.  Snapshots fold their per-device high-water into the HBM ledger
+(obs/memory.py), so the end-of-session fleet report carries the peak a
+background-monitored run actually reached.  The same
+start/stop/integration API shape is kept so solver reports can attach
+resource usage.
 """
 
 from __future__ import annotations
@@ -27,12 +31,18 @@ class Monitor:
         self._stop = threading.Event()
 
     def _device_mem(self):
+        """(total, max, n) bytes_in_use over ALL local devices — the
+        snapshot also folds per-device high-water into the HBM ledger
+        (obs/memory.device_snapshot)."""
         try:
-            import jax
-            stats = jax.local_devices()[0].memory_stats()
-            return stats.get("bytes_in_use", 0) if stats else 0
+            from ..obs import memory as omem
+            rows = omem.device_snapshot()
+            if not rows:
+                return 0, 0, 0
+            vals = [r["bytes_in_use"] for r in rows]
+            return sum(vals), max(vals), len(vals)
         except Exception:
-            return 0
+            return 0, 0, 0
 
     def _host_rss(self):
         try:
@@ -43,9 +53,12 @@ class Monitor:
 
     def _loop(self):
         while not self._stop.is_set():
+            total, dmax, ndev = self._device_mem()
             self.samples.append({
                 "time": time.time(),
-                "device_bytes": self._device_mem(),
+                "device_bytes": total,
+                "device_bytes_max": dmax,
+                "n_devices": ndev,
                 "host_rss": self._host_rss(),
             })
             self._stop.wait(self.period)
@@ -61,9 +74,12 @@ class Monitor:
             self._thread.join(timeout=2.0)
         if self.path:
             with open(self.path, "w") as fh:
-                fh.write("time\tdevice_bytes\thost_rss\n")
+                fh.write("time\tdevice_bytes\tdevice_bytes_max\t"
+                         "n_devices\thost_rss\n")
                 for s in self.samples:
                     fh.write(f"{s['time']:.6f}\t{s['device_bytes']}\t"
+                             f"{s.get('device_bytes_max', 0)}\t"
+                             f"{s.get('n_devices', 0)}\t"
                              f"{s['host_rss']}\n")
 
     def window(self, t0: float, t1: float):

@@ -160,6 +160,10 @@ def warm_start() -> int:
     _obs_event("tune_cache_loaded", path=stats["path"],
                entries=len(_cache), usable_here=usable,
                stale_dropped=stats["stale"], platform=here)
+    _obs_gauge("tune_cache_entries", len(_cache), scope="total")
+    _obs_gauge("tune_cache_entries", usable, scope="usable_here")
+    _obs_gauge("tune_cache_entries", stats["stale"],
+               scope="stale_dropped")
     return usable
 
 
@@ -179,6 +183,26 @@ def _obs_event(name: str, **fields):
         pass
 
 
+def _obs_metric(name: str, value: float = 1.0, **labels):
+    """Mirror tuner cache behavior into the metrics registry (no-op when
+    QUDA_TPU_METRICS is off) — the warm-cache hit/miss/race accounting a
+    serving fleet reads before scaling (ROADMAP item 2's compile/race
+    storm is diagnosed HERE)."""
+    try:
+        from ..obs import metrics as omet
+        omet.inc(name, value, **labels)
+    except Exception:
+        pass
+
+
+def _obs_gauge(name: str, value: float, **labels):
+    try:
+        from ..obs import metrics as omet
+        omet.set_gauge(name, value, **labels)
+    except Exception:
+        pass
+
+
 def tune(name: str, volume, candidates: Dict[str, Callable], args: tuple,
          aux: str = "", reps: int = 3, inner: int = 5) -> str:
     """Return the winning candidate key; time once per chip, cache forever.
@@ -194,9 +218,12 @@ def tune(name: str, volume, candidates: Dict[str, Callable], args: tuple,
         _obs_event("tune_cached", key=key,
                    param=_cache[key]["param"],
                    seconds=_cache[key].get("time"))
+        _obs_metric("tune_cache_hits_total", kernel=name)
         return _cache[key]["param"]
+    _obs_metric("tune_cache_misses_total", kernel=name)
     if not tuning_enabled():
         return next(iter(candidates))
+    _obs_metric("tune_races_total", kernel=name)
     best, best_t = None, float("inf")
     for param, fn in candidates.items():
         try:
@@ -230,6 +257,7 @@ def tune(name: str, volume, candidates: Dict[str, Callable], args: tuple,
         default = next(iter(candidates))
         _obs_event("tune_race_all_failed", key=key, fallback=default,
                    n_candidates=len(candidates))
+        _obs_metric("tune_race_failures_total", kernel=name)
         from . import logging as qlog
         qlog.warn_once(
             f"tune_all_failed:{name}",

@@ -1,0 +1,110 @@
+"""Observability-schema lint: every trace-event and metric name emitted
+anywhere in the package must appear in the canonical registry
+(quda_tpu/obs/schema.py), and the registry must carry no name nothing
+emits — dashboards and scrape configs key on names, and a renamed or
+ad-hoc one breaks them silently (the same AST-harvest discipline as
+test_env_knob_lint.py for knobs and test_roofline_lint.py for kernel
+forms).
+
+Harvested emission surfaces:
+
+* trace events — first string args of ``event(...)`` /
+  ``otr.event(...)`` / ``_obs_event(...)`` calls and of bench.py's
+  ``_mirror_row_event(...)`` wrapper;
+* metrics — first string args of ``inc(...)`` / ``set_gauge(...)`` /
+  ``observe(...)`` / ``_obs_metric(...)`` / ``_obs_gauge(...)`` calls.
+
+The metrics registry also validates names at RECORD time
+(obs/metrics._Registry._check), so the dynamic half is covered even
+off-CI; this lint closes the path-never-executed gap statically.
+"""
+
+import ast
+import os
+
+import quda_tpu
+from quda_tpu.obs import schema as osch
+
+_EVENT_FUNCS = {"event", "_obs_event", "_mirror_row_event"}
+_METRIC_FUNCS = {"inc", "set_gauge", "observe", "_obs_metric",
+                 "_obs_gauge"}
+
+
+def _paths():
+    pkg = os.path.dirname(os.path.abspath(quda_tpu.__file__))
+    root = os.path.dirname(pkg)
+    paths = [os.path.join(root, f) for f in ("bench.py", "bench_suite.py")
+             if os.path.exists(os.path.join(root, f))]
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        paths += [os.path.join(dirpath, f) for f in filenames
+                  if f.endswith(".py")]
+    return root, paths
+
+
+def _harvest(funcs):
+    """{name: [relpaths]} of first-string-arg calls to ``funcs``."""
+    root, paths = _paths()
+    out = {}
+    for path in paths:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+        rel = os.path.relpath(path, root)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = getattr(fn, "attr", None) or getattr(fn, "id", "")
+            if name in funcs and node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) and isinstance(a0.value,
+                                                               str):
+                    out.setdefault(a0.value, []).append(rel)
+    return out
+
+
+def test_every_emitted_trace_event_is_registered():
+    emitted = _harvest(_EVENT_FUNCS)
+    unknown = {n: ps for n, ps in emitted.items()
+               if n not in osch.TRACE_EVENTS}
+    assert not unknown, (
+        f"trace events emitted without a schema entry: {unknown} — "
+        "register them in quda_tpu/obs/schema.py TRACE_EVENTS (cat + "
+        "doc); an unregistered event name breaks dashboards silently")
+
+
+def test_no_registered_trace_event_is_orphaned():
+    emitted = set(_harvest(_EVENT_FUNCS))
+    orphans = set(osch.TRACE_EVENTS) - emitted
+    assert not orphans, (
+        f"TRACE_EVENTS entries nothing emits: {orphans} — schema rot; "
+        "delete them or restore the emission site")
+
+
+def test_every_recorded_metric_is_registered():
+    emitted = _harvest(_METRIC_FUNCS)
+    unknown = {n: ps for n, ps in emitted.items()
+               if n not in osch.METRICS}
+    assert not unknown, (
+        f"metrics recorded without a schema entry: {unknown} — "
+        "register them in quda_tpu/obs/schema.py METRICS (type + help)")
+
+
+def test_no_registered_metric_is_orphaned():
+    """Gauges the ledger mirrors internally count as emitted through
+    their module-level set_gauge literals, so a truly orphaned name
+    means dead schema."""
+    emitted = set(_harvest(_METRIC_FUNCS))
+    orphans = set(osch.METRICS) - emitted
+    assert not orphans, (
+        f"METRICS entries nothing records: {orphans} — schema rot; "
+        "delete them or restore the recording site")
+
+
+def test_schema_entries_carry_docs():
+    for name, meta in osch.TRACE_EVENTS.items():
+        assert meta.get("cat") and len(meta.get("doc", "")) > 5, name
+    for name, meta in osch.METRICS.items():
+        assert meta["type"] in (osch.COUNTER, osch.GAUGE,
+                                osch.HISTOGRAM), name
+        assert len(meta["help"]) > 10, name

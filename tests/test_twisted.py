@@ -320,9 +320,9 @@ def test_twisted_pairs_api_adapter_selected(monkeypatch):
     captured = {}
     orig = api._PairOpSolve.__init__
 
-    def spy(self, dpc, use_pallas):
+    def spy(self, dpc, use_pallas, pallas_interpret=False):
         captured["hit"] = True
-        orig(self, dpc, use_pallas)
+        orig(self, dpc, use_pallas, pallas_interpret)
 
     monkeypatch.setattr(api._PairOpSolve, "__init__", spy)
     monkeypatch.setenv("QUDA_TPU_PACKED", "1")
