@@ -166,14 +166,25 @@ def main(argv):
     try:
         opts = {flag: regress.pop_opt(argv, flag)
                 for flag in ("--tol", "--iters-tol", "--history",
-                             "--trends")}
+                             "--trends", "--artifacts-dir")}
     except ValueError as e:
         print(json.dumps({"suite": "compare", "error": str(e)}),
               flush=True)
         return 2
 
+    # --artifacts-dir: ONE directory every exporter respects (trace,
+    # metrics.prom/tsv, fleet_report.txt, roofline.tsv, trends.tsv) —
+    # default: alongside the bench JSON output (the cwd, where the
+    # driver tees the JSON lines); replaces the per-exporter ad hoc
+    # path choices
+    artifacts_dir = opts["--artifacts-dir"] or os.getcwd()
+    if opts["--trends"] is None and opts["--artifacts-dir"] is not None:
+        opts["--trends"] = os.path.join(artifacts_dir, "trends.tsv")
+
     if do_compare and dry:
-        passthrough = [t for flag, v in opts.items() if v is not None
+        passthrough = [t for flag in ("--tol", "--iters-tol",
+                                      "--history", "--trends")
+                       if (v := opts[flag]) is not None
                        for t in (flag, v)]
         return regress.main(["--latest"] + passthrough)
 
@@ -219,18 +230,23 @@ def main(argv):
     platform = actual
 
     suites = set(a for a in argv if not a.startswith("-")) or {
-        "blas", "dslash", "solver", "sharded"}
+        "blas", "dslash", "solver", "sharded", "costmodel"}
 
     if do_trace:
         from quda_tpu.obs import trace as qtrace
-        qtrace.start(os.getcwd(), prefix="bench_trace")
+        qtrace.start(artifacts_dir, prefix="bench_trace")
     if do_metrics:
         # --metrics (or QUDA_TPU_METRICS=1): run the suite under the
         # serving-metrics registry — bench row counts, tuner warm-cache
         # hit/miss, compile accounting — and export metrics.prom /
-        # metrics.tsv / fleet_report.txt next to the bench output
+        # metrics.tsv / fleet_report.txt into the artifacts dir
         from quda_tpu.obs import metrics as qmet
-        qmet.start(os.getcwd())
+        qmet.start(artifacts_dir)
+    if do_trace or do_metrics:
+        # the ICI comms ledger rides the observability sessions (its
+        # rows land in roofline.tsv / the trace stream)
+        from quda_tpu.obs import comms as qcomms
+        qcomms.start()
 
     def suite_guard(suite: str) -> bool:
         """Window hygiene (VERDICT r7 #10): every suite re-checks the
@@ -950,6 +966,15 @@ def main(argv):
                 pspec_p = P(None, None, None, "t", "z", None)
                 gspec_p = P(None, None, None, None, "t", "z", None)
 
+                # ICI column: the analytic halo model's total bytes per
+                # dslash apply over the interconnect (obs/comms.py) —
+                # trended by --compare (unit ici_gb, never gated), so
+                # the first chip window starts the comms trend line the
+                # pod-scale question (ROADMAP item 2) needs
+                from quda_tpu.obs import comms as qcomms
+                ici_gb_sh = round(qcomms.wilson_eo_halo_model(
+                    dims_sh, (n_t, n_z))["total"] / 1e9, 6)
+
                 def sharded_case(name, form, policy):
                     if form == "v2":
                         def local(a, b, p):
@@ -973,7 +998,7 @@ def main(argv):
                         _emit("sharded", name, secs, fl_sh, bts_sh,
                               platform, (Lsh,) * 4, banner=banner,
                               mesh=[n_t, n_z], form=form, policy=policy,
-                              devices=n_dev)
+                              devices=n_dev, ici_gb=ici_gb_sh)
                     except Exception as e:
                         print(json.dumps({
                             "suite": "sharded", "name": name,
@@ -1177,12 +1202,56 @@ def main(argv):
             "platform": platform, "lattice": [Lm] * 4,
             "n_vec": 8}, banner_platform=banner)
 
+    if "costmodel" in suites and suite_guard("costmodel"):
+        # KERNEL_MODELS drift check (obs/costmodel.py): analytic
+        # flops/bytes vs the XLA reference-stencil count and the
+        # operand-footprint floor, one row per registered pallas form.
+        # cost_drift_ratio is trended (unit drift_ratio) by --compare;
+        # pass/fail enforcement lives in tests/test_costmodel.py —
+        # a failing row here is loud but the lint is the gate.
+        from quda_tpu.obs import costmodel as qcost
+        for form in qcost.checkable_forms():
+            # per-form try/except (file convention): a reference-
+            # stencil compile failure is a loud error row, never an
+            # uncaught abort mid-bench
+            try:
+                r = qcost.drift_row(form)
+            except Exception as e:
+                print(json.dumps({"suite": "costmodel",
+                                  "name": f"cost_drift_{form}",
+                                  "error": str(e)[:140]}), flush=True)
+                continue
+            if not r.get("checked"):
+                print(json.dumps({"suite": "costmodel",
+                                  "name": f"cost_drift_{form}",
+                                  "error": "; ".join(r["reasons"])
+                                  [:140]}), flush=True)
+                continue
+            record_row("costmodel", {
+                "name": f"cost_drift_{form}",
+                "form": form,
+                "cost_drift_ratio": r["bytes_ratio"],
+                "flops_ratio": r["flops_ratio"],
+                "drift_ok": r["ok"],
+                "platform": platform, "lattice": [4] * 4},
+                banner_platform=banner)
+
     if do_trace:
         from quda_tpu.obs import trace as qtrace
         paths = qtrace.stop()
         if paths:
             print(json.dumps({"suite": "harness", "trace": paths}),
                   flush=True)
+    # roofline rows accumulated during the run (API-style attribution +
+    # the comms ledger's ICI rows) land in the artifacts dir too
+    from quda_tpu.obs import comms as qcomms2
+    from quda_tpu.obs import roofline as qorf
+    if qorf.rows() or qcomms2.solve_rows():
+        path = qorf.save(path=artifacts_dir)
+        if path:
+            print(json.dumps({"suite": "harness", "roofline": path}),
+                  flush=True)
+
     from quda_tpu.obs import metrics as qmet
     if qmet.enabled():
         paths = qmet.stop()

@@ -48,6 +48,8 @@ def init_quda(device: int = 0):
     qmon.start_default()       # QUDA_TPU_ENABLE_MONITOR sampling thread
     otr.maybe_start()          # QUDA_TPU_TRACE span/event session
     omet.maybe_start()         # QUDA_TPU_METRICS counter/gauge registry
+    from ..obs import comms as ocomms
+    ocomms.maybe_start()       # ICI comms ledger (rides both knobs)
     # warm-start the chip-keyed tuner cache (tune.cpp persistent-cache
     # behavior): a fresh worker with a shared QUDA_TPU_RESOURCE_PATH
     # serves its first solve from already-raced (platform, volume,
@@ -101,6 +103,8 @@ def end_quda():
     # one raises (a broken profile writer must not eat the trace of the
     # crashed session it would explain) — the first error is re-raised
     # AFTER the epilogue completes.
+    from ..obs import comms as ocomms
+    from ..obs import costmodel as ocost
     from ..obs import memory as omem
     from ..obs import metrics as omet
     from ..obs import roofline as orf
@@ -129,8 +133,11 @@ def end_quda():
 
     errors = []
     for step in (qmon.stop_default, print_summary, qtune.save_profile,
-                 orf.save,
+                 orf.save,       # dumps the ICI ledger rows alongside
                  orf.reset,  # a later init/end must not re-dump rows
+                 ocost.save_report,  # cost_drift.tsv for noted compiles
+                 ocost.reset,
+                 ocomms.stop,    # ledger follows the session it served
                  _flush_metrics, _flush_trace):
         try:
             step()
@@ -774,6 +781,22 @@ def _hbm_sampled(api: str):
             omem.sample(f"{api}:exit")
 
 
+def _op_mesh(d):
+    """The jax.sharding.Mesh a solve operator runs on, walked through
+    the adapter wrappers (_WilsonPairsSolve and friends hold the pairs
+    op on ``.op``); None for single-device operators.  Drives the
+    per-device trace tracks and the ICI solve attribution."""
+    seen = set()
+    o = d
+    while o is not None and id(o) not in seen:
+        seen.add(id(o))
+        m = getattr(o, "_mesh", None)
+        if m is not None:
+            return m
+        o = getattr(o, "op", None) or getattr(o, "dirac", None)
+    return None
+
+
 def _record_solve_metrics(api: str, form: str, solver: str,
                           secs: float, family: str, prec: str):
     """The ONE home for per-route compile/execution accounting: first
@@ -973,8 +996,8 @@ def _invert_quda_body(source, param: InvertParam):
 
     t_solve0 = time.perf_counter()
     with otr.phase("compute", "invert_quda"), \
-            otr.span(f"solve:{inv}", cat="solver", tol=param.tol,
-                     maxiter=param.maxiter):
+            otr.span(f"solve:{inv}", cat="solver", mesh=_op_mesh(d),
+                     tol=param.tol, maxiter=param.maxiter):
         # keyword-only at the call site: four adjacent bools among 17
         # parameters — a positional transposition would type-check and
         # silently pick the wrong solve route
@@ -1046,6 +1069,20 @@ def _invert_quda_body(source, param: InvertParam):
                    flops_per_site=flops,
                    dslash_per_apply=2.0 if pc else 1.0,
                    label=f"invert_quda:{param.dslash_type}/{inv}")
+    from ..obs import comms as ocomms
+    if ocomms.enabled() and _op_mesh(d) is not None:
+        # ICI attribution: the comms ledger's per-invocation halo model
+        # x this solve's measured applies, emitted as the roofline.tsv
+        # sibling row.  Gated on the LEDGER (which rides either
+        # trace or metrics knob), not on `recording` — a metrics-only
+        # session must still see ici_bytes_total.  The site prefix
+        # confines the model to this operator's family so another
+        # form's stencils traced earlier in the session cannot leak in.
+        form = _solve_form(d)
+        ocomms.attribute_solve(
+            form, param.iter_count * mv_applies, 2.0 if pc else 1.0,
+            t_solve, label=f"invert_quda:{param.dslash_type}/{inv}",
+            site_prefix=form.split("_")[0])
     qlog.printq(
         f"invert_quda[{param.dslash_type}/{inv}]: {param.iter_count} "
         f"iters, true_res {param.true_res:.2e}, {param.secs:.2f} s")
@@ -1373,7 +1410,7 @@ def _invert_multi_src_body(sources, param: InvertParam):
         # pass the RAW resident gauge; each sub-grid folds the boundary
         # phase inside its own trace (DiracWilsonPC does it)
         t_solve0 = time.perf_counter()
-        with otr.phase("compute", "invert_multi_src_quda",
+        with otr.phase("compute", "invert_multi_src_quda", mesh=mesh,
                        route="split_grid"):
             x_full, iters, conv_l, bk_l = split_grid_solve(
                 solve_one, _ctx["gauge"], B, mesh)

@@ -134,30 +134,41 @@ def build_coarse(fine_parts, transfer: Transfer,
         c = np.arange(latc[ax]).reshape(shape) % 2
         return np.broadcast_to(c, latc)  # (latc,)
 
+    from ..obs import trace as otr
+
     dtype = transfer.v.dtype
     diag_cols = []
     hop_cols = {d: [] for d in DIRS}
-    for chir in range(2):
-        for b in range(n):
-            e = jnp.zeros(latc + (2, n), dtype).at[..., chir, b].set(1.0)
-            dcol = probe_diag(e).reshape(latc + (nc,))
-            for mu, sign in DIRS:
-                ext = latc[axis_of_mu(mu)]
-                if ext == 1:
-                    out = probe_hop(e, mu, sign).reshape(latc + (nc,))
-                    hop_cols[(mu, sign)].append(out)
-                    continue
-                par = jnp.asarray(coord_parity(mu))[..., None, None]
-                ycol = jnp.zeros(latc + (nc,), dtype)
-                for p in (0, 1):
-                    mask = (par == p).astype(dtype)
-                    out = probe_hop(e * mask, mu, sign).reshape(latc + (nc,))
-                    lit = (jnp.asarray(coord_parity(mu)) == p)[..., None]
-                    # unlit sites: pure link column; lit: diagonal part
-                    ycol = jnp.where(lit, ycol, out)
-                    dcol = dcol + jnp.where(lit, out, 0.0)
-                hop_cols[(mu, sign)].append(ycol)
-            diag_cols.append(dcol)
+    # the probe loop is the coarse-stencil cost: Nc = 2*n_vec columns x
+    # (1 diag + 8 masked-twice hop) probes — spanned so the MG setup
+    # breakdown's coarse_probe phase shows its inner structure in the
+    # trace (span is the module no-op when tracing is off)
+    with otr.span("mg_coarse_probe_loop", cat="mg", n_vec=n,
+                  coarse_shape=list(latc)):
+        for chir in range(2):
+            for b in range(n):
+                e = jnp.zeros(latc + (2, n),
+                              dtype).at[..., chir, b].set(1.0)
+                dcol = probe_diag(e).reshape(latc + (nc,))
+                for mu, sign in DIRS:
+                    ext = latc[axis_of_mu(mu)]
+                    if ext == 1:
+                        out = probe_hop(e, mu, sign).reshape(latc + (nc,))
+                        hop_cols[(mu, sign)].append(out)
+                        continue
+                    par = jnp.asarray(coord_parity(mu))[..., None, None]
+                    ycol = jnp.zeros(latc + (nc,), dtype)
+                    for p in (0, 1):
+                        mask = (par == p).astype(dtype)
+                        out = probe_hop(e * mask, mu,
+                                        sign).reshape(latc + (nc,))
+                        lit = (jnp.asarray(coord_parity(mu)) == p)[
+                            ..., None]
+                        # unlit sites: pure link column; lit: diagonal
+                        ycol = jnp.where(lit, ycol, out)
+                        dcol = dcol + jnp.where(lit, out, 0.0)
+                    hop_cols[(mu, sign)].append(ycol)
+                diag_cols.append(dcol)
 
     x_diag = jnp.stack(diag_cols, axis=-1)           # (latc, Nc, Nc)
     y = {d: jnp.stack(hop_cols[d], axis=-1) for d in DIRS}

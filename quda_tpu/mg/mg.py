@@ -258,30 +258,116 @@ class MG:
                 for i in range(0, n_vec, chunk)]
         return jnp.concatenate(outs) if len(outs) > 1 else outs[0]
 
+    @staticmethod
+    def _await_phase(obj):
+        """Block on every device array reachable from a phase's product
+        so async dispatch cannot bill one phase's work to the next —
+        the breakdown is only worth having if the rows are honest.
+        Setup is host-driven; the sync points add nothing hot.  The
+        product is either an array/pytree (tree_leaves finds the
+        arrays directly — a bare jax Array has an EMPTY __dict__, so
+        the object fallback must not shadow this case) or a plain
+        object (Transfer/CoarseOperator: an opaque tree leaf, walked
+        through its __dict__)."""
+        leaves = jax.tree_util.tree_leaves(obj)
+        if not any(hasattr(leaf, "block_until_ready")
+                   for leaf in leaves):
+            leaves = jax.tree_util.tree_leaves(
+                getattr(obj, "__dict__", {}))
+        for leaf in leaves:
+            if hasattr(leaf, "block_until_ready"):
+                leaf.block_until_ready()
+        return obj
+
+    def _phase(self, level: int, phase: str):
+        """One timed MG-setup phase: wall seconds appended to
+        ``self.setup_breakdown``, mirrored as a trace span and the
+        ``mg_setup_phase_seconds_total`` counter (both single-load
+        no-ops when QUDA_TPU_TRACE/QUDA_TPU_METRICS are off) — the
+        per-phase attribution the 5652s-setup scandal (ROADMAP item 1)
+        never had."""
+        import contextlib
+        import time as _time
+
+        from ..obs import metrics as omet
+        from ..obs import trace as otr
+
+        @contextlib.contextmanager
+        def _ctx():
+            t0 = _time.perf_counter()
+            try:
+                with otr.span(f"mg:{phase}", cat="mg", level=level):
+                    yield
+            finally:
+                # record even when the phase raises (a pallas compile
+                # failure here is exactly what robust/escalate retries)
+                # — the span records its duration unconditionally, and
+                # breakdown/metrics must not disagree with it on the
+                # error paths
+                dt = _time.perf_counter() - t0
+                self.setup_breakdown.append(
+                    {"level": level, "phase": phase,
+                     "seconds": round(dt, 6)})
+                omet.inc("mg_setup_phase_seconds_total", dt,
+                         level=level, phase=phase)
+
+        return _ctx()
+
     def _setup(self, adapter, key, verbosity):
+        """Hierarchy build with per-phase attribution: [{level, phase,
+        seconds}] rows (null_vectors | transfer_build | coarse_probe
+        per level) + the total — host bookkeeping, maintained always;
+        trace/metrics mirrors activate with their sessions.  The total
+        and breakdown record in a finally so a mid-level failure (a
+        pallas compile raise the robust ladder retries) still leaves
+        honest partial attribution."""
+        import time as _time
+
+        from ..obs import metrics as omet
+        self.setup_breakdown = []
+        self.setup_seconds = 0.0     # set even if setup aborts mid-level
+        t_setup0 = _time.perf_counter()
+        try:
+            self._setup_levels(adapter, key, verbosity)
+        finally:
+            self.setup_seconds = round(_time.perf_counter() - t_setup0,
+                                       6)
+            omet.inc("mg_setup_seconds_total", self.setup_seconds,
+                     levels=len(self.params))
+
+    def _setup_levels(self, adapter, key, verbosity):
+        from ..obs import trace as otr
         level_op = adapter
         lat_shape = self.geom.lattice_shape
         k_fine = adapter.k_fine        # 6 wilson-like, 3 staggered, n_vec coarse
-        for li, p in enumerate(self.params):
-            dtype = (level_op.dtype if hasattr(level_op, "dtype")
-                     else level_op.x_diag.dtype)
-            example = self._example_field(lat_shape, k_fine, dtype)
-            MdagM = level_op.MdagM
-            parts = level_op               # all adapters expose diag/hop
-            nulls = self._generate_null_vectors(
-                level_op.M, MdagM, example, p.n_vec, p.setup_iters,
-                jax.random.fold_in(key, li))
-            transfer = self._transfer_from_nulls(nulls, p.block)
-            coarse = self._build_coarse(parts, transfer)
-            self.levels.append(dict(op=level_op, transfer=transfer,
-                                    coarse=coarse, param=p))
-            if verbosity:
-                print(f"MG level {li}: lattice {lat_shape} k={k_fine} "
-                      f"-> coarse {transfer.coarse_shape} n_vec={p.n_vec}")
-            # descend
-            level_op = coarse
-            lat_shape = transfer.coarse_shape
-            k_fine = p.n_vec
+        with otr.span("mg_setup", cat="mg", levels=len(self.params)):
+            for li, p in enumerate(self.params):
+                dtype = (level_op.dtype if hasattr(level_op, "dtype")
+                         else level_op.x_diag.dtype)
+                example = self._example_field(lat_shape, k_fine, dtype)
+                MdagM = level_op.MdagM
+                parts = level_op           # all adapters expose diag/hop
+                with self._phase(li, "null_vectors"):
+                    nulls = self._await_phase(
+                        self._generate_null_vectors(
+                            level_op.M, MdagM, example, p.n_vec,
+                            p.setup_iters, jax.random.fold_in(key, li)))
+                with self._phase(li, "transfer_build"):
+                    transfer = self._await_phase(
+                        self._transfer_from_nulls(nulls, p.block))
+                with self._phase(li, "coarse_probe"):
+                    coarse = self._await_phase(
+                        self._build_coarse(parts, transfer))
+                self.levels.append(dict(op=level_op, transfer=transfer,
+                                        coarse=coarse, param=p))
+                if verbosity:
+                    print(f"MG level {li}: lattice {lat_shape} "
+                          f"k={k_fine} -> coarse "
+                          f"{transfer.coarse_shape} n_vec={p.n_vec}")
+                # descend
+                level_op = coarse
+                lat_shape = transfer.coarse_shape
+                k_fine = p.n_vec
 
     # -- apply ---------------------------------------------------------
     def vcycle(self, level: int, b, x0=None):

@@ -330,27 +330,37 @@ def build_coarse_pairs(fine_parts, transfer: PairTransfer,
     def as_col(out):                       # (latc, 2, n, 2) -> (latc, nc, 2)
         return out.reshape(latc + (nc, 2))
 
+    from ..obs import trace as otr
+
     diag_cols = []
     hop_cols = {d: [] for d in DIRS}
-    for chir in range(2):
-        for b in range(n):
-            e = jnp.zeros(latc + (2, n, 2), F32).at[..., chir, b, 0].set(1.0)
-            dcol = as_col(probe_diag(e))
-            for mu, sign in DIRS:
-                ext = latc[axis_of_mu(mu)]
-                if ext == 1:
-                    hop_cols[(mu, sign)].append(as_col(probe_hop(e, mu, sign)))
-                    continue
-                par = jnp.asarray(coord_parity(mu))[..., None, None, None]
-                ycol = jnp.zeros(latc + (nc, 2), F32)
-                for p in (0, 1):
-                    mask = (par == p).astype(F32)
-                    out = as_col(probe_hop(e * mask, mu, sign))
-                    lit = (jnp.asarray(coord_parity(mu)) == p)[..., None, None]
-                    ycol = jnp.where(lit, ycol, out)
-                    dcol = dcol + jnp.where(lit, out, 0.0)
-                hop_cols[(mu, sign)].append(ycol)
-            diag_cols.append(dcol)
+    # spanned like mg/coarse.build_coarse: the coarse_probe phase of the
+    # MG setup breakdown shows the probe loop in the trace
+    with otr.span("mg_coarse_probe_loop", cat="mg", n_vec=n,
+                  coarse_shape=list(latc)):
+        for chir in range(2):
+            for b in range(n):
+                e = jnp.zeros(latc + (2, n, 2),
+                              F32).at[..., chir, b, 0].set(1.0)
+                dcol = as_col(probe_diag(e))
+                for mu, sign in DIRS:
+                    ext = latc[axis_of_mu(mu)]
+                    if ext == 1:
+                        hop_cols[(mu, sign)].append(
+                            as_col(probe_hop(e, mu, sign)))
+                        continue
+                    par = jnp.asarray(coord_parity(mu))[..., None, None,
+                                                        None]
+                    ycol = jnp.zeros(latc + (nc, 2), F32)
+                    for p in (0, 1):
+                        mask = (par == p).astype(F32)
+                        out = as_col(probe_hop(e * mask, mu, sign))
+                        lit = (jnp.asarray(coord_parity(mu)) == p)[
+                            ..., None, None]
+                        ycol = jnp.where(lit, ycol, out)
+                        dcol = dcol + jnp.where(lit, out, 0.0)
+                    hop_cols[(mu, sign)].append(ycol)
+                diag_cols.append(dcol)
 
     x_diag = jnp.stack(diag_cols, axis=-2)         # (latc, Nc, Nc, 2)
     y = {d: jnp.stack(hop_cols[d], axis=-2) for d in DIRS}

@@ -217,7 +217,8 @@ class _PairSloppyBase:
 _SHARDED_NOTICED = False
 
 
-def _notice_sharded_policy(version: int, policy: str, src: str):
+def _notice_sharded_policy(version: int, policy: str, src: str,
+                           ici_bytes: int | None = None):
     """One-time provenance notice naming the mesh dslash configuration
     actually selected (kernel form + halo policy + how it was chosen:
     pinned, raced, or served from the chip-keyed tunecache warm cache)
@@ -231,9 +232,13 @@ def _notice_sharded_policy(version: int, policy: str, src: str):
         return
     _SHARDED_NOTICED = True
     from ..utils import logging as qlog
+    # comms volume next to the timing winner (obs/comms.py model): the
+    # policies move the SAME bytes — what the race times is transport
+    comms = ("" if not ici_bytes
+             else f"; ICI {ici_bytes / 1024:.1f} KB/device per dslash")
     qlog.printq(
         f"mesh dslash: pallas v{version} eo interior, halo policy "
-        f"{policy} ({src}); pin via QUDA_TPU_PALLAS_VERSION / "
+        f"{policy} ({src}){comms}; pin via QUDA_TPU_PALLAS_VERSION / "
         "QUDA_TPU_SHARDED_POLICY", qlog.SUMMARIZE)
 
 
@@ -337,7 +342,8 @@ class _PackedHopMixin:
                 self._resolve_sharded_policy(0, None)
             else:
                 _notice_sharded_policy(self._pallas_version,
-                                       self._sharded_policy, "pinned")
+                                       self._sharded_policy, "pinned",
+                                       ici_bytes=self._ici_model_bytes())
 
     def _d_to(self, psi_pp, target_parity, out_dtype):
         from ..ops import wilson_packed as wpk
@@ -382,6 +388,20 @@ class _PackedHopMixin:
         return jax.vmap(
             lambda p: self._d_to(p, target_parity, out_dtype))(psi_b)
 
+    def _ici_model_bytes(self):
+        """Per-device ICI bytes of one sharded dslash invocation (the
+        analytic halo model, obs/comms.py) — quoted by the one-time
+        policy notice next to the timing winner; None off-mesh."""
+        if getattr(self, "_mesh", None) is None:
+            return None
+        import numpy as np
+
+        from ..obs import comms as ocomms
+        return ocomms.wilson_eo_halo_model(
+            tuple(self.dims),
+            (int(self._mesh.shape["t"]), int(self._mesh.shape["z"])),
+            itemsize=np.dtype(self.store_dtype).itemsize)["per_device"]
+
     def _build_sharded_fn(self, target_parity, out_dtype, policy: str):
         """jitted shard_map of the sharded eo pallas policy for one
         (parity, out_dtype, halo policy) configuration."""
@@ -420,7 +440,8 @@ class _PackedHopMixin:
         simply loses the race — tune skips failing candidates."""
         pol = self._sharded_policy
         if pol != "auto":
-            _notice_sharded_policy(self._pallas_version, pol, "pinned")
+            _notice_sharded_policy(self._pallas_version, pol, "pinned",
+                                   ici_bytes=self._ici_model_bytes())
             return pol
         won = getattr(self, "_sharded_policy_winner", None)
         if won is not None:
@@ -465,7 +486,8 @@ class _PackedHopMixin:
         _notice_sharded_policy(
             self._pallas_version, won,
             "warm cache (chip-keyed tunecache)" if warm is not None
-            else "raced+cached (QUDA_TPU_SHARDED_POLICY=auto)")
+            else "raced+cached (QUDA_TPU_SHARDED_POLICY=auto)",
+            ici_bytes=self._ici_model_bytes())
         return won
 
     def _sharded_d_to(self, target_parity, out_dtype):

@@ -42,11 +42,21 @@ home for that surface:
                         solve phases, and the pallas VMEM budget audit.
 * ``obs.report``      — the human-readable end-of-session fleet report
                         (fleet_report.txt) rendered from the two above.
+* ``obs.comms``       — the ICI comms ledger (rides QUDA_TPU_TRACE /
+                        QUDA_TPU_METRICS): every halo-exchange seam
+                        records (site, axis, direction, bytes/device,
+                        policy, dtype, mesh); per-solve ICI roofline
+                        rows emitted alongside the HBM rows.
+* ``obs.costmodel``   — the KERNEL_MODELS cross-check: analytic
+                        flops/bytes vs Compiled.cost_analysis() of the
+                        XLA reference stencils and the operand-footprint
+                        floors; drift lint + per-session cost_drift.tsv.
 * ``obs.schema``      — the canonical registry of every trace-event and
                         metric name (linted bidirectionally by
                         tests/test_obs_schema_lint.py; the metrics
                         registry also validates names at record time).
 """
 
-from . import (convergence, history, memory, metrics, regress,  # noqa: F401
-               report, roofline, schema, trace)
+from . import (comms, convergence, costmodel, history,  # noqa: F401
+               memory, metrics, regress, report, roofline, schema,
+               trace)

@@ -33,12 +33,21 @@ from typing import Dict, Iterable, List, Optional, Tuple
 # units where larger is better; everything else regresses upward
 THROUGHPUT_UNITS = ("gflops", "gbps", "msites_per_s")
 
+# units tracked as TREND LINES only — never gated in either direction:
+# ici_gb (analytic interconnect bytes per dslash apply, obs/comms.py)
+# moves with the decomposition, not with performance, and drift_ratio
+# (obs/costmodel.py analytic-vs-footprint) is a consistency check whose
+# pass/fail lives in the drift lint, not the perf gate
+TRENDED_ONLY_UNITS = ("ici_gb", "drift_ratio")
+
 # suite-row fields that become canonical observations: (field, unit).
 # ordered — for the secs family only the FIRST present field is taken
 # (secs_per_call and secs are the same observable at different call
 # sites, and double-recording would duplicate the series)
 _VALUE_FIELDS = (("gflops", "gflops"), ("gbps", "gbps"),
-                 ("msites_per_s", "msites_per_s"), ("iters", "iters"))
+                 ("msites_per_s", "msites_per_s"), ("iters", "iters"),
+                 ("ici_gb", "ici_gb"),
+                 ("cost_drift_ratio", "drift_ratio"))
 _SECS_FIELDS = (("secs_per_call", "secs"), ("secs", "secs"),
                 ("apply_secs", "apply_secs"))
 

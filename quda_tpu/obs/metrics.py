@@ -206,6 +206,11 @@ def record_execution(api: str, form: str, shape, dtype: str,
         otr.event("compile", cat="metrics", api=api, form=form,
                   shape=list(shape), dtype=dtype, solver=solver,
                   seconds=round(float(seconds), 6))
+        # cost-model cross-check capture: the session's drift report
+        # (obs/costmodel.py, cost_drift.tsv at end_quda) covers exactly
+        # the forms that compiled here
+        from . import costmodel as ocost
+        ocost.note_compile(api, form, shape, dtype, solver, seconds)
     r.inc("executions_total", 1.0, {"api": api, "form": form})
     return first
 

@@ -76,7 +76,13 @@ def compare(current_rows: List[dict], hist: qhist.History,
         v["baseline"] = bv
         v["baseline_source"] = base.get("source")
         v["ratio"] = round(row["value"] / bv, 4) if bv else None
-        if row["unit"] in qhist.THROUGHPUT_UNITS:
+        if row["unit"] in qhist.TRENDED_ONLY_UNITS:
+            # comms volume / cost-drift ratio: a trend line the first
+            # chip window starts, never a gate (the drift LINT owns
+            # pass/fail for the ratio; ici bytes change with the
+            # decomposition, not the code's speed)
+            v["compare"] = "trended"
+        elif row["unit"] in qhist.THROUGHPUT_UNITS:
             lim = bv * (1.0 - tol)
             if row["value"] < lim:
                 v["compare"] = "regression"

@@ -125,6 +125,30 @@ def render(snap: Optional[dict] = None) -> str:
         lines.append("  (no retries, degradations, or breakdowns)")
     lines.append("")
 
+    # -- MG setup attribution --
+    mg_phases = _by_name(snap, "counters", "mg_setup_phase_seconds_total")
+    if mg_phases:
+        lines.append("## MG setup breakdown (per level / phase, "
+                     "wall seconds)")
+        total = _counter_total(snap, "mg_setup_seconds_total")
+        for labels, v in mg_phases:
+            lines.append(f"  level {labels.get('level', '?')} "
+                         f"{labels.get('phase', '?'):16s} {v:.3f} s")
+        phase_sum = _counter_total(snap, "mg_setup_phase_seconds_total")
+        lines.append(f"  phases {phase_sum:.3f} s of {total:.3f} s "
+                     "setup wall")
+        lines.append("")
+
+    # -- ICI comms attribution --
+    ici = _by_name(snap, "counters", "ici_bytes_total")
+    if ici:
+        lines.append("## ICI comms (ledger-attributed bytes)")
+        for labels, v in ici:
+            lines.append(f"  axes {labels.get('axis', '?'):8s} "
+                         f"policy {labels.get('policy', '?'):16s} "
+                         f"{_mb(v)}")
+        lines.append("")
+
     # -- VMEM budget audit --
     lines.append("## Pallas VMEM budgets (single-buffer, vs "
                  f"{omem.SCOPED_VMEM_MB:g} MB scoped limit)")

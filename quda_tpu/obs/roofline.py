@@ -199,14 +199,21 @@ def reset():
     _dropped = 0
 
 
-def save(fname: str = "roofline.tsv") -> Optional[str]:
-    """Dump accumulated rows as a tsv under the resource path (the
-    profile_N.tsv sibling); None when no path or no rows."""
+def save(fname: str = "roofline.tsv",
+         path: Optional[str] = None) -> Optional[str]:
+    """Dump accumulated rows as a tsv under ``path`` (default: the
+    resource path — the profile_N.tsv sibling); None when no path or no
+    rows.  The ICI attribution rows of the comms ledger (obs/comms.py
+    ``attribute_solve``) are appended alongside the HBM rows: same
+    form/seconds/gbps columns, percent column against the nominal ICI
+    link bandwidth instead of the HBM demonstrated peak."""
     import os
 
+    from . import comms as ocomms
     from ..utils import config as qconf
-    path = qconf.get("QUDA_TPU_RESOURCE_PATH", fresh=True)
-    if not path or not _rows:
+    path = path or qconf.get("QUDA_TPU_RESOURCE_PATH", fresh=True)
+    ici_rows = ocomms.solve_rows()
+    if not path or not (_rows or ici_rows):
         return None
     os.makedirs(path, exist_ok=True)
     cols = ("form", "sites", "applies", "nrhs", "seconds", "gflops",
@@ -219,4 +226,16 @@ def save(fname: str = "roofline.tsv") -> Optional[str]:
         if _dropped:
             fh.write(f"# TRUNCATED: {_dropped} rows past the "
                      f"{_MAX_ROWS}-row cap were dropped\n")
+        if ici_rows:
+            fh.write(f"# ICI attribution (comms ledger; gbps = mesh-"
+                     f"aggregate, pct = PER-DEVICE rate vs the nominal "
+                     f"{ocomms.ICI_NOMINAL_GBPS:g} GB/s per-chip link, "
+                     "NOT the HBM peak)\n")
+            for r in ici_rows:
+                fh.write("\t".join(str(v) for v in (
+                    r["form"], r["ici_bytes"], r["applies"], "",
+                    r["seconds"], "", r["gbps"], "",
+                    r["pct_nominal_ici"],
+                    f"{r['label']}|{r['policy']}|axes={r['axes']}"
+                    f"|devices={r['devices']}")) + "\n")
     return out

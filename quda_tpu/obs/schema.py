@@ -66,6 +66,18 @@ TRACE_EVENTS: dict[str, dict] = {
                         "doc": "unitarity screen exceeded tolerance"},
     "fault_injected": {"cat": "robust",
                        "doc": "QUDA_TPU_FAULT arm fired (drill)"},
+    # ICI comms ledger (obs/comms.py)
+    "ici_exchange": {"cat": "comms",
+                     "doc": "one halo-exchange seam recorded into the "
+                            "ledger (per trace, bytes from the traced "
+                            "slab shapes)"},
+    "ici_solve": {"cat": "comms",
+                  "doc": "per-solve ICI attribution row (ledger model "
+                         "x measured applies, vs nominal link BW)"},
+    # cost-model cross-check (obs/costmodel.py)
+    "cost_drift": {"cat": "costmodel",
+                   "doc": "one KERNEL_MODELS drift verdict (analytic "
+                          "vs XLA reference flops + footprint floor)"},
     # serving-grade accounting (obs/metrics.py / obs/memory.py)
     "compile": {"cat": "metrics",
                 "doc": "first execution of a (api, form, shape, dtype, "
@@ -164,6 +176,21 @@ METRICS: dict[str, dict] = {
         "type": GAUGE,
         "help": "selected z-block working-set bytes (last _pick_bz "
                 "decision), by knob"},
+    # ICI comms ledger (obs/comms.py)
+    "ici_bytes_total": {
+        "type": COUNTER,
+        "help": "interconnect bytes attributed to solves (halo model x "
+                "applies) and split-grid replications, by axis/policy"},
+    # MG setup attribution (mg/mg.py _setup phase breakdown)
+    "mg_setup_phase_seconds_total": {
+        "type": COUNTER,
+        "help": "MG setup wall seconds per hierarchy level and phase "
+                "(null_vectors | transfer_build | coarse_probe), by "
+                "level/phase"},
+    "mg_setup_seconds_total": {
+        "type": COUNTER,
+        "help": "total MG setup wall seconds per hierarchy build, by "
+                "levels"},
     # bench harness (bench_suite.py)
     "bench_rows_total": {
         "type": COUNTER,

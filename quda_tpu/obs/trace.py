@@ -60,6 +60,7 @@ class _Session:
         self.dropped = 0
         self.lock = threading.Lock()
         self.depth: dict = {}      # thread ident -> current span depth
+        self.device_pids: dict = {}  # device label -> chrome pid
         try:
             import jax.profiler
             self.annotation_cls = getattr(jax.profiler, "TraceAnnotation",
@@ -139,10 +140,69 @@ def _push(s: _Session, chrome_ev: dict, jsonl_ev: Optional[dict]):
             s.jsonl.append(jsonl_ev)
 
 
-class _Span:
-    __slots__ = ("name", "cat", "args", "_ts", "_ann", "_depth", "_tid")
+def _device_pid(s: _Session, label: str, desc: str) -> int:
+    """Chrome pid for one device track; first use emits the perfetto
+    process metadata naming it (mesh coordinates in the track name) —
+    metadata rows bypass the event cap (bounded by device count) and
+    pid 0 stays the host track."""
+    with s.lock:
+        pid = s.device_pids.get(label)
+        if pid is not None:
+            return pid
+        if not s.device_pids:
+            s.chrome.append({"name": "process_name", "ph": "M",
+                             "pid": 0, "tid": 0,
+                             "args": {"name": "host (api spans)"}})
+        pid = 1 + len(s.device_pids)
+        s.device_pids[label] = pid
+        s.chrome.append({"name": "process_name", "ph": "M", "pid": pid,
+                         "tid": 0, "args": {"name": desc}})
+        s.chrome.append({"name": "process_sort_index", "ph": "M",
+                         "pid": pid, "tid": 0,
+                         "args": {"sort_index": pid}})
+        return pid
 
-    def __init__(self, name: str, cat: str, args: dict):
+
+def _mirror_span_per_device(s: _Session, name: str, cat: str, ts: float,
+                            dur: float, mesh, args: dict) -> int:
+    """One chrome span row per LOCAL device of ``mesh``, on that
+    device's own pid track (mesh coordinates in the track name), so
+    perfetto shows a sharded solve as parallel device rows instead of
+    one collapsed host track.  The duration is the host-measured span
+    (per-device device timelines need a profiler capture); what the
+    rows add is the device/mesh-coordinate attribution."""
+    import numpy as np
+    try:
+        import jax
+        my_proc = jax.process_index()
+    except Exception:
+        return 0
+    n = 0
+    # partitioned axes only in the track names (a size-1 axis carries
+    # no placement information); all axes when nothing is partitioned
+    parted = [ax for ax in mesh.axis_names if mesh.shape[ax] > 1] \
+        or list(mesh.axis_names)
+    for idx, dev in np.ndenumerate(mesh.devices):
+        if getattr(dev, "process_index", 0) != my_proc:
+            continue
+        label = f"{getattr(dev, 'platform', 'dev')}:{getattr(dev, 'id', 0)}"
+        coords = ",".join(f"{ax}={i}" for ax, i
+                          in zip(mesh.axis_names, idx) if ax in parted)
+        pid = _device_pid(s, label, f"device {label} [{coords}]")
+        _push(s, {"name": name, "cat": cat, "ph": "X",
+                  "ts": round(ts, 3), "dur": round(dur, 3),
+                  "pid": pid, "tid": 0,
+                  "args": dict(args, device=label, mesh_coords=coords)},
+              None)
+        n += 1
+    return n
+
+
+class _Span:
+    __slots__ = ("name", "cat", "args", "_ts", "_ann", "_depth", "_tid",
+                 "_mesh")
+
+    def __init__(self, name: str, cat: str, args: dict, mesh=None):
         self.name = name
         self.cat = cat
         self.args = args
@@ -150,6 +210,7 @@ class _Span:
         self._ts = 0.0
         self._depth = 0
         self._tid = 0
+        self._mesh = mesh
 
     def __enter__(self):
         s = _session
@@ -179,21 +240,32 @@ class _Span:
         dur = _now_us(s) - self._ts
         s.depth[self._tid] = self._depth - 1
         args = dict(self.args, depth=self._depth)
+        n_dev = 0
+        if self._mesh is not None:
+            n_dev = _mirror_span_per_device(s, self.name, self.cat,
+                                            self._ts, dur, self._mesh,
+                                            dict(self.args))
+        jsonl = {"kind": "span", "name": self.name, "cat": self.cat,
+                 "ts_us": round(self._ts, 3), "dur_us": round(dur, 3),
+                 "depth": self._depth, **self.args}
+        if n_dev:
+            jsonl["devices"] = n_dev
         _push(s, {"name": self.name, "cat": self.cat, "ph": "X",
                   "ts": round(self._ts, 3), "dur": round(dur, 3),
-                  "pid": 0, "tid": 0, "args": args},
-              {"kind": "span", "name": self.name, "cat": self.cat,
-               "ts_us": round(self._ts, 3), "dur_us": round(dur, 3),
-               "depth": self._depth, **self.args})
+                  "pid": 0, "tid": 0, "args": args}, jsonl)
         return False
 
 
-def span(name: str, cat: str = "api", **args):
+def span(name: str, cat: str = "api", mesh=None, **args):
     """A nestable named span; the module no-op singleton when tracing is
-    off (so call sites stay branch-cheap on the disabled path)."""
+    off (so call sites stay branch-cheap on the disabled path).  With
+    ``mesh`` (a jax.sharding.Mesh) the span is additionally mirrored
+    onto one chrome track per local mesh device, mesh coordinates in
+    the track names — a sharded solve renders as parallel device rows
+    in perfetto instead of one collapsed host track."""
     if _session is None:
         return _NOOP
-    return _Span(name, cat, args)
+    return _Span(name, cat, args, mesh=mesh)
 
 
 def event(name: str, cat: str = "event", **fields):
@@ -245,9 +317,12 @@ def api_span(name: str, **args):
 
 
 @contextmanager
-def phase(category: str, profile: Optional[str] = None, **args):
+def phase(category: str, profile: Optional[str] = None, mesh=None,
+          **args):
     """One category interval on ``profile``'s TimeProfile + a trace span
-    — the setup/compute/comms/epilogue breakdown inside an api_span."""
+    — the setup/compute/comms/epilogue breakdown inside an api_span.
+    ``mesh`` mirrors the span onto per-device chrome tracks (see
+    :func:`span`)."""
     from ..utils import timer as qtimer
     prof = (qtimer.get_profile(profile)
             if profile is not None and qtimer._profiling_enabled()
@@ -255,7 +330,7 @@ def phase(category: str, profile: Optional[str] = None, **args):
     if prof is not None:
         prof.start(category)
     try:
-        with span(category, cat=category, **args):
+        with span(category, cat=category, mesh=mesh, **args):
             yield
     finally:
         if prof is not None:

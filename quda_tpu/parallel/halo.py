@@ -33,7 +33,16 @@ def _permute_slice(face, axis_name: str, towards_lower: bool, n: int):
     """Send `face` to the neighbouring shard along axis_name.
 
     towards_lower: shard i sends to shard i-1 (receives from i+1).
+
+    The ONE ``lax.ppermute`` home in the package (the comms-ledger lint,
+    tests/test_comms_ledger_lint.py, pins this): every face transfer
+    recorded here lands in the ICI ledger with the enclosing policy
+    scope's labels (obs/comms.py — no-op when the ledger is off).
     """
+    from ..obs import comms as ocomms
+    ocomms.record_exchange(face, axis=axis_name,
+                           direction="down" if towards_lower else "up",
+                           mesh_axes=(n,))
     if towards_lower:
         perm = [(i, (i - 1) % n) for i in range(n)]
     else:

@@ -285,13 +285,16 @@ def dslash_pallas_sharded(gauge_pl, gauge_bw_pl, psi_pl, X: int, mesh,
                                gauge_bw=gauge_bw_pl, interpret=interpret,
                                tb_sign=tb_sign and n_t == 1)
 
-    for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
-        if n == 1:
-            continue                      # periodic wrap is correct
-        sign_hi, sign_lo = _t_edge_signs(name, n, mu, R, tb_sign)
-        out = _wilson_fix_faces_v2(out, gauge_pl, gauge_bw_pl, psi_pl,
-                                   axis, name, n, mu, exchange,
-                                   sign_hi, sign_lo)
+    from ..obs import comms as ocomms
+    with ocomms.scope("wilson_sharded_v2", policy,
+                      mesh_axes=(n_t, n_z)):
+        for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
+            if n == 1:
+                continue                  # periodic wrap is correct
+            sign_hi, sign_lo = _t_edge_signs(name, n, mu, R, tb_sign)
+            out = _wilson_fix_faces_v2(out, gauge_pl, gauge_bw_pl,
+                                       psi_pl, axis, name, n, mu,
+                                       exchange, sign_hi, sign_lo)
     return out
 
 
@@ -419,15 +422,19 @@ def dslash_staggered_pallas_sharded_v3(fat_pl, psi_pl, X: int, mesh,
     out = dslash_staggered_pallas_v3(fat_pl, psi_pl, X, long_pl=long_pl,
                                      interpret=interpret)
 
+    from ..obs import comms as ocomms
     t_ax, z_ax = -3, -2
-    for axis, name, n, mu in ((t_ax, "t", n_t, 3), (z_ax, "z", n_z, 2)):
-        if n == 1:
-            continue
-        out = _stag_fix_faces(out, fat_pl, fat_pl, psi_pl, 1, axis,
-                              name, n, mu, exchange)
-        if long_pl is not None:
-            out = _stag_fix_faces(out, long_pl, long_pl, psi_pl, 3,
-                                  axis, name, n, mu, exchange)
+    with ocomms.scope("staggered_sharded_v3", policy,
+                      mesh_axes=(n_t, n_z)):
+        for axis, name, n, mu in ((t_ax, "t", n_t, 3),
+                                  (z_ax, "z", n_z, 2)):
+            if n == 1:
+                continue
+            out = _stag_fix_faces(out, fat_pl, fat_pl, psi_pl, 1, axis,
+                                  name, n, mu, exchange)
+            if long_pl is not None:
+                out = _stag_fix_faces(out, long_pl, long_pl, psi_pl, 3,
+                                      axis, name, n, mu, exchange)
     return out
 
 
@@ -458,14 +465,18 @@ def dslash_staggered_pallas_sharded(fat_pl, fat_bw_pl, psi_pl, X: int,
                                   long_bw_pl=long_bw_pl,
                                   interpret=interpret)
 
-    for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
-        if n == 1:
-            continue
-        out = _stag_fix_faces_v2(out, fat_pl, fat_bw_pl, psi_pl, 1,
-                                 axis, name, n, mu, exchange)
-        if long_pl is not None:
-            out = _stag_fix_faces_v2(out, long_pl, long_bw_pl, psi_pl,
-                                     3, axis, name, n, mu, exchange)
+    from ..obs import comms as ocomms
+    with ocomms.scope("staggered_sharded_v2", policy,
+                      mesh_axes=(n_t, n_z)):
+        for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
+            if n == 1:
+                continue
+            out = _stag_fix_faces_v2(out, fat_pl, fat_bw_pl, psi_pl, 1,
+                                     axis, name, n, mu, exchange)
+            if long_pl is not None:
+                out = _stag_fix_faces_v2(out, long_pl, long_bw_pl,
+                                         psi_pl, 3, axis, name, n, mu,
+                                         exchange)
     return out
 
 
@@ -528,15 +539,21 @@ def dslash_staggered_eo_pallas_sharded_v3(fat_here_pl, fat_there_pl,
         long_here_pl=long_here_pl, long_there_pl=long_there_pl,
         interpret=interpret)
 
+    from ..obs import comms as ocomms
     t_ax, z_ax = -3, -2
-    for axis, name, n, mu in ((t_ax, "t", n_t, 3), (z_ax, "z", n_z, 2)):
-        if n == 1:
-            continue
-        out = _stag_fix_faces(out, fat_here_pl, fat_there_pl, psi_pl, 1,
-                              axis, name, n, mu, exchange)
-        if long_here_pl is not None:
-            out = _stag_fix_faces(out, long_here_pl, long_there_pl,
-                                  psi_pl, 3, axis, name, n, mu, exchange)
+    with ocomms.scope(f"staggered_eo_sharded_v3:p{target_parity}",
+                      policy, mesh_axes=(n_t, n_z)):
+        for axis, name, n, mu in ((t_ax, "t", n_t, 3),
+                                  (z_ax, "z", n_z, 2)):
+            if n == 1:
+                continue
+            out = _stag_fix_faces(out, fat_here_pl, fat_there_pl,
+                                  psi_pl, 1, axis, name, n, mu,
+                                  exchange)
+            if long_here_pl is not None:
+                out = _stag_fix_faces(out, long_here_pl, long_there_pl,
+                                      psi_pl, 3, axis, name, n, mu,
+                                      exchange)
     return out
 
 
@@ -574,15 +591,19 @@ def dslash_staggered_eo_pallas_sharded(fat_here_pl, fat_bw_pl, psi_pl,
         long_here_pl=long_here_pl, long_bw_pl=long_bw_pl,
         interpret=interpret)
 
-    for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
-        if n == 1:
-            continue
-        out = _stag_fix_faces_v2(out, fat_here_pl, fat_bw_pl, psi_pl, 1,
-                                 axis, name, n, mu, exchange)
-        if long_here_pl is not None:
-            out = _stag_fix_faces_v2(out, long_here_pl, long_bw_pl,
-                                     psi_pl, 3, axis, name, n, mu,
+    from ..obs import comms as ocomms
+    with ocomms.scope(f"staggered_eo_sharded_v2:p{target_parity}",
+                      policy, mesh_axes=(n_t, n_z)):
+        for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
+            if n == 1:
+                continue
+            out = _stag_fix_faces_v2(out, fat_here_pl, fat_bw_pl,
+                                     psi_pl, 1, axis, name, n, mu,
                                      exchange)
+            if long_here_pl is not None:
+                out = _stag_fix_faces_v2(out, long_here_pl, long_bw_pl,
+                                         psi_pl, 3, axis, name, n, mu,
+                                         exchange)
     return out
 
 
@@ -636,13 +657,16 @@ def dslash_eo_pallas_sharded(u_here_pl, u_bw_pl, psi_pl, dims,
         interpret=interpret, out_dtype=out_dtype,
         tb_sign=tb_sign and n_t == 1)
 
-    for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
-        if n == 1:
-            continue
-        sign_hi, sign_lo = _t_edge_signs(name, n, mu, R, tb_sign)
-        out = _wilson_fix_faces_v2(out, u_here_pl, u_bw_pl, psi_pl,
-                                   axis, name, n, mu, exchange,
-                                   sign_hi, sign_lo)
+    from ..obs import comms as ocomms
+    with ocomms.scope(f"wilson_eo_sharded_v2:p{target_parity}", policy,
+                      mesh_axes=(n_t, n_z)):
+        for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
+            if n == 1:
+                continue
+            sign_hi, sign_lo = _t_edge_signs(name, n, mu, R, tb_sign)
+            out = _wilson_fix_faces_v2(out, u_here_pl, u_bw_pl, psi_pl,
+                                       axis, name, n, mu, exchange,
+                                       sign_hi, sign_lo)
     return out
 
 
@@ -684,12 +708,16 @@ def dslash_eo_pallas_sharded_v3(u_here_pl, u_there_pl, psi_pl, dims,
         interpret=interpret, out_dtype=out_dtype,
         tb_sign=tb_sign and n_t == 1)
 
-    for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
-        if n == 1:
-            continue
-        sign_hi, _ = _t_edge_signs(name, n, mu, R, tb_sign)
-        out = _wilson_fix_faces_v3(out, u_here_pl, u_there_pl, psi_pl,
-                                   axis, name, n, mu, exchange, sign_hi)
+    from ..obs import comms as ocomms
+    with ocomms.scope(f"wilson_eo_sharded_v3:p{target_parity}", policy,
+                      mesh_axes=(n_t, n_z)):
+        for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
+            if n == 1:
+                continue
+            sign_hi, _ = _t_edge_signs(name, n, mu, R, tb_sign)
+            out = _wilson_fix_faces_v3(out, u_here_pl, u_there_pl,
+                                       psi_pl, axis, name, n, mu,
+                                       exchange, sign_hi)
     return out
 
 
@@ -719,10 +747,14 @@ def dslash_pallas_sharded_v3(gauge_pl, psi_pl, X: int, mesh,
                                   interpret=interpret,
                                   tb_sign=tb_sign and n_t == 1)
 
-    for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
-        if n == 1:
-            continue
-        sign_hi, _ = _t_edge_signs(name, n, mu, R, tb_sign)
-        out = _wilson_fix_faces_v3(out, gauge_pl, gauge_pl, psi_pl,
-                                   axis, name, n, mu, exchange, sign_hi)
+    from ..obs import comms as ocomms
+    with ocomms.scope("wilson_sharded_v3", policy,
+                      mesh_axes=(n_t, n_z)):
+        for axis, name, n, mu in ((-3, "t", n_t, 3), (-2, "z", n_z, 2)):
+            if n == 1:
+                continue
+            sign_hi, _ = _t_edge_signs(name, n, mu, R, tb_sign)
+            out = _wilson_fix_faces_v3(out, gauge_pl, gauge_pl, psi_pl,
+                                       axis, name, n, mu, exchange,
+                                       sign_hi)
     return out

@@ -271,6 +271,19 @@ def wilson_axis_fused_halo(psi_pl: jnp.ndarray, u_pl: jnp.ndarray,
     kern = _make_fused_kernel_bidir(axis_name, mu)
     ip = _require_dist_interpret(interpret)
 
+    # ICI ledger: two half-spinor boundary strips per device ride the
+    # in-kernel RDMAs each invocation; the strips are kernel-internal
+    # VMEM buffers, so the bytes are passed explicitly (obs/comms.py;
+    # no-op when the ledger is off)
+    from ..obs import comms as ocomms
+    strip_elems = 2 * 3 * 2
+    for s in psi_pl.shape[4:]:
+        strip_elems *= s
+    ocomms.record_exchange(axis=axis_name, direction="bidir",
+                           policy="fused_halo", nbytes=2 * 4 * strip_elems,
+                           n_slabs=2,
+                           mesh_axes=(mesh.shape[axis_name],))
+
     def local(psi, u):
         strip = pltpu.VMEM((2, 3, 2, 1) + psi.shape[4:], F32)
         return pl.pallas_call(
@@ -367,6 +380,11 @@ def slab_exchange_bidir(send_down: jnp.ndarray, send_up: jnp.ndarray,
     + wait, expressed as a drop-in for parallel/halo._permute_slice)."""
     kern = _make_exchange_kernel(axis_name, tuple(mesh_axes))
     ip = _require_dist_interpret(interpret)
+    # ICI ledger: both slabs leave this device in one fused launch
+    # (obs/comms.py; the enclosing policy scope labels the row)
+    from ..obs import comms as ocomms
+    ocomms.record_exchange((send_down, send_up), axis=axis_name,
+                           direction="bidir", policy="fused_halo")
     anyspec = pl.BlockSpec(memory_space=pltpu.ANY)
     return pl.pallas_call(
         kern,
@@ -475,6 +493,14 @@ def wilson_zbwd_fused_halo(psi_pl: jnp.ndarray, uz_pl: jnp.ndarray,
 
     kern = _make_fused_kernel(axis_name)
     ip = _require_dist_interpret(interpret)
+
+    # ICI ledger: one product boundary row per device per invocation
+    from ..obs import comms as ocomms
+    ocomms.record_exchange(axis=axis_name, direction="down",
+                           policy="fused_halo",
+                           nbytes=4 * 2 * 3 * 2 * psi_pl.shape[-1],
+                           n_slabs=1,
+                           mesh_axes=(mesh.shape[axis_name],))
 
     def local(psi, uz):
         yx = psi.shape[-1]

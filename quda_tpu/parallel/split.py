@@ -54,6 +54,14 @@ def split_grid_solve(solve_one: Callable, gauge, B: jnp.ndarray,
 
     Returns the batch of solutions with the same sharding.
     """
+    # ICI ledger (obs/comms.py): lane placement replicates the gauge
+    # onto every sub-grid — (n_src - 1) x its bytes travel the
+    # interconnect at this device_put (a per-call record, unlike the
+    # trace-time halo rows); the sources are scattered, not replicated
+    from ..obs import comms as ocomms
+    ocomms.record_replication(gauge, axis=SRC_AXIS,
+                              n_devices=mesh.shape[SRC_AXIS],
+                              what="gauge")
     gauge_sh = jax.device_put(gauge, NamedSharding(mesh, gauge_pspec()))
     b_sh = jax.device_put(B, NamedSharding(mesh, spinor_pspec(batched=True)))
 

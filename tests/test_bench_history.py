@@ -262,3 +262,38 @@ def test_bench_suite_dry_compare_delegates(tmp_path, capsys):
                             f"--trends={trends}"])
     assert rc2 != 0
     assert '"rejected"' in capsys.readouterr().out
+
+
+def test_ici_and_drift_units_trended_never_gated(tmp_path, capsys):
+    """The round-13 metric families: ici_gb (sharded rows' analytic
+    comms volume) and cost_drift_ratio become canonical TRENDED series
+    — a large move in either direction starts a trend line but never
+    fails the gate (the drift LINT owns pass/fail for the ratio)."""
+    d = tmp_path / "hist"
+    d.mkdir()
+    sh = {"suite": "sharded", "name": "wilson_eo_sharded_v2_facefix_24",
+          "gflops": 4000.0, "secs_per_call": 1e-3, "platform": "tpu",
+          "lattice": [24] * 4, "mesh": [1, 2], "ici_gb": 0.05}
+    cm = {"suite": "costmodel", "name": "cost_drift_wilson_v2",
+          "form": "wilson_v2", "cost_drift_ratio": 1.5,
+          "platform": "cpu", "lattice": [4] * 4}
+    _write_round(d, 1, [sh, cm])
+    # round 2: comms volume doubles, drift ratio moves — trended only
+    _write_round(d, 2, [dict(sh, ici_gb=0.1),
+                        dict(cm, cost_drift_ratio=1.9)])
+    rc, trends = _run(d, tmp_path)
+    assert rc == 0                      # nothing gated
+    out = capsys.readouterr().out
+    assert "rejected" not in out
+    assert '"compare": "trended"' in out
+    body = trends.read_text()
+    # --latest: round 2 plays "current" (column 11), round 1 is history
+    ici = next(ln for ln in body.splitlines() if "\tici_gb\t" in ln)
+    assert "r01:0.05" in ici and ici.split("\t")[11] == "0.1"
+    drift = next(ln for ln in body.splitlines()
+                 if "\tdrift_ratio\t" in ln)
+    assert "r01:1.5" in drift and drift.split("\t")[11] == "1.9"
+    # a genuine gflops regression in the same rows still gates
+    _write_round(d, 3, [dict(sh, gflops=3000.0, ici_gb=0.1)])
+    rc3, _ = _run(d, tmp_path)
+    assert rc3 != 0

@@ -1,0 +1,139 @@
+"""Cost-model cross-check tests (obs/costmodel.py): the drift LINT over
+every registered pallas traffic model, the deliberately-wrong fixtures
+(a factor-2 slip in either direction must fail), the
+Compiled.cost_analysis capture, and the record_execution ->
+note_compile -> cost_drift.tsv session report."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.obs import costmodel as ocost
+from quda_tpu.obs import metrics as omet
+from quda_tpu.obs import trace as otr
+from quda_tpu.obs.roofline import KERNEL_MODELS
+from quda_tpu.utils import config as qconf
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    ocost.reset()
+    omet.stop(flush_files=False)
+    otr.stop(flush_files=False)
+    qconf.reset_cache()
+    yield
+    ocost.reset()
+    omet.stop(flush_files=False)
+    otr.stop(flush_files=False)
+    qconf.reset_cache()
+
+
+def test_xla_cost_reports_flops_and_bytes():
+    cost = ocost.xla_cost(lambda x: jnp.einsum("ij,j->i", x, x[0]),
+                          jnp.ones((32, 32), jnp.float32))
+    assert cost["flops"] and cost["flops"] > 0
+    assert cost["bytes"] and cost["bytes"] > 0
+
+
+def test_drift_lint_passes_for_every_registered_pallas_form():
+    """ISSUE acceptance: the cost-model drift lint passes for every
+    registered pallas form — and covers ALL of them (a form with a
+    traffic model but no footprint spec fails, so a new kernel cannot
+    ship unchecked)."""
+    rows = ocost.lint()
+    assert len(rows) == len(ocost.checkable_forms())
+    for r in rows:
+        assert r["checked"] and r["ok"], r
+        # the flop models sit a few percent under XLA's HLO count
+        assert 0.9 <= r["flops_ratio"] <= 1.3, r
+        assert (ocost.BYTES_REREAD_MIN <= r["bytes_ratio"]
+                <= ocost.BYTES_REREAD_MAX), r
+
+
+def test_checkable_forms_are_the_pallas_models():
+    forms = set(ocost.checkable_forms())
+    assert "wilson_v2" in forms and "staggered_fat_naik_fused" in forms
+    # honest flops-only rows are exempt by design
+    assert "wilson_xla" not in forms and "generic" not in forms
+
+
+def test_deliberately_inflated_bytes_model_fails(monkeypatch):
+    """A factor-2 bytes inflation (the classic copied-table slip) must
+    fail the lint."""
+    wrong = dict(KERNEL_MODELS["wilson_v2"], bytes_per_site=2 * 1152)
+    monkeypatch.setitem(KERNEL_MODELS, "wilson_v2", wrong)
+    ocost.reset()          # drop the cached passing verdict
+    row = ocost.drift_row("wilson_v2")
+    assert not row["ok"]
+    assert any("bytes drift" in r for r in row["reasons"])
+    with pytest.raises(AssertionError, match="bytes drift"):
+        ocost.lint(["wilson_v2"])
+
+
+def test_below_footprint_bytes_model_fails(monkeypatch):
+    """A model claiming LESS traffic than the operand footprint (data
+    cannot be moved less than once) must fail."""
+    wrong = dict(KERNEL_MODELS["wilson_v2"], bytes_per_site=600)
+    monkeypatch.setitem(KERNEL_MODELS, "wilson_v2", wrong)
+    ocost.reset()
+    row = ocost.drift_row("wilson_v2")
+    assert not row["ok"] and any("bytes drift" in r
+                                 for r in row["reasons"])
+
+
+def test_wrong_flops_model_fails(monkeypatch):
+    wrong = dict(KERNEL_MODELS["staggered_fat"], flops_per_site=2500)
+    monkeypatch.setitem(KERNEL_MODELS, "staggered_fat", wrong)
+    ocost.reset()
+    row = ocost.drift_row("staggered_fat")
+    assert not row["ok"] and any("flops drift" in r
+                                 for r in row["reasons"])
+
+
+def test_agreeing_model_fixture_and_drift_event(tmp_path):
+    """An agreeing model passes and mirrors a cost_drift trace event."""
+    otr.start(str(tmp_path))
+    ocost.reset()
+    row = ocost.drift_row("wilson_v2")
+    assert row["ok"]
+    paths = otr.stop()
+    import json
+    lines = [json.loads(ln) for ln in open(paths["jsonl"])]
+    evs = [ln for ln in lines if ln.get("name") == "cost_drift"]
+    assert evs and evs[0]["form"] == "wilson_v2" and evs[0]["ok"]
+
+
+def test_record_execution_notes_compiles_once(tmp_path):
+    """The Compiled-capture hook: metrics.record_execution notes each
+    DISTINCT key's first execution for the session drift report."""
+    omet.start(str(tmp_path))
+    omet.record_execution("invert_quda", "wilson_v2", (4, 4, 4, 4),
+                          "single", "cg", 1.25)
+    omet.record_execution("invert_quda", "wilson_v2", (4, 4, 4, 4),
+                          "single", "cg", 0.01)    # warm: not re-noted
+    omet.record_execution("invert_quda", "gcr_mg", (4, 4, 4, 4),
+                          "single", "gcr-mg", 3.0)
+    noted = ocost.noted_compiles()
+    assert [n["form"] for n in noted] == ["wilson_v2", "gcr_mg"]
+    assert noted[0]["seconds"] == 1.25
+
+
+def test_save_report_joins_models_and_verdicts(tmp_path):
+    ocost.note_compile("invert_quda", "wilson_v2", (4, 4, 4, 4),
+                       "single", "cg", 2.0)
+    ocost.note_compile("invert_quda", "gcr_mg", (4, 4, 4, 4),
+                       "single", "gcr-mg", 5.0)
+    ocost.drift_row("wilson_v2")       # probe so the verdict is cached
+    out = ocost.save_report(path=str(tmp_path))
+    body = open(out).read()
+    lines = body.strip().splitlines()
+    assert lines[0].startswith("api\tform\tsolver")
+    w = next(ln for ln in lines if "\twilson_v2\t" in ln)
+    assert "\tTrue\tTrue\t" in w          # checked + ok
+    assert "1152" in w                    # analytic bytes joined
+    g = next(ln for ln in lines if "\tgcr_mg\t" in ln)
+    assert g                              # unmodeled forms still listed
+
+
+def test_save_report_none_without_compiles(tmp_path):
+    assert ocost.save_report(path=str(tmp_path)) is None
