@@ -1236,10 +1236,16 @@ def main(argv):
                 "platform": platform, "lattice": [4] * 4},
                 banner_platform=banner)
 
+    # every exporter's output is indexed into artifacts_manifest.json
+    # below (the end_quda discipline): one file CI or an operator
+    # collects to find everything this run wrote
+    suite_artifacts = {}
     if do_trace:
         from quda_tpu.obs import trace as qtrace
         paths = qtrace.stop()
         if paths:
+            suite_artifacts["bench_trace.json"] = paths["chrome"]
+            suite_artifacts["bench_trace_events.jsonl"] = paths["jsonl"]
             print(json.dumps({"suite": "harness", "trace": paths}),
                   flush=True)
     # roofline rows accumulated during the run (API-style attribution +
@@ -1249,6 +1255,7 @@ def main(argv):
     if qorf.rows() or qcomms2.solve_rows():
         path = qorf.save(path=artifacts_dir)
         if path:
+            suite_artifacts["roofline.tsv"] = path
             print(json.dumps({"suite": "harness", "roofline": path}),
                   flush=True)
 
@@ -1256,8 +1263,12 @@ def main(argv):
     if qmet.enabled():
         paths = qmet.stop()
         if paths:
+            suite_artifacts["metrics.prom"] = paths["prom"]
+            suite_artifacts["metrics.tsv"] = paths["tsv"]
+            suite_artifacts["fleet_report.txt"] = paths["report"]
             print(json.dumps({"suite": "harness", "metrics": paths}),
                   flush=True)
+
 
     rc = 0
     if do_compare:
@@ -1271,6 +1282,20 @@ def main(argv):
             tol=float(tol) if tol is not None else None,
             iters_tol=float(iters_tol) if iters_tol is not None else None,
             trends_path=opts["--trends"])
+
+    # last: trends.tsv exists only after run_compare wrote it
+    if opts["--trends"] and os.path.exists(opts["--trends"]):
+        suite_artifacts["trends.tsv"] = opts["--trends"]
+    from quda_tpu.obs import postmortem as qpm
+    manifest_path = qpm.write_artifacts_manifest(
+        suite_artifacts,
+        path=artifacts_dir if (suite_artifacts
+                               or opts["--artifacts-dir"] is not None)
+        else None)
+    if manifest_path:
+        print(json.dumps({"suite": "harness",
+                          "artifacts_manifest": manifest_path}),
+              flush=True)
     return rc
 
 

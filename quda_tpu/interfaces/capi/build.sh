@@ -9,6 +9,6 @@ PYINC=$(python3-config --includes)
 # --embed gives -lpython3.x for standalone executables; the shared lib
 # also links it so C programs need only -lquda_tpu
 PYLIB=$(python3-config --ldflags --embed 2>/dev/null || python3-config --ldflags)
-$CXX -O2 -shared -fPIC quda_tpu_c.cpp quda_tpu_fortran.cpp $PYINC $PYLIB \
+$CXX -std=c++17 -O2 -shared -fPIC quda_tpu_c.cpp quda_tpu_fortran.cpp $PYINC $PYLIB \
     -o "$OUT/libquda_tpu.so"
 echo "built $OUT/libquda_tpu.so"

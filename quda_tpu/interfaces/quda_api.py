@@ -50,6 +50,10 @@ def init_quda(device: int = 0):
     omet.maybe_start()         # QUDA_TPU_METRICS counter/gauge registry
     from ..obs import comms as ocomms
     ocomms.maybe_start()       # ICI comms ledger (rides both knobs)
+    from ..obs import flight as ofl
+    from ..obs import postmortem as opm
+    ofl.maybe_start()          # QUDA_TPU_FLIGHT black-box ring buffer
+    opm.reset_session()        # fresh postmortem bundle index
     # warm-start the chip-keyed tuner cache (tune.cpp persistent-cache
     # behavior): a fresh worker with a shared QUDA_TPU_RESOURCE_PATH
     # serves its first solve from already-raced (platform, volume,
@@ -98,25 +102,36 @@ def end_quda():
     _ctx["mg_epoch"] = -1
     # shutdown telemetry flush (endQuda summary semantics): the timer
     # summary + profile.tsv, the tuner's profiler half (profile_0.tsv),
-    # the roofline rows, the metrics export + fleet report, and the
-    # trace session artifacts.  Every step runs even when an earlier
-    # one raises (a broken profile writer must not eat the trace of the
-    # crashed session it would explain) — the first error is re-raised
-    # AFTER the epilogue completes.
+    # the roofline rows, the metrics export + fleet report, the flight
+    # recorder's black-box tail, and the trace session artifacts.
+    # Every step runs even when an earlier one raises (a broken
+    # profile writer must not eat the trace of the crashed session it
+    # would explain) — the first error is re-raised AFTER the epilogue
+    # completes.  Everything flushed is indexed (name -> path + size +
+    # the session knob snapshot) into artifacts_manifest.json — the
+    # ONE file an operator or CI collects to find every artifact,
+    # postmortem bundles included.
     from ..obs import comms as ocomms
     from ..obs import costmodel as ocost
+    from ..obs import flight as ofl
     from ..obs import memory as omem
     from ..obs import metrics as omet
+    from ..obs import postmortem as opm
     from ..obs import roofline as orf
     from ..obs import trace as otr
     from ..utils import monitor as qmon
     from ..utils import tune as qtune
     from ..utils.timer import print_summary
 
+    artifacts: dict = {}
+
     def _flush_metrics():
         try:
             paths = omet.stop()
             if paths:
+                artifacts["metrics.prom"] = paths["prom"]
+                artifacts["metrics.tsv"] = paths["tsv"]
+                artifacts["fleet_report.txt"] = paths["report"]
                 qlog.printq(f"metrics artifacts: {paths['prom']} / "
                             f"{paths['report']}", qlog.SUMMARIZE)
         finally:
@@ -125,24 +140,54 @@ def end_quda():
             # session would report this one's fields as still resident
             omem.reset()
 
+    def _flush_flight():
+        # before the trace flush: a wrapped ring emits flight_dropped,
+        # which must land in the trace artifact it explains
+        paths = ofl.stop()
+        if paths:
+            artifacts["flight.jsonl"] = paths["flight"]
+            qlog.printq(f"flight recorder: {paths['flight']} "
+                        f"({paths['events']} events, "
+                        f"{paths['dropped']} dropped)", qlog.SUMMARIZE)
+
     def _flush_trace():
         paths = otr.stop()
         if paths:
+            artifacts["trace.json"] = paths["chrome"]
+            artifacts["trace_events.jsonl"] = paths["jsonl"]
             qlog.printq(f"trace artifacts: {paths['chrome']} / "
                         f"{paths['jsonl']}", qlog.SUMMARIZE)
 
+    def _save_tune_profile():
+        artifacts["profile_0.tsv"] = qtune.save_profile()
+
+    def _save_roofline():
+        # dumps the ICI ledger rows alongside
+        artifacts["roofline.tsv"] = orf.save()
+
+    def _save_cost_report():
+        # cost_drift.tsv for noted compiles
+        artifacts["cost_drift.tsv"] = ocost.save_report()
+
     errors = []
-    for step in (qmon.stop_default, print_summary, qtune.save_profile,
-                 orf.save,       # dumps the ICI ledger rows alongside
+    for step in (qmon.stop_default, print_summary, _save_tune_profile,
+                 _save_roofline,
                  orf.reset,  # a later init/end must not re-dump rows
-                 ocost.save_report,  # cost_drift.tsv for noted compiles
+                 _save_cost_report,
                  ocost.reset,
                  ocomms.stop,    # ledger follows the session it served
-                 _flush_metrics, _flush_trace):
+                 _flush_metrics, _flush_flight, _flush_trace):
         try:
             step()
         except Exception as e:   # noqa: BLE001 — epilogue must finish
             errors.append(e)
+    try:
+        mpath = opm.write_artifacts_manifest(artifacts)
+        if mpath:
+            qlog.printq(f"artifacts manifest: {mpath}", qlog.SUMMARIZE)
+    except Exception as e:       # noqa: BLE001 — epilogue must finish
+        errors.append(e)
+    opm.reset_session()
     if errors:
         raise errors[0]
 
@@ -150,6 +195,50 @@ def end_quda():
 def _require_init():
     if not _ctx["initialized"]:
         qlog.errorq("initQuda has not been called")
+
+
+def _pm_api(api: str, payload: Optional[str] = None):
+    """API-boundary postmortem guard (obs/postmortem.py).
+
+    When failure capture is enabled, enters a solve scope carrying the
+    caller's payload field (source/gauge), the param, and the knob
+    snapshot as of API entry, and captures any uncaught exception
+    crossing this boundary as an ``exception:<type>`` bundle before
+    re-raising — unless a more specific trigger (breakdown, verify
+    mismatch, gauge rejection, ladder exhaustion) already captured
+    inside the call: one failure, one bundle.  Capture disabled = one
+    knob read, then the undecorated call — no scope, no try frame
+    semantics change, no bundle I/O (the raising-stub pin in
+    tests/test_flight.py).  tests/test_flight_lint.py pins that every
+    inverting entry point carries this guard and that its except-to-
+    status site calls the capture hook."""
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            from ..obs import postmortem as opm
+            if not opm.enabled():
+                return fn(*args, **kwargs)
+            src = None
+            if payload is not None:
+                # positional or keyword spelling of the payload (the
+                # entry points name it source / sources / gauge) — a
+                # keyword-style call must still dump a replayable field
+                src = args[0] if args else next(
+                    (kwargs[k] for k in ("source", "sources", "gauge")
+                     if k in kwargs), None)
+            param = (args[1] if len(args) > 1 else
+                     kwargs.get("param", kwargs.get("invert_param")))
+            with opm.solve_scope(api, param=param, source=src,
+                                 source_name=payload or "source"):
+                try:
+                    return fn(*args, **kwargs)
+                except Exception as e:
+                    opm.capture_exception(api, e)
+                    raise
+        return wrapper
+    return deco
 
 
 def _set_resident_gauge(g):
@@ -163,6 +252,7 @@ def _set_resident_gauge(g):
     omem.track("gauge", "resident_gauge", g)
 
 
+@_pm_api("load_gauge_quda", payload="gauge")
 def load_gauge_quda(gauge, param: GaugeParam):
     """loadGaugeQuda: host layout (4,T,Z,Y,X,3,3) -> resident device gauge."""
     _require_init()
@@ -191,6 +281,14 @@ def load_gauge_quda(gauge, param: GaugeParam):
     if not bool(jnp.all(jnp.isfinite(g))):
         otr.event("gauge_rejected", cat="robust", reason="nonfinite",
                   X=list(param.X))
+        # failure capture BEFORE the raise: the bundle dumps the gauge
+        # AS REJECTED (fault-poisoned links included) so a replay of
+        # the bundle reproduces the rejection from the dump alone
+        from ..obs import postmortem as opm
+        opm.capture("gauge_rejected", api="load_gauge_quda",
+                    fields={"gauge": g},
+                    note=f"non-finite links rejected at load, "
+                         f"X={list(param.X)}")
         qlog.errorq(
             "load_gauge_quda: non-finite link values in the input "
             "gauge field — rejected (a NaN link silently poisons every "
@@ -650,6 +748,7 @@ def _solve_supervision(param, api: str, converged=None, breakdown=None,
     param.verified_res = vres
     margin = float(qconf.get("QUDA_TPU_ROBUST_VERIFY_MARGIN",
                              fresh=True))
+    from ..obs import postmortem as opm
     if bk:
         param.solve_status = f"breakdown:{rsent.reason(bk)}"
         param.converged = False
@@ -657,6 +756,11 @@ def _solve_supervision(param, api: str, converged=None, breakdown=None,
                   reason=rsent.reason(bk), solver=param.inv_type,
                   iters=param.iter_count)
         omet.inc("breakdowns_total", api=api, reason=rsent.reason(bk))
+        # failure capture AFTER classification: the bundle records the
+        # attempt param with its final solve_status, so a replay's
+        # status comparison is against the classified exit
+        opm.capture(f"breakdown:{rsent.reason(bk)}", api=api,
+                    param=param)
         qlog.warn_once(
             f"breakdown:{api}:{rsent.reason(bk)}",
             f"{api}: breakdown sentinel tripped "
@@ -670,6 +774,7 @@ def _solve_supervision(param, api: str, converged=None, breakdown=None,
         param.converged = False
         otr.event("verify_mismatch", cat="robust", api=api,
                   verified_res=vres, tol=param.tol, margin=margin)
+        opm.capture("verify_mismatch", api=api, param=param)
         qlog.warn_once(
             f"unverified:{api}",
             f"{api}: solver claimed convergence but the recomputed "
@@ -728,6 +833,7 @@ def _solve_form(d) -> str:
     return "generic"
 
 
+@_pm_api("invert_quda", payload="source")
 def invert_quda(source, param: InvertParam):
     """invertQuda: solve M x = b per param; returns x, mutates param
     result fields (true_res, iter_count, secs, gflops, converged; with
@@ -1224,6 +1330,7 @@ def _invert_dispatch(param, d, d_full, b, rhs, sys_rhs, mv, mv_applies,
     return res, (rhs if inv in ("cgne", "cgnr") else sys_rhs)
 
 
+@_pm_api("invert_multi_src_quda", payload="source")
 def invert_multi_src_quda(sources, param: InvertParam):
     """invertMultiSrcQuda analog: solve M x_i = b_i for a batch of
     sources (lib/interface_quda.cpp:3064 callMultiSrcQuda).
@@ -1708,6 +1815,7 @@ def destroy_multigrid_quda():
     omem.release("mg", "hierarchy")
 
 
+@_pm_api("invert_multishift_quda", payload="source")
 def invert_multishift_quda(source, param: InvertParam):
     """invertMultiShiftQuda: (A + offset_i) x_i = b on the PC normal op."""
     _require_init()
@@ -1917,6 +2025,7 @@ def mat_dag_mat_quda(psi, param: InvertParam):
     return d.MdagM(jnp.asarray(psi, complex_dtype(param.cuda_prec)))
 
 
+@_pm_api("eigensolve_quda")
 def eigensolve_quda(eig_param: EigParamAPI, invert_param: InvertParam):
     """eigensolveQuda: returns (evals, evecs)."""
     _require_init()

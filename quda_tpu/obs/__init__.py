@@ -55,8 +55,35 @@ home for that surface:
                         metric name (linted bidirectionally by
                         tests/test_obs_schema_lint.py; the metrics
                         registry also validates names at record time).
+* ``obs.flight``      — the black-box flight recorder
+                        (QUDA_TPU_FLIGHT; off = zero-overhead no-op):
+                        a bounded ring buffer of structured events —
+                        API entries/exits, tuner decisions, escalation
+                        rungs, sentinel codes, gauge rejections —
+                        tapped off the trace.event emission sites,
+                        flushed as flight.jsonl and into every
+                        postmortem bundle.
+* ``obs.postmortem``  — failure-capture bundles (QUDA_TPU_POSTMORTEM):
+                        on breakdown / verify mismatch / ladder
+                        exhaustion / gauge rejection / API-boundary
+                        exceptions, one self-contained directory —
+                        knob + topology snapshot, consulted tunecache,
+                        metrics + HBM snapshots, the flight tail, full
+                        param provenance, size-capped content-hashed
+                        field dumps, manifest.json — plus the
+                        session-wide artifacts_manifest.json index.
+* ``obs.replay``      — deterministic solve replay from a bundle
+                        (``python -m quda_tpu.obs.replay <dir>``):
+                        reconstructs fields/params, re-runs through
+                        the normal invert_quda path under the recorded
+                        knobs, reports reproduced / recovered /
+                        diverged and appends replay.json for the fleet
+                        report's replay-verified column.
 """
 
-from . import (comms, convergence, costmodel, history,  # noqa: F401
-               memory, metrics, regress, report, roofline, schema,
-               trace)
+# obs.replay is deliberately NOT imported eagerly: it is the
+# ``python -m quda_tpu.obs.replay`` entry point, and runpy warns when a
+# -m target is already resident from its package import
+from . import (comms, convergence, costmodel, flight,  # noqa: F401
+               history, memory, metrics, postmortem, regress,
+               report, roofline, schema, trace)

@@ -1,0 +1,191 @@
+"""Flight recorder: an always-cheap in-process ring buffer of events.
+
+The trace session (obs/trace.py) is the *profiling* surface: opt-in,
+unbounded-ish, flushed as chrome artifacts for humans studying a run
+they planned to study.  A serving fleet needs the *black-box* half: when
+a solve goes wrong on chip N hours into a run, the operator wants the
+last few thousand structured events — API entries/exits, tuner
+decisions, escalation rungs, sentinel codes, gauge loads/rejections,
+exchange-policy picks — attached to the failure, without having paid
+for full tracing all along.  That is this module: a bounded
+``collections.deque`` ring (``QUDA_TPU_FLIGHT_EVENTS_MAX``, oldest
+dropped and counted) fed by host-side appends only.
+
+Feeds:
+
+* every ``obs.trace.event(...)`` call site in the package taps into the
+  ring when the recorder is on (the tap lives in trace.event, so tuner/
+  robust/gauge/comms events arrive here with zero new call sites), even
+  when the trace session itself is off;
+* ``obs.trace.api_span`` records ``api_enter`` / ``api_exit`` markers;
+* subsystems may call :func:`record` directly for ring-only events
+  (names here are NOT part of the obs schema — the ring mirrors
+  schema'd events, it does not mint dashboard names).
+
+Activation: ``QUDA_TPU_FLIGHT=1`` (read by init_quda via
+:func:`maybe_start`) or an explicit :func:`start`.  **Off means off**
+(the obs no-op discipline): :func:`record` returns after one global
+load, no ring exists, no clock is read, and no op is ever added to a
+compiled solve either way — pinned by a raising-stub test
+(tests/test_flight.py).
+
+``end_quda`` flushes the ring tail to ``flight.jsonl`` under the
+resource path (and the postmortem writer snapshots it into every
+bundle); drops are surfaced as a ``flight_dropped`` trace event and on
+the flush return so a truncated black box is never mistaken for a
+complete one.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+
+class _Ring:
+    """The live recorder: a maxlen deque + drop accounting.  Appends
+    are host-side only (dict build + deque append under a lock) — the
+    recorder never touches device values, so instrumented sites are
+    safe around jit boundaries."""
+
+    __slots__ = ("events", "maxlen", "dropped", "seq", "t0", "wall0",
+                 "lock")
+
+    def __init__(self, maxlen: int):
+        self.maxlen = int(maxlen)
+        self.events: collections.deque = collections.deque(
+            maxlen=self.maxlen)
+        self.dropped = 0
+        self.seq = 0
+        self.t0 = time.perf_counter()
+        self.wall0 = time.time()
+        self.lock = threading.Lock()
+
+    def append(self, name: str, cat: str, fields: dict):
+        with self.lock:
+            if len(self.events) >= self.maxlen:
+                self.dropped += 1
+            self.seq += 1
+            self.events.append({
+                "seq": self.seq,
+                "t_us": round((time.perf_counter() - self.t0) * 1e6, 3),
+                "name": name, "cat": cat, **fields})
+
+
+_session: Optional[_Ring] = None
+
+
+def enabled() -> bool:
+    return _session is not None
+
+
+def start(maxlen: Optional[int] = None) -> _Ring:
+    """Open a recorder session (idempotent: an active ring is kept —
+    trace.start semantics; an explicit maxlen that conflicts with the
+    live ring is discarded, the black box must not lose its tail
+    mid-session)."""
+    global _session
+    if _session is None:
+        if maxlen is None:
+            from ..utils import config as qconf
+            maxlen = int(qconf.get("QUDA_TPU_FLIGHT_EVENTS_MAX",
+                                   fresh=True))
+        _session = _Ring(max(1, int(maxlen)))
+    return _session
+
+
+def maybe_start() -> Optional[_Ring]:
+    """Start a session iff QUDA_TPU_FLIGHT is set (init_quda hook)."""
+    from ..utils import config as qconf
+    if qconf.get("QUDA_TPU_FLIGHT", fresh=True):
+        return start()
+    return None
+
+
+def record(name: str, cat: str = "event", **fields):
+    """Append one event to the ring — the module no-op when the
+    recorder is off (one global load, nothing else; the zero-overhead
+    contract shared with obs.trace.event)."""
+    r = _session
+    if r is None:
+        return
+    r.append(name, cat, fields)
+
+
+def dropped() -> int:
+    r = _session
+    return r.dropped if r is not None else 0
+
+
+def tail(n: Optional[int] = None) -> List[dict]:
+    """The newest ``n`` ring events (all when n is None), oldest first
+    — the postmortem writer's snapshot hook.  Host-side copies; the
+    ring keeps running."""
+    r = _session
+    if r is None:
+        return []
+    with r.lock:
+        evs = list(r.events)
+    return evs if n is None else evs[-int(n):]
+
+
+def _json_safe(obj):
+    """Ring fields arrive as whatever the call site passed (ints,
+    floats, lists, the odd numpy scalar); render everything else via
+    str so one exotic field can never eat the flush."""
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        return str(obj)
+
+
+def flush(path: Optional[str] = None,
+          fname: str = "flight.jsonl") -> Optional[dict]:
+    """Write the ring tail as JSONL under ``path`` (default: the
+    resource path, else cwd); returns {'flight': file, 'events': n,
+    'dropped': d} or None when the recorder is off.  The session stays
+    active (incremental flushes overwrite)."""
+    r = _session
+    if r is None:
+        return None
+    if path is None:
+        from ..utils import config as qconf
+        path = qconf.get("QUDA_TPU_RESOURCE_PATH", fresh=True) or "."
+    os.makedirs(path, exist_ok=True)
+    fpath = os.path.join(path, fname)
+    evs = tail()
+    with open(fpath, "w") as fh:
+        for e in evs:
+            fh.write(json.dumps({k: _json_safe(v) for k, v in e.items()})
+                     + "\n")
+    return {"flight": fpath, "events": len(evs), "dropped": r.dropped}
+
+
+def stop(flush_files: bool = True) -> Optional[dict]:
+    """Close the recorder (end_quda hook); flushes flight.jsonl and —
+    when the ring wrapped — emits the ``flight_dropped`` trace event so
+    a truncated black box is auditable next to the artifacts it
+    truncated."""
+    global _session
+    r = _session
+    if r is None:
+        return None
+    # snapshot BEFORE the event: the trace tap appends the event to
+    # this very ring, which on a full ring would inflate its own count
+    n_dropped, n_kept = r.dropped, len(r.events)
+    try:
+        if n_dropped:
+            from . import trace as otr
+            otr.event("flight_dropped", cat="flight",
+                      dropped=n_dropped, kept=n_kept)
+        out = flush() if flush_files else None
+        if out is not None:
+            out["dropped"] = n_dropped
+        return out
+    finally:
+        _session = None

@@ -1,0 +1,499 @@
+"""Postmortem bundles: one self-contained directory per solve failure.
+
+Reference behavior: the reference's production posture stores
+self-describing artifacts under $QUDA_RESOURCE_PATH (tunecache.tsv,
+profile_N.tsv) so a run can be understood after the fact
+(lib/tune.cpp:450-610); arXiv:1408.5925's framework keeps every solver
+decision resident and inspectable.  A serving fleet needs the black-box
+half of that discipline: when a solve goes wrong on chip N hours into a
+run, an operator pulls ONE bundle and re-runs that exact solve on a
+workstation (obs/replay.py).  This module writes the bundle.
+
+Capture triggers (the ISSUE-11 failure-path inventory):
+
+* sentinel breakdown / verification mismatch — the classification
+  branches of ``interfaces/quda_api._solve_supervision``;
+* construction failure and ladder exhaustion — every failure path of
+  ``robust/escalate.run_ladder`` (``_pm_capture`` sites, linted by
+  tests/test_flight_lint.py);
+* gauge rejection — ``load_gauge_quda``'s non-finite screen;
+* any uncaught exception crossing an ``interfaces/quda_api.py`` API
+  boundary (the ``_pm_api`` guard's except-to-status site).
+
+Bundle layout (``<postmortem dir>/pm_<stamp>_p<pid>_<seq>_<trigger>/``)::
+
+    manifest.json     trigger, api, platform/topology, knob snapshot
+                      (raw strings — the replay input), param
+                      provenance incl. solve_attempts, field index
+    flight.jsonl      the flight-recorder ring tail (obs/flight.py)
+    metrics.json      metrics-registry snapshot (obs/metrics.py)
+    hbm.json          HBM field ledger + device high-water (obs/memory)
+    tunecache.json    the tunecache entries consulted on this platform
+    fields/*.npy      content-hashed gauge/fat/long/source dumps,
+                      size-capped by QUDA_TPU_POSTMORTEM_MAX_MB
+                      (fields past the cap stay in the manifest as
+                      omitted entries with shape/dtype/sha256)
+
+Activation: ``QUDA_TPU_POSTMORTEM`` ('1' always / '0' never / empty =
+follow the flight recorder).  **Off means off**: :func:`capture`
+returns after one knob read, no directory is ever created, and no op
+is added to a compiled solve either way — pinned by the raising-stub
+test next to the flight recorder's.  Bundle writes are bounded per
+session (``QUDA_TPU_POSTMORTEM_MAX_BUNDLES``); a capture that fails
+internally warns and returns None — the postmortem writer must never
+turn a recoverable failure into a crash (AssertionError propagates so
+the raising-stub pins stay effective).
+
+``end_quda`` indexes every bundle (with everything else it flushed)
+into ``artifacts_manifest.json`` via :func:`write_artifacts_manifest`;
+the fleet report renders the session's bundles in its "Postmortems"
+section with their replay-verified status (obs/replay.py writes
+``replay.json`` into a bundle it has re-run).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import re
+import sys
+import time
+from typing import List, Optional
+
+# field-dump priority under the size cap: replay needs gauge + source;
+# links before derived/auxiliary fields
+_PRIORITY = {"gauge": 0, "source": 1, "fat": 2, "long": 3, "clover": 4}
+
+_bundles: List[dict] = []
+_suppressed = 0
+_seq = 0
+
+# Per-API-call scope stack (pushed by quda_api's _pm_api guard): gives
+# capture sites deep in the call tree the API name, the caller's
+# source/param, and the knob snapshot AS OF API ENTRY (an escalation
+# rung's scoped overrides must not leak into the replay input — the
+# replay re-runs the WHOLE solve, ladder included).  The ``captured``
+# flag lets the boundary exception guard skip a failure that already
+# captured a more specific trigger.
+_scopes: List[dict] = []
+
+
+def enabled() -> bool:
+    """'1' = always, '0' = never, empty = ride the flight recorder (a
+    bundle without the ring tail is half blind, so capture defaults to
+    following QUDA_TPU_FLIGHT's live session)."""
+    from ..utils import config as qconf
+    v = str(qconf.get("QUDA_TPU_POSTMORTEM", fresh=True))
+    if v == "1":
+        return True
+    if v == "0":
+        return False
+    from . import flight as ofl
+    return ofl.enabled()
+
+
+def bundle_root() -> str:
+    """The directory receiving bundle dirs: QUDA_TPU_POSTMORTEM_PATH,
+    else <resource path>/postmortems (cwd-relative when no resource
+    path is configured)."""
+    from ..utils import config as qconf
+    path = qconf.get("QUDA_TPU_POSTMORTEM_PATH", fresh=True)
+    if path:
+        return path
+    rp = qconf.get("QUDA_TPU_RESOURCE_PATH", fresh=True)
+    return os.path.join(rp or ".", "postmortems")
+
+
+def bundles() -> List[dict]:
+    """Bundles written this session: [{'path', 'trigger', 'api',
+    'wall'}] (fleet report + artifacts manifest consumers)."""
+    return list(_bundles)
+
+
+def suppressed() -> int:
+    return _suppressed
+
+
+def reset_session():
+    """Forget this session's bundle list (init/end_quda hook; the
+    bundle DIRECTORIES persist on disk — only the in-process index
+    resets)."""
+    global _suppressed
+    _bundles.clear()
+    _scopes.clear()
+    _suppressed = 0
+
+
+def current_scope() -> Optional[dict]:
+    return _scopes[-1] if _scopes else None
+
+
+@contextlib.contextmanager
+def solve_scope(api: str, param=None, source=None,
+                source_name: str = "source"):
+    """Per-API-call capture context (see the stack comment above).
+    Entered by the ``_pm_api`` guard only when capture is enabled —
+    the disabled path never builds the knob snapshot."""
+    from ..utils import config as qconf
+    _scopes.append({"api": api, "param": param, "source": source,
+                    "source_name": source_name, "captured": False,
+                    "knobs_raw": qconf.snapshot_raw()})
+    try:
+        yield _scopes[-1]
+    finally:
+        popped = _scopes.pop()
+        # one failure, one bundle — across NESTED boundaries too: an
+        # exception captured inside (e.g. invert_quda called from the
+        # invert_multi_src_quda fallback loop) must not re-capture at
+        # the outer boundary, so the flag propagates outward on exit
+        if popped.get("captured") and _scopes:
+            _scopes[-1]["captured"] = True
+
+
+def capture(trigger: str, api: Optional[str] = None, param=None,
+            fields: Optional[dict] = None, exc: Optional[BaseException]
+            = None, note: Optional[str] = None) -> Optional[str]:
+    """Write one postmortem bundle for a failure; returns its directory
+    (None when capture is off, suppressed past the session cap, or the
+    writer itself failed).  ``fields`` overrides the default dump set
+    (resident gauge/fat/long from the API context + the scope's
+    source); ``param`` defaults to the scope's InvertParam — pass the
+    attempt copy at attempt-level sites so the bundle records the
+    provenance of the failing attempt, not the caller's final view.
+
+    One bundle per API call: the FIRST capture inside a solve scope
+    wins; later triggers of the same call (every subsequent rung of an
+    exhausting ladder re-classifying the same failure) are skipped —
+    without this, one persistently-failing solve under 'escalate'
+    would burn MAX_RETRIES near-identical bundles off the session cap
+    and starve the next, distinct failure of its bundle."""
+    if not enabled():
+        return None
+    global _suppressed
+    from ..utils import config as qconf
+    from ..utils import logging as qlog
+    from . import metrics as omet
+    from . import trace as otr
+    scope = current_scope()
+    if scope is not None and scope.get("captured"):
+        return None
+    if api is None:
+        api = scope["api"] if scope else "unknown"
+    if param is None and scope is not None:
+        param = scope["param"]
+    cap = int(qconf.get("QUDA_TPU_POSTMORTEM_MAX_BUNDLES", fresh=True))
+    if len(_bundles) >= max(1, cap):
+        _suppressed += 1
+        if scope is not None:
+            scope["captured"] = True
+        omet.inc("postmortems_total", trigger="suppressed")
+        qlog.warn_once(
+            "postmortem_suppressed",
+            f"postmortem: session bundle cap "
+            f"(QUDA_TPU_POSTMORTEM_MAX_BUNDLES={cap}) reached; further "
+            "captures are counted but not written")
+        return None
+    try:
+        path = _write_bundle(trigger, api, param, fields, exc, note,
+                             scope)
+    except AssertionError:
+        raise                  # raising-stub pins must stay effective
+    except Exception as e:     # noqa: BLE001 — never worsen a failure
+        qlog.warningq(
+            f"postmortem capture failed ({type(e).__name__}: "
+            f"{str(e)[:120]}); the original failure is unaffected")
+        return None
+    if scope is not None:
+        scope["captured"] = True
+    _bundles.append({"path": path, "trigger": trigger, "api": api,
+                     "wall": time.time()})
+    omet.inc("postmortems_total", trigger=trigger)
+    otr.event("postmortem_written", cat="postmortem", trigger=trigger,
+              api=api, path=path)
+    qlog.warningq(f"postmortem bundle written: {path} "
+                  f"(trigger {trigger}; replay with `python -m "
+                  "quda_tpu.obs.replay <bundle>`)")
+    return path
+
+
+def capture_exception(api: str, exc: BaseException) -> Optional[str]:
+    """The API-boundary guard's except-to-status hook: capture an
+    uncaught exception UNLESS a more specific trigger already captured
+    during this API call (scope ``captured`` flag) — one failure, one
+    bundle."""
+    s = current_scope()
+    if s is not None and s.get("captured"):
+        return None
+    return capture(f"exception:{type(exc).__name__}", api=api, exc=exc)
+
+
+# -- bundle writing ----------------------------------------------------------
+
+def _json_default(obj):
+    return str(obj)
+
+
+def _write_json(bdir: str, rel: str, doc, files: dict):
+    fpath = os.path.join(bdir, rel)
+    with open(fpath, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True,
+                  default=_json_default)
+    files[rel] = {"bytes": os.path.getsize(fpath)}
+
+
+def _param_dict(param) -> Optional[dict]:
+    """Every dataclass field of an InvertParam/GaugeParam as plain
+    data (sequences listed, exotic values stringified at dump time)."""
+    import dataclasses
+    if param is None:
+        return None
+    if not dataclasses.is_dataclass(param):
+        return {"repr": repr(param)}
+    out = {}
+    for f in dataclasses.fields(param):
+        v = getattr(param, f.name)
+        out[f.name] = list(v) if isinstance(v, tuple) else v
+    return out
+
+
+def _platform_info() -> dict:
+    info = {"python": sys.version.split()[0]}
+    try:
+        import jax
+        info["jax"] = jax.__version__
+        info["backend"] = jax.default_backend()
+        devs = jax.devices()
+        info["n_devices"] = len(devs)
+        info["device_kind"] = str(getattr(devs[0], "device_kind", "")
+                                  or devs[0].platform)
+        info["process_index"] = jax.process_index()
+    except Exception as e:     # noqa: BLE001 — capture must not crash
+        info["error"] = f"{type(e).__name__}: {e}"
+    return info
+
+
+def _metrics_snapshot() -> dict:
+    """The registry snapshot with its tuple keys flattened to rows."""
+    from . import metrics as omet
+    snap = omet.snapshot()
+    return {kind: [{"name": name, "labels": dict(labels), "value": v}
+                   for (name, labels), v in sorted(snap[kind].items())]
+            for kind in snap}
+
+
+def _default_fields(scope: Optional[dict]) -> dict:
+    """The dump set when the capture site passed none: the resident
+    device fields of the API context + the scope's source."""
+    out = {}
+    try:
+        from ..interfaces import quda_api as qapi
+        for k in ("gauge", "fat", "long"):
+            if qapi._ctx.get(k) is not None:
+                out[k] = qapi._ctx[k]
+    except Exception:          # noqa: BLE001 — partial dump beats none
+        pass
+    if scope is not None and scope.get("source") is not None:
+        out[scope.get("source_name") or "source"] = scope["source"]
+    return out
+
+
+def _dump_fields(bdir: str, fields: dict, cap_mb: float,
+                 files: dict) -> dict:
+    """Content-hashed .npy dumps in priority order until the size cap
+    is spent; capped-out fields keep manifest entries (shape/dtype/
+    sha256, omitted='size_cap') so replay can say what is missing."""
+    import hashlib
+
+    import numpy as np
+    budget = int(cap_mb * 2 ** 20)
+    index = {}
+    os.makedirs(os.path.join(bdir, "fields"), exist_ok=True)
+    for name in sorted(fields, key=lambda n: (_PRIORITY.get(n, 99), n)):
+        arr = np.ascontiguousarray(np.asarray(fields[name]))
+        entry = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                 "nbytes": int(arr.nbytes),
+                 "sha256": hashlib.sha256(arr.tobytes()).hexdigest()}
+        if arr.nbytes <= budget:
+            rel = f"fields/{name}.npy"
+            np.save(os.path.join(bdir, rel), arr)
+            budget -= arr.nbytes
+            entry["file"] = rel
+            files[rel] = {"bytes": os.path.getsize(
+                os.path.join(bdir, rel))}
+        else:
+            entry["omitted"] = "size_cap"
+        index[name] = entry
+    return index
+
+
+def _write_bundle(trigger: str, api: str, param, fields, exc, note,
+                  scope) -> str:
+    global _seq
+    from ..utils import config as qconf
+    from ..utils import tune as qtune
+    from . import flight as ofl
+    from . import memory as omem
+    slug = re.sub(r"[^A-Za-z0-9_.-]+", "-", trigger)[:48]
+    root = bundle_root()
+    os.makedirs(root, exist_ok=True)
+    # pid in the name + exist_ok=False retry: workers sharing one
+    # resource path (the supported fleet setup) capturing in the same
+    # wall-clock second must never merge two failures into one
+    # corrupted bundle dir
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    while True:
+        _seq += 1
+        bdir = os.path.join(
+            root, f"pm_{stamp}_p{os.getpid()}_{_seq:03d}_{slug}")
+        try:
+            os.makedirs(bdir, exist_ok=False)
+            break
+        except FileExistsError:
+            continue
+    files: dict = {}
+
+    flight_tail = ofl.tail()
+    if flight_tail or ofl.enabled():
+        fpath = os.path.join(bdir, "flight.jsonl")
+        with open(fpath, "w") as fh:
+            for e in flight_tail:
+                fh.write(json.dumps(e, default=_json_default) + "\n")
+        files["flight.jsonl"] = {"bytes": os.path.getsize(fpath),
+                                 "events": len(flight_tail),
+                                 "dropped": ofl.dropped()}
+    _write_json(bdir, "metrics.json", _metrics_snapshot(), files)
+    _write_json(bdir, "hbm.json", {
+        "ledger": omem.ledger(),
+        "family_bytes": omem.family_bytes(),
+        "high_water": omem.high_water(),
+        "device_high_water": omem.device_high_water()}, files)
+    _write_json(bdir, "tunecache.json",
+                qtune.cache_snapshot(platform_only=True), files)
+
+    if fields is None:
+        fields = _default_fields(scope)
+    cap_mb = float(qconf.get("QUDA_TPU_POSTMORTEM_MAX_MB", fresh=True))
+    field_index = _dump_fields(bdir, fields, cap_mb, files) \
+        if fields else {}
+
+    # a load_gauge_quda capture's scope param IS the (rejected) load's
+    # GaugeParam — record it as such; solve captures record the
+    # RESIDENT gauge's param from the API context
+    is_gauge_param = type(param).__name__ == "GaugeParam"
+    gauge_param = _param_dict(param) if is_gauge_param else None
+    if gauge_param is None:
+        try:
+            from ..interfaces import quda_api as qapi
+            gauge_param = _param_dict(qapi._ctx.get("gauge_param"))
+        except Exception:      # noqa: BLE001
+            pass
+
+    # manifest LAST: its presence marks the bundle complete
+    manifest = {
+        "schema": 1,
+        "trigger": trigger,
+        "api": api,
+        "wall_time": time.time(),
+        "written": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "note": note,
+        "exception": (None if exc is None else
+                      {"type": type(exc).__name__,
+                       "message": str(exc)[:500]}),
+        "platform": _platform_info(),
+        # raw-string knob snapshot AS OF API ENTRY (scope) — the
+        # replay input; resolved values ride along for humans
+        "knobs": ((scope or {}).get("knobs_raw")
+                  or qconf.snapshot_raw()),
+        "knobs_resolved": qconf.snapshot_values(),
+        "invert_param": None if is_gauge_param else _param_dict(param),
+        "gauge_param": gauge_param,
+        "fields": field_index,
+        "files": files,
+        "flight": {"events": len(flight_tail),
+                   "dropped": ofl.dropped(),
+                   "enabled": ofl.enabled()},
+    }
+    with open(os.path.join(bdir, "manifest.json"), "w") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True,
+                  default=_json_default)
+    return bdir
+
+
+# -- session artifact indexing (end_quda / bench_suite) ----------------------
+
+def _tree_bytes(path: str) -> int:
+    total = 0
+    for dirpath, _dirnames, filenames in os.walk(path):
+        for f in filenames:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, f))
+            except OSError:
+                pass
+    return total
+
+
+def write_artifacts_manifest(artifacts: dict,
+                             path: Optional[str] = None) -> \
+        Optional[str]:
+    """Index every artifact a session flushed — trace, metrics.prom/
+    tsv, fleet_report.txt, roofline.tsv, cost_drift.tsv, tune
+    profiles, flight.jsonl, postmortem bundles — into ONE
+    ``artifacts_manifest.json`` (name -> path + size, plus the knob
+    snapshot), so operators and CI collect one file to find
+    everything.  ``artifacts`` maps artifact name -> written path.
+
+    Directory: explicit ``path`` (bench_suite --artifacts-dir) >
+    resource path > the first artifact's directory.  Nothing to index
+    and no explicit path -> None (a bare test session must not drop
+    manifests into the cwd)."""
+    from ..utils import config as qconf
+    arts = {k: v for k, v in (artifacts or {}).items() if v}
+    explicit = path is not None
+    if path is None:
+        path = qconf.get("QUDA_TPU_RESOURCE_PATH", fresh=True) or ""
+    if not path and arts:
+        path = os.path.dirname(next(iter(arts.values()))) or "."
+    if not path or (not arts and not _bundles and not explicit):
+        return None
+    os.makedirs(path, exist_ok=True)
+
+    def _size(p):
+        try:
+            return os.path.getsize(p)
+        except OSError:
+            return None
+
+    doc = {
+        "schema": 1,
+        "generated": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "knobs": qconf.snapshot_raw(),
+        "artifacts": {name: {"path": p, "bytes": _size(p)}
+                      for name, p in sorted(arts.items())},
+        "postmortems": [
+            {"path": b["path"], "trigger": b["trigger"],
+             "api": b["api"],
+             "manifest": os.path.join(b["path"], "manifest.json"),
+             "bytes": _tree_bytes(b["path"])}
+            for b in _bundles],
+        "postmortems_suppressed": _suppressed,
+    }
+    fpath = os.path.join(path, "artifacts_manifest.json")
+    with open(fpath, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True,
+                  default=_json_default)
+    return fpath
+
+
+def replay_status(bundle_path: str) -> str:
+    """Fleet-report cell: has this bundle been replay-verified?
+    Reads the ``replay.json`` obs/replay.py writes into a bundle it
+    re-ran; 'no' when no replay has run."""
+    try:
+        with open(os.path.join(bundle_path, "replay.json")) as fh:
+            verdict = json.load(fh).get("verdict", "")
+    except (OSError, json.JSONDecodeError):
+        return "no"
+    if verdict in ("reproduced", "recovered"):
+        return f"yes ({verdict})"
+    return verdict or "no"

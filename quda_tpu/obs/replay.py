@@ -1,0 +1,298 @@
+"""Deterministic solve replay from a postmortem bundle.
+
+``python -m quda_tpu.obs.replay <bundle-dir>`` reconstructs the fields
+and params a postmortem bundle (obs/postmortem.py) recorded, re-runs
+the solve through the NORMAL ``invert_quda`` path under the recorded
+knob snapshot, and reports whether the replay agrees with the original:
+
+* **reproduced** — the replay exits with the recorded ``solve_status``
+  and a bit-for-bit identical verified residual (XLA reductions are
+  deterministic per executable, so same fields + same knobs + same
+  code revision reproduce the failure exactly — QUDA_TPU_FAULT drills
+  included, because the fault spec is part of the knob snapshot and
+  re-arms under the replay overrides);
+* **recovered** — the bundle recorded a failing attempt (breakdown,
+  construct error, verification mismatch) and the replay, running the
+  FULL solve under the recorded knobs (escalation ladder included),
+  exits verified-converged: the failure was transient or the ladder
+  absorbed it;
+* **diverged** — anything else: the bundle no longer reproduces on
+  this build/host, which is itself the finding (environment drift,
+  nondeterminism, or a fix).
+
+The replay never writes new telemetry: QUDA_TPU_POSTMORTEM /
+QUDA_TPU_FLIGHT / QUDA_TPU_TRACE / QUDA_TPU_METRICS are forced off on
+top of the recorded knobs (none of the four adds device ops, so the
+solve itself is unchanged — pinned by the obs raising-stub tests), so
+re-running a bundle cannot clobber the artifacts of the session that
+wrote it.  The verdict is appended to the bundle as ``replay.json``,
+which the fleet report's "Postmortems" section quotes as
+replay-verified yes/no.
+
+In-process use (:func:`replay_bundle`) re-initialises the API context
+(init_quda / load_gauge_quda): run it after ``end_quda``, or from a
+fresh process (the CLI).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import struct
+import sys
+from typing import Optional
+
+# InvertParam result fields must NOT be seeded from the recorded
+# (post-failure) param — the replay recomputes them; the recorded
+# values are the comparison baseline
+_RESULT_FIELDS = frozenset({
+    "true_res", "iter_count", "secs", "gflops", "true_res_multi",
+    "iter_count_multi", "res_history", "events", "converged",
+    "converged_multi", "verified_res", "solve_status",
+    "solve_attempts"})
+
+# telemetry knobs forced off during replay (see module docstring)
+_QUIET = {"QUDA_TPU_POSTMORTEM": "0", "QUDA_TPU_FLIGHT": "0",
+          "QUDA_TPU_TRACE": "0", "QUDA_TPU_METRICS": "0"}
+
+_REPLAYABLE = ("invert_quda", "invert_multishift_quda",
+               "invert_multi_src_quda", "load_gauge_quda")
+
+
+def load_manifest(bundle: str) -> dict:
+    with open(os.path.join(bundle, "manifest.json")) as fh:
+        return json.load(fh)
+
+
+def _load_field(bundle: str, manifest: dict, name: str):
+    import numpy as np
+    entry = (manifest.get("fields") or {}).get(name)
+    if entry is None:
+        raise ValueError(f"bundle has no recorded {name!r} field")
+    if "file" not in entry:
+        raise ValueError(
+            f"bundle field {name!r} was omitted at capture "
+            f"({entry.get('omitted')}; {entry.get('nbytes')} bytes over "
+            "QUDA_TPU_POSTMORTEM_MAX_MB) — cannot replay without it")
+    return np.load(os.path.join(bundle, entry["file"]))
+
+
+def _bits(x: float) -> bytes:
+    return struct.pack("<d", float(x))
+
+
+def bits_equal(a, b) -> bool:
+    """Bit-for-bit float64 agreement; both-NaN counts as agreement
+    regardless of payload (a NaN residual round-trips through the
+    manifest JSON as the canonical quiet NaN)."""
+    fa, fb = float(a), float(b)
+    if math.isnan(fa) and math.isnan(fb):
+        return True
+    return _bits(fa) == _bits(fb)
+
+
+def _rebuild_invert_param(recorded: dict):
+    import dataclasses
+
+    from ..interfaces.params import InvertParam
+    p = InvertParam()
+    names = {f.name for f in dataclasses.fields(InvertParam)}
+    for k, v in (recorded or {}).items():
+        if k in names and k not in _RESULT_FIELDS:
+            setattr(p, k, tuple(v) if isinstance(v, list) else v)
+    return p
+
+
+def _rebuild_gauge_param(recorded: dict):
+    import dataclasses
+
+    from ..interfaces.params import GaugeParam
+    gp = GaugeParam()
+    names = {f.name for f in dataclasses.fields(GaugeParam)}
+    for k, v in (recorded or {}).items():
+        if k in names:
+            setattr(gp, k, tuple(v) if isinstance(v, list) else v)
+    # the dumped gauge is the RESIDENT field: already order-converted
+    # and anisotropy-folded at the original load — never fold twice
+    gp.gauge_order = "canonical"
+    gp.anisotropy = 1.0
+    return gp
+
+
+def _verdict(rec_status: str, rec_vres, rep_status: str,
+             rep_vres, rep_converged: bool,
+             rec_exc_type: Optional[str] = None) -> str:
+    # an exception-trigger bundle reproduces when the replay raises
+    # the SAME exception type (its recorded solve_status/verified_res
+    # are just the pre-failure param defaults — not the failure)
+    if rec_exc_type and rep_status == f"raised:{rec_exc_type}":
+        return "reproduced"
+    status_ok = (rep_status == rec_status)
+    vres_ok = (rec_vres is None
+               or (rep_vres is not None
+                   and bits_equal(rec_vres, rep_vres)))
+    if status_ok and vres_ok:
+        return "reproduced"
+    if rec_status != "converged" and rep_status == "converged" \
+            and rep_converged:
+        return "recovered"
+    return "diverged"
+
+
+def replay_bundle(bundle: str, save: bool = True) -> dict:
+    """Re-run the solve a bundle recorded; returns the replay report
+    (and appends it to the bundle as replay.json when ``save``)."""
+    from ..utils import config as qconf
+    manifest = load_manifest(bundle)
+    api = manifest.get("api")
+    if api not in _REPLAYABLE:
+        raise ValueError(f"bundle api {api!r} is not replayable "
+                         f"(supported: {_REPLAYABLE})")
+    # a bundle from a build with knobs this checkout has never heard
+    # of must still replay (environment drift is a finding, not a
+    # crash): unknown names are dropped from the overrides and
+    # reported, not fed to qconf.overrides' unregistered-knob raise
+    known = set(qconf.knobs())
+    recorded_knobs = dict(manifest.get("knobs") or {})
+    skipped_knobs = sorted(k for k in recorded_knobs if k not in known)
+    overrides = {k: v for k, v in recorded_knobs.items() if k in known}
+    overrides.update(_QUIET)
+
+    from ..interfaces import quda_api as qapi
+    from ..robust import faultinject as finj
+
+    rec_param = manifest.get("invert_param") or {}
+    rec_exc_type = (manifest.get("exception") or {}).get("type")
+    report = {
+        "bundle": os.path.abspath(bundle),
+        "api": api,
+        "trigger": manifest.get("trigger"),
+        "recorded": {"solve_status": rec_param.get("solve_status"),
+                     "verified_res": rec_param.get("verified_res"),
+                     "converged": rec_param.get("converged"),
+                     "iter_count": rec_param.get("iter_count"),
+                     "exception_type": rec_exc_type},
+    }
+    if skipped_knobs:
+        report["skipped_knobs"] = skipped_knobs
+    with qconf.overrides(**overrides):
+        # the recorded QUDA_TPU_FAULT spec re-arms under the override
+        # stack — the drill that captured this bundle replays too
+        finj.reset()
+        try:
+            qapi.init_quda()
+            if api == "load_gauge_quda":
+                return _replay_gauge_load(bundle, manifest, report,
+                                          save, qapi, finj)
+            gp = _rebuild_gauge_param(manifest.get("gauge_param"))
+            qapi.load_gauge_quda(_load_field(bundle, manifest, "gauge"),
+                                 gp)
+            if (manifest.get("fields") or {}).get("fat"):
+                try:
+                    qapi.load_fat_long_quda(
+                        _load_field(bundle, manifest, "fat"),
+                        _load_field(bundle, manifest, "long"))
+                except ValueError:
+                    pass       # fat recorded, long capped out
+            p = _rebuild_invert_param(rec_param)
+            src = _load_field(bundle, manifest, "source")
+            fn = getattr(qapi, api)
+            try:
+                fn(src, p)
+                replayed = {
+                    "solve_status": p.solve_status,
+                    "verified_res": p.verified_res,
+                    "converged": bool(p.converged),
+                    "iter_count": int(p.iter_count),
+                    "solve_attempts": list(p.solve_attempts)}
+            except Exception as e:  # noqa: BLE001 — exception IS data
+                replayed = {
+                    "solve_status": f"raised:{type(e).__name__}",
+                    "verified_res": None, "converged": False,
+                    "error": str(e)[:300]}
+        finally:
+            finj.reset()       # never leak replay arms to the caller
+    report["replayed"] = replayed
+    rec = report["recorded"]
+    report["verdict"] = _verdict(
+        rec.get("solve_status"), rec.get("verified_res"),
+        replayed.get("solve_status"), replayed.get("verified_res"),
+        bool(replayed.get("converged")), rec_exc_type=rec_exc_type)
+    report["status_match"] = (replayed.get("solve_status")
+                              == rec.get("solve_status"))
+    report["verified_res_bits_match"] = (
+        rec.get("verified_res") is not None
+        and replayed.get("verified_res") is not None
+        and bits_equal(rec["verified_res"], replayed["verified_res"]))
+    if save:
+        _save_report(bundle, report)
+    return report
+
+
+def _replay_gauge_load(bundle, manifest, report, save, qapi, finj):
+    """Gauge-rejection bundles replay the load itself: the dumped
+    gauge (poisoned as captured) must be rejected again."""
+    from ..utils.logging import QudaError
+    gp = _rebuild_gauge_param(manifest.get("gauge_param"))
+    try:
+        qapi.load_gauge_quda(_load_field(bundle, manifest, "gauge"), gp)
+        replayed = {"solve_status": "accepted"}
+    except QudaError as e:
+        replayed = {"solve_status": "rejected", "error": str(e)[:300]}
+    finally:
+        finj.reset()
+    report["replayed"] = replayed
+    report["verdict"] = ("reproduced"
+                         if replayed["solve_status"] == "rejected"
+                         else "diverged")
+    if save:
+        _save_report(bundle, report)
+    return report
+
+
+def _save_report(bundle: str, report: dict):
+    import time
+    with open(os.path.join(bundle, "replay.json"), "w") as fh:
+        json.dump(dict(report,
+                       replayed_at=time.strftime("%Y-%m-%d %H:%M:%S")),
+                  fh, indent=1, sort_keys=True, default=str)
+
+
+def render(report: dict) -> str:
+    rec, rep = report["recorded"], report["replayed"]
+    lines = [
+        f"# postmortem replay — {report['bundle']}",
+        f"api:      {report['api']}   trigger: {report['trigger']}",
+        f"recorded: status={rec.get('solve_status')!r} "
+        f"verified_res={rec.get('verified_res')} "
+        f"iters={rec.get('iter_count')}",
+        f"replayed: status={rep.get('solve_status')!r} "
+        f"verified_res={rep.get('verified_res')} "
+        f"iters={rep.get('iter_count')}",
+        f"verdict:  {report['verdict'].upper()}",
+    ]
+    if rep.get("error"):
+        lines.append(f"replay error: {rep['error']}")
+    if report.get("skipped_knobs"):
+        lines.append("skipped knobs (unknown to this build): "
+                     + ", ".join(report["skipped_knobs"]))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    as_json = "--json" in argv
+    args = [a for a in argv if not a.startswith("-")]
+    if len(args) != 1:
+        print("usage: python -m quda_tpu.obs.replay [--json] "
+              "<bundle-dir>", file=sys.stderr)
+        return 2
+    report = replay_bundle(args[0])
+    print(json.dumps(report, indent=1, default=str) if as_json
+          else render(report))
+    return 0 if report["verdict"] in ("reproduced", "recovered") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,7 @@ from typing import Optional
 
 from . import memory as omem
 from . import metrics as omet
+from . import postmortem as opm
 
 
 def _mb(nbytes) -> str:
@@ -123,6 +124,28 @@ def render(snap: Optional[dict] = None) -> str:
                      f"exits: {breakdowns:g}")
     else:
         lines.append("  (no retries, degradations, or breakdowns)")
+    lines.append("")
+
+    # -- postmortem bundles (obs/postmortem.py) --
+    lines.append("## Postmortems (failure-capture bundles)")
+    pm_bundles = opm.bundles()
+    if pm_bundles:
+        by_trigger: dict = {}
+        for b in pm_bundles:
+            by_trigger[b["trigger"]] = by_trigger.get(b["trigger"],
+                                                      0) + 1
+        for trig in sorted(by_trigger):
+            lines.append(f"  {trig}: {by_trigger[trig]}")
+        for b in pm_bundles:
+            lines.append(f"    {b['path']}  replay-verified: "
+                         f"{opm.replay_status(b['path'])}")
+        if opm.suppressed():
+            lines.append(f"  ({opm.suppressed()} further capture(s) "
+                         "suppressed past the session bundle cap)")
+        lines.append("  replay: python -m quda_tpu.obs.replay "
+                     "<bundle>")
+    else:
+        lines.append("  (no postmortem bundles this session)")
     lines.append("")
 
     # -- MG setup attribution --

@@ -88,6 +88,15 @@ TRACE_EVENTS: dict[str, dict] = {
     "hbm_field_released": {"cat": "memory",
                            "doc": "resident field freed from the HBM "
                                   "ledger"},
+    # failure capture (obs/postmortem.py / obs/flight.py)
+    "postmortem_written": {"cat": "postmortem",
+                           "doc": "one failure-capture bundle written "
+                                  "under the postmortem path (trigger "
+                                  "+ api + bundle dir)"},
+    "flight_dropped": {"cat": "flight",
+                       "doc": "the flight-recorder ring wrapped: "
+                              "oldest events were dropped (count "
+                              "reported at session stop)"},
 }
 
 # -- metrics (obs/metrics.py registry) --------------------------------------
@@ -191,6 +200,13 @@ METRICS: dict[str, dict] = {
         "type": COUNTER,
         "help": "total MG setup wall seconds per hierarchy build, by "
                 "levels"},
+    # failure capture (obs/postmortem.py)
+    "postmortems_total": {
+        "type": COUNTER,
+        "help": "postmortem bundles captured, by trigger (breakdown:*, "
+                "verify_mismatch, construct_error:*, ladder_exhausted:"
+                "*, gauge_rejected, exception:*; 'suppressed' counts "
+                "captures past the per-session bundle cap)"},
     # bench harness (bench_suite.py)
     "bench_rows_total": {
         "type": COUNTER,

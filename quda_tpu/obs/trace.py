@@ -33,6 +33,10 @@ import time
 from contextlib import contextmanager
 from typing import Optional
 
+# the flight recorder taps this module's event stream (obs/flight.py
+# imports nothing from here at module level, so the edge is acyclic)
+from . import flight as _flight
+
 
 class _NoopSpan:
     """Zero-overhead disabled span (the QUDA_DO_NOT_PROFILE analog)."""
@@ -269,7 +273,18 @@ def span(name: str, cat: str = "api", mesh=None, **args):
 
 
 def event(name: str, cat: str = "event", **fields):
-    """Instant event into both the chrome trace and the JSONL stream."""
+    """Instant event into both the chrome trace and the JSONL stream.
+
+    Every call here also lands in the flight-recorder ring when
+    QUDA_TPU_FLIGHT is on — the recorder rides the SAME emission sites
+    (tuner decisions, escalation rungs, sentinel codes, gauge loads/
+    rejections, exchange-policy picks) independently of whether a
+    trace session is active, so the black box costs zero new
+    instrumentation.  Both disabled paths stay one-global-load
+    no-ops."""
+    fl = _flight._session
+    if fl is not None:
+        fl.append(name, cat, fields)
     s = _session
     if s is None:
         return
@@ -309,11 +324,18 @@ def flush() -> Optional[dict]:
 def api_span(name: str, **args):
     """Top-level API span: a pushProfile interval (category 'total' on
     the named TimeProfile) + a trace span — one context for every
-    interface entry point (invert_quda, eigensolve_quda, ...)."""
+    interface entry point (invert_quda, eigensolve_quda, ...).  API
+    entries/exits are also marked into the flight-recorder ring
+    (host-side, no-op when QUDA_TPU_FLIGHT is off) so a postmortem
+    bundle's tail shows what the worker was serving when it failed."""
     from ..utils.timer import push_profile
-    with push_profile(name):
-        with span(name, cat="api", **args):
-            yield
+    _flight.record("api_enter", cat="api", api=name, **args)
+    try:
+        with push_profile(name):
+            with span(name, cat="api", **args):
+                yield
+    finally:
+        _flight.record("api_exit", cat="api", api=name)
 
 
 @contextmanager

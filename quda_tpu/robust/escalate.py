@@ -50,6 +50,16 @@ def enabled() -> bool:
     return rsent.mode() == "escalate"
 
 
+def _pm_capture(trigger: str, api: str, param, exc=None):
+    """Postmortem hook for the ladder's failure paths (construct
+    errors, ladder exhaustion): one bounded bundle per failure under
+    the resource path (obs/postmortem.py; no-op when capture is off).
+    tests/test_flight_lint.py pins that every failure path in this
+    module calls it."""
+    from ..obs import postmortem as opm
+    opm.capture(trigger, api=api, param=param, exc=exc)
+
+
 def ladder(param) -> List[dict]:
     """The rung list for this solve: label + knob overrides (+ optional
     solver swap), bounded by QUDA_TPU_ROBUST_MAX_RETRIES.  Rung 0 is
@@ -127,6 +137,8 @@ def run_ladder(body: Callable, source, param, api: str = "invert_quda"):
                              "status":
                                  f"construct_error:{type(e).__name__}",
                              "error": str(e)[:200]})
+            _pm_capture(f"construct_error:{type(e).__name__}", api,
+                        p_i, exc=e)
             if i + 1 < len(rungs):
                 otr.event("solve_retry", cat="robust", api=api,
                           from_rung=rung["label"],
@@ -174,11 +186,14 @@ def run_ladder(body: Callable, source, param, api: str = "invert_quda"):
     if best is None:
         param.solve_attempts = list(attempts)
         param.solve_status = "failed"
+        _pm_capture("ladder_exhausted:failed", api, param,
+                    exc=last_exc)
         raise last_exc
     _, best_rung, x, p_i = best
     _publish(param, p_i, attempts)
     param.solve_status = f"degraded:{p_i.solve_status}"
     param.converged = False
+    _pm_capture(f"ladder_exhausted:{param.solve_status}", api, param)
     otr.event("solve_degraded", cat="robust", api=api, rung=best_rung,
               attempts=len(attempts), status=param.solve_status)
     omet.inc("solve_degraded_total", api=api)

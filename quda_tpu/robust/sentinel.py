@@ -75,6 +75,14 @@ def make(stagnation_checks: Optional[int] = None) -> Optional["Sentinel"]:
         from ..utils import config as qconf
         stagnation_checks = int(qconf.get("QUDA_TPU_ROBUST_STAGNATION",
                                           fresh=True))
+    # flight-recorder marker (host-side, no-op when QUDA_TPU_FLIGHT is
+    # off): the ring shows which solves ran sentinel-guarded, so a
+    # postmortem tail distinguishes "breakdown detected" from "nothing
+    # was watching" — the trip itself arrives via the
+    # breakdown_detected trace-event tap
+    from ..obs import flight as ofl
+    ofl.record("sentinel_armed", cat="robust", mode=mode(),
+               stagnation=stagnation_checks)
     return Sentinel(stagnation_checks)
 
 

@@ -249,6 +249,58 @@ _register("QUDA_TPU_ENABLE_FORCE_MONITOR", "bool", False,
           "log per-step force norms during HMC momentum updates",
           reference="QUDA_ENABLE_FORCE_MONITOR")
 
+# -- flight recorder / postmortem bundles (obs/flight.py, obs/postmortem.py)
+_register("QUDA_TPU_FLIGHT", "bool", False,
+          "enable the in-process flight recorder (obs/flight.py): a "
+          "bounded host-side ring buffer of structured events (API "
+          "entries/exits, tuner decisions, escalation rungs, sentinel "
+          "codes, gauge loads/rejections, exchange-policy picks) whose "
+          "tail lands in every postmortem bundle and in flight.jsonl "
+          "at end_quda; off (default) = zero-overhead no-op appends "
+          "and bit-identical compiled solves (pinned by raising-stub "
+          "test)",
+          reference="persistent tunecache/profile artifacts "
+                    "(lib/tune.cpp:450-610) as the always-on black box")
+_register("QUDA_TPU_FLIGHT_EVENTS_MAX", "int", 4096,
+          "flight-recorder ring capacity: the newest this many events "
+          "are kept; older ones are dropped (counted, reported as a "
+          "flight_dropped trace event and in the bundle manifest)",
+          reference="bounded profiling buffers")
+_register("QUDA_TPU_POSTMORTEM", "choice", "",
+          "postmortem bundle capture on solve failure paths "
+          "(obs/postmortem.py): '1' = always capture, '0' = never, "
+          "empty = follow QUDA_TPU_FLIGHT (a bundle without the ring "
+          "tail is half blind, so capture defaults to riding the "
+          "recorder).  Triggers: sentinel breakdown, verification "
+          "mismatch, exhausted escalation ladder, gauge rejection, and "
+          "uncaught exceptions crossing an interfaces/quda_api.py "
+          "boundary",
+          ("", "0", "1"),
+          reference="QUDA_RESOURCE_PATH persistent artifacts as the "
+                    "production failure-capture surface")
+_register("QUDA_TPU_POSTMORTEM_PATH", "str", "",
+          "directory receiving postmortem bundle directories (one "
+          "pm_<stamp>_<trigger> dir per capture); empty = "
+          "<QUDA_TPU_RESOURCE_PATH>/postmortems, else the working "
+          "directory's ./postmortems",
+          reference="QUDA_RESOURCE_PATH")
+_register("QUDA_TPU_POSTMORTEM_MAX_MB", "float", 64.0,
+          "size cap (MB) on the field dumps inside one postmortem "
+          "bundle: fields are dumped in replay-priority order (gauge, "
+          "source, fat, long) until the budget is spent; fields past "
+          "the cap appear in manifest.json as omitted entries with "
+          "shape/dtype/sha256 only (a replay then reports what is "
+          "missing)",
+          reference="bounded artifact size for fleet log collection")
+_register("QUDA_TPU_POSTMORTEM_MAX_BUNDLES", "int", 8,
+          "cap on postmortem bundles written per session: a repeating "
+          "failure (e.g. every solve of a poisoned gauge breaking "
+          "down) must not fill the disk; past the cap, captures are "
+          "counted (postmortems_total{trigger=suppressed}) but not "
+          "written",
+          reference="bounded retry: a serving fleet must fail fast, "
+                    "not loop")
+
 # -- benchmark harness (bench.py / bench_suite.py) --------------------------
 for _n, _k, _d, _doc in (
         ("QUDA_TPU_BENCH_CPU", "bool", False,
@@ -475,6 +527,37 @@ def reset_cache():
 
 def knobs() -> dict[str, Knob]:
     return dict(_REGISTRY)
+
+
+def snapshot_raw() -> dict:
+    """Raw-string view of every knob currently steered away from its
+    default (env value or scoped-override layer, overrides winning) —
+    the replay-facing half of describe(): feeding these back through
+    :func:`overrides` reproduces this moment's configuration
+    (obs/postmortem.py records it in every bundle manifest)."""
+    out = {}
+    for name in _REGISTRY:
+        raw = os.environ.get(name)
+        for layer in reversed(_overrides):
+            if name in layer:
+                raw = layer[name]
+                break
+        if raw:
+            out[name] = raw
+    return out
+
+
+def snapshot_values() -> dict:
+    """Resolved typed value of every registered knob (the human half of
+    the postmortem snapshot; a malformed env value reads as None rather
+    than aborting a failure capture)."""
+    out = {}
+    for name in _REGISTRY:
+        try:
+            out[name] = get(name, fresh=True)
+        except ValueError:
+            out[name] = None
+    return out
 
 
 def describe() -> str:

@@ -167,6 +167,18 @@ def warm_start() -> int:
     return usable
 
 
+def cache_snapshot(platform_only: bool = True) -> Dict[str, dict]:
+    """Host-side copy of the in-process tunecache — with
+    ``platform_only`` restricted to the entries servable on THIS
+    hardware (the ones a solve on this chip could have consulted).
+    The postmortem bundle writer (obs/postmortem.py) embeds this so a
+    replayed solve can be compared against the winners the original
+    solve was served."""
+    here = platform_key() + "|"
+    return {k: dict(v) for k, v in _cache.items()
+            if not platform_only or k.startswith(here)}
+
+
 def tuning_enabled() -> bool:
     from . import config as qconf
     return qconf.get("QUDA_TPU_ENABLE_TUNING", fresh=True)
@@ -284,11 +296,13 @@ def record_launch(name: str, volume, aux: str, seconds: float,
     p["bytes"] += bytes_
 
 
-def save_profile(fname: str = "profile_0.tsv"):
-    """Write profile_N.tsv like lib/tune.cpp:528-610."""
+def save_profile(fname: str = "profile_0.tsv") -> Optional[str]:
+    """Write profile_N.tsv like lib/tune.cpp:528-610; returns the path
+    (None without a resource path) so end_quda can index it into
+    artifacts_manifest.json."""
     path = _resource_path()
     if not path:
-        return
+        return None
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, fname), "w") as fh:
         fh.write("key\tcalls\tseconds\tGFLOPS\tGB/s\n")
@@ -297,6 +311,7 @@ def save_profile(fname: str = "profile_0.tsv"):
             fh.write(f"{key}\t{p['calls']}\t{p['seconds']:.6f}\t"
                      f"{p['flops'] / s / 1e9:.2f}\t"
                      f"{p['bytes'] / s / 1e9:.2f}\n")
+    return os.path.join(path, fname)
 
 
 load_cache()

@@ -1,0 +1,432 @@
+"""Flight recorder + postmortem bundles + deterministic replay.
+
+The acceptance contract (ISSUE 11): with QUDA_TPU_FLIGHT=1 and
+QUDA_TPU_FAULT=residual:nan, a Wilson CG solve produces a postmortem
+bundle whose obs.replay run reproduces the recorded solve_status and
+verified residual bit-for-bit under the recorded knobs; with both
+flight and postmortem knobs off, a raising-stub test pins that compiled
+solves never touch the recorder and no bundle I/O occurs.  The
+QUDA_TPU_FAULT registry makes every capture trigger drillable on CPU.
+"""
+
+import glob
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from quda_tpu.obs import flight as ofl
+from quda_tpu.obs import postmortem as opm
+from quda_tpu.obs import replay as orep
+from quda_tpu.obs import trace as otr
+from quda_tpu.robust import faultinject as finj
+from quda_tpu.utils import config as qconf
+from quda_tpu.utils import logging as qlog
+
+
+@pytest.fixture(autouse=True)
+def _iso(monkeypatch):
+    """Every test starts with recorder/postmortem/fault state clean."""
+    finj.reset()
+    ofl.stop(flush_files=False)
+    otr.stop(flush_files=False)
+    opm.reset_session()
+    qconf.reset_cache()
+    monkeypatch.setattr(qlog, "_warned_once", set())
+    yield
+    finj.reset()
+    ofl.stop(flush_files=False)
+    otr.stop(flush_files=False)
+    opm.reset_session()
+    qconf.reset_cache()
+
+
+def _unit_gauge(L):
+    return np.broadcast_to(np.eye(3, dtype=np.complex64),
+                           (4, L, L, L, L, 3, 3)).copy()
+
+
+def _rand_src(L, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((L, L, L, L, 4, 3))
+            + 1j * rng.standard_normal((L, L, L, L, 4, 3))
+            ).astype(np.complex64)
+
+
+def _wilson_param(**kw):
+    from quda_tpu.interfaces.params import InvertParam
+    kw.setdefault("dslash_type", "wilson")
+    kw.setdefault("inv_type", "cg")
+    kw.setdefault("solve_type", "normop-pc")
+    kw.setdefault("kappa", 0.12)
+    kw.setdefault("tol", 1e-6)
+    kw.setdefault("maxiter", 300)
+    kw.setdefault("cuda_prec", "single")
+    return InvertParam(**kw)
+
+
+def _bundles(tmp_path):
+    return sorted(glob.glob(str(tmp_path / "postmortems" / "pm_*")))
+
+
+# -- ring-buffer unit level ---------------------------------------------------
+
+def test_ring_bounded_and_drop_counting():
+    ofl.start(maxlen=4)
+    for i in range(7):
+        ofl.record("ev", cat="t", i=i)
+    t = ofl.tail()
+    assert len(t) == 4
+    assert [e["i"] for e in t] == [3, 4, 5, 6]     # newest kept
+    assert ofl.dropped() == 3
+    assert t[-1]["seq"] == 7                        # seq never resets
+    assert ofl.tail(2) == t[-2:]
+
+
+def test_flush_writes_jsonl_and_reports_drops(tmp_path):
+    ofl.start(maxlen=2)
+    ofl.record("a", cat="t")
+    ofl.record("b", cat="t")
+    ofl.record("c", cat="t", odd=object())          # json-safe fallback
+    out = ofl.flush(path=str(tmp_path))
+    assert out["events"] == 2 and out["dropped"] == 1
+    lines = [json.loads(ln) for ln in open(out["flight"])]
+    assert [e["name"] for e in lines] == ["b", "c"]
+    assert isinstance(lines[1]["odd"], str)
+
+
+def test_trace_event_taps_into_ring_without_trace_session():
+    """Every otr.event site feeds the ring when the recorder is on,
+    even with the trace session off — the zero-new-call-sites
+    contract."""
+    assert not otr.enabled()
+    ofl.start(maxlen=16)
+    otr.event("tune_cached", cat="tune", key="k")
+    names = [e["name"] for e in ofl.tail()]
+    assert names == ["tune_cached"]
+
+
+def test_stop_emits_flight_dropped_event(tmp_path, monkeypatch):
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    qconf.reset_cache()
+    otr.start(str(tmp_path))
+    ofl.start(maxlen=1)
+    ofl.record("a", cat="t")
+    ofl.record("b", cat="t")
+    out = ofl.stop()
+    assert out["dropped"] == 1
+    paths = otr.stop()
+    names = [json.loads(ln)["name"] for ln in open(paths["jsonl"])]
+    assert "flight_dropped" in names
+
+
+# -- off means off: the raising-stub acceptance pin --------------------------
+
+def test_flight_off_solve_never_touches_recorder_or_bundles(
+        tmp_path, monkeypatch):
+    """With flight AND postmortem knobs off, a full API solve runs none
+    of the recorder append path and no bundle I/O — raising-stub
+    pinned (the obs zero-overhead discipline), including a failure
+    path (verification mismatch under ROBUST=verify)."""
+    from quda_tpu.interfaces.params import GaugeParam
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              invert_quda,
+                                              load_gauge_quda)
+    monkeypatch.delenv("QUDA_TPU_FLIGHT", raising=False)
+    monkeypatch.delenv("QUDA_TPU_POSTMORTEM", raising=False)
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    qconf.reset_cache()
+
+    def _boom(*a, **kw):
+        raise AssertionError("flight/postmortem code ran with both "
+                             "knobs off")
+
+    monkeypatch.setattr(ofl._Ring, "append", _boom)
+    monkeypatch.setattr(opm, "_write_bundle", _boom)
+    monkeypatch.setattr(opm, "solve_scope", _boom)
+    init_quda()
+    L = 4
+    load_gauge_quda(_unit_gauge(L), GaugeParam(X=(L,) * 4,
+                                               cuda_prec="single"))
+    # clean solve AND a failure-classified solve: neither touches it
+    p = _wilson_param()
+    invert_quda(_rand_src(L), p)
+    assert p.solve_status == "converged"
+    finj.arm("residual", "1e6")
+    p2 = _wilson_param()
+    invert_quda(_rand_src(L), p2)
+    assert p2.solve_status == "unverified"
+    end_quda()
+    assert not os.path.exists(tmp_path / "postmortems")
+    assert not os.path.exists(tmp_path / "flight.jsonl")
+
+
+# -- the ISSUE-11 acceptance drill -------------------------------------------
+
+def test_acceptance_residual_nan_drill_replays_bit_for_bit(
+        tmp_path, monkeypatch):
+    """QUDA_TPU_FLIGHT=1 + QUDA_TPU_FAULT=residual:nan: the Wilson CG
+    solve is captured as a verify_mismatch bundle, and the replay
+    reproduces the recorded solve_status and verified residual
+    bit-for-bit under the recorded knobs (the fault spec is part of
+    the snapshot, so the drill replays too)."""
+    from quda_tpu.interfaces.params import GaugeParam
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              invert_quda,
+                                              load_gauge_quda)
+    monkeypatch.setenv("QUDA_TPU_FLIGHT", "1")
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "verify")
+    monkeypatch.setenv("QUDA_TPU_FAULT", "residual:nan")
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    qconf.reset_cache()
+    init_quda()
+    L = 4
+    load_gauge_quda(_unit_gauge(L), GaugeParam(X=(L,) * 4,
+                                               cuda_prec="single"))
+    p = _wilson_param()
+    invert_quda(_rand_src(L), p)
+    assert p.solve_status == "unverified"
+    assert math.isnan(p.verified_res)
+    end_quda()
+
+    bundles = _bundles(tmp_path)
+    assert len(bundles) == 1
+    m = json.load(open(os.path.join(bundles[0], "manifest.json")))
+    assert m["trigger"] == "verify_mismatch"
+    assert m["api"] == "invert_quda"
+    assert m["knobs"]["QUDA_TPU_FAULT"] == "residual:nan"
+    assert m["knobs"]["QUDA_TPU_ROBUST"] == "verify"
+    assert m["invert_param"]["solve_status"] == "unverified"
+    for f in ("gauge", "source"):
+        ent = m["fields"][f]
+        assert ent["file"] and len(ent["sha256"]) == 64
+        assert os.path.exists(os.path.join(bundles[0], ent["file"]))
+    assert os.path.getsize(os.path.join(bundles[0], "flight.jsonl"))
+
+    # the artifacts manifest indexes the bundle + flight.jsonl
+    am = json.load(open(tmp_path / "artifacts_manifest.json"))
+    assert "flight.jsonl" in am["artifacts"]
+    assert am["postmortems"][0]["trigger"] == "verify_mismatch"
+    assert am["postmortems"][0]["path"] == bundles[0]
+    assert am["knobs"]["QUDA_TPU_FAULT"] == "residual:nan"
+
+    report = orep.replay_bundle(bundles[0])
+    assert report["verdict"] == "reproduced"
+    assert report["status_match"]
+    assert report["replayed"]["solve_status"] == "unverified"
+    assert orep.bits_equal(report["recorded"]["verified_res"],
+                           report["replayed"]["verified_res"])
+    # the verdict is appended to the bundle for the fleet report
+    rj = json.load(open(os.path.join(bundles[0], "replay.json")))
+    assert rj["verdict"] == "reproduced"
+    assert opm.replay_status(bundles[0]) == "yes (reproduced)"
+    end_quda()
+
+
+def test_breakdown_drill_bundle_and_ladder_recovery(tmp_path,
+                                                    monkeypatch):
+    """dslash:5 under escalate: the rung-0 breakdown is captured
+    (bundle records the failing ATTEMPT) while the ladder recovers the
+    caller's solve; the replay runs the full ladder under the recorded
+    knobs and reports 'recovered'."""
+    from quda_tpu.interfaces.params import GaugeParam
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              invert_quda,
+                                              load_gauge_quda)
+    monkeypatch.setenv("QUDA_TPU_FLIGHT", "1")
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "escalate")
+    monkeypatch.setenv("QUDA_TPU_FAULT", "dslash:5")
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    qconf.reset_cache()
+    init_quda()
+    L = 4
+    load_gauge_quda(_unit_gauge(L), GaugeParam(X=(L,) * 4,
+                                               cuda_prec="single"))
+    p = _wilson_param()
+    invert_quda(_rand_src(L), p)
+    assert p.solve_status == "converged"          # ladder recovered
+    end_quda()
+    bundles = _bundles(tmp_path)
+    assert len(bundles) == 1
+    m = json.load(open(os.path.join(bundles[0], "manifest.json")))
+    assert m["trigger"] == "breakdown:nonfinite"
+    assert m["invert_param"]["solve_status"] == "breakdown:nonfinite"
+    report = orep.replay_bundle(bundles[0])
+    assert report["verdict"] == "recovered"
+    assert report["replayed"]["solve_status"] == "converged"
+    end_quda()
+
+
+def test_gauge_rejection_drill_captures_and_replays(tmp_path,
+                                                    monkeypatch):
+    """gauge:1: the rejected (poisoned) gauge is dumped into the
+    bundle, and replaying the bundle reproduces the rejection from the
+    dump alone."""
+    from quda_tpu.interfaces.params import GaugeParam
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              load_gauge_quda)
+    from quda_tpu.utils.logging import QudaError
+    monkeypatch.setenv("QUDA_TPU_FLIGHT", "1")
+    monkeypatch.setenv("QUDA_TPU_FAULT", "gauge:1")
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    qconf.reset_cache()
+    init_quda()
+    L = 4
+    with pytest.raises(QudaError, match="non-finite link"):
+        load_gauge_quda(_unit_gauge(L), GaugeParam(X=(L,) * 4,
+                                                   cuda_prec="single"))
+    end_quda()
+    bundles = _bundles(tmp_path)
+    assert len(bundles) == 1
+    m = json.load(open(os.path.join(bundles[0], "manifest.json")))
+    assert m["trigger"] == "gauge_rejected"
+    assert m["invert_param"] is None
+    assert m["gauge_param"]["X"] == [L] * 4       # the REJECTED load's
+    report = orep.replay_bundle(bundles[0])
+    assert report["verdict"] == "reproduced"
+    assert report["replayed"]["solve_status"] == "rejected"
+    end_quda()
+
+
+def test_pallas_build_drill_captures_construct_error(tmp_path,
+                                                     monkeypatch):
+    """pallas_build:1 under escalate: the construction failure is
+    captured by the ladder's except path with per-attempt provenance,
+    while the caller's solve recovers on the XLA rung."""
+    from quda_tpu.interfaces.params import GaugeParam
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              invert_quda,
+                                              load_gauge_quda)
+    monkeypatch.setenv("QUDA_TPU_FLIGHT", "1")
+    monkeypatch.setenv("QUDA_TPU_ROBUST", "escalate")
+    monkeypatch.setenv("QUDA_TPU_PALLAS", "1")
+    monkeypatch.setenv("QUDA_TPU_PACKED", "1")
+    monkeypatch.setenv("QUDA_TPU_FAULT", "pallas_build:1")
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    qconf.reset_cache()
+    init_quda()
+    L = 4
+    load_gauge_quda(_unit_gauge(L), GaugeParam(X=(L,) * 4,
+                                               cuda_prec="single"))
+    p = _wilson_param()
+    invert_quda(_rand_src(L), p)
+    assert p.solve_status == "converged"
+    end_quda()
+    bundles = _bundles(tmp_path)
+    assert len(bundles) == 1
+    m = json.load(open(os.path.join(bundles[0], "manifest.json")))
+    assert m["trigger"] == "construct_error:InjectedFault"
+    assert m["exception"]["type"] == "InjectedFault"
+
+
+# -- bundle policy knobs ------------------------------------------------------
+
+def test_one_bundle_per_solve_scope(tmp_path, monkeypatch):
+    """First capture inside a solve scope wins; later triggers of the
+    SAME API call (an exhausting ladder re-classifying per rung) are
+    skipped, so one bad solve cannot burn the session cap."""
+    monkeypatch.setenv("QUDA_TPU_POSTMORTEM", "1")
+    monkeypatch.setenv("QUDA_TPU_POSTMORTEM_PATH",
+                       str(tmp_path / "pm"))
+    qconf.reset_cache()
+    with opm.solve_scope("invert_quda"):
+        assert opm.capture("breakdown:nonfinite") is not None
+        assert opm.capture("breakdown:nonfinite") is None
+        assert opm.capture("ladder_exhausted:failed") is None
+    assert len(opm.bundles()) == 1
+    # a NEW call (new scope) captures again
+    with opm.solve_scope("invert_quda"):
+        assert opm.capture("verify_mismatch") is not None
+    assert len(opm.bundles()) == 2
+
+
+def test_exception_bundle_replays_reproduced(tmp_path, monkeypatch):
+    """An exception crossing the API boundary is captured, and the
+    replay verdicts 'reproduced' when re-running raises the same
+    exception type (the recorded param fields are pre-failure
+    defaults, so the status comparison alone could never match)."""
+    from quda_tpu.interfaces.params import GaugeParam
+    from quda_tpu.interfaces.quda_api import (end_quda, init_quda,
+                                              invert_quda,
+                                              load_gauge_quda)
+    from quda_tpu.utils.logging import QudaError
+    monkeypatch.setenv("QUDA_TPU_POSTMORTEM", "1")
+    monkeypatch.setenv("QUDA_TPU_RESOURCE_PATH", str(tmp_path))
+    qconf.reset_cache()
+    init_quda()
+    L = 4
+    load_gauge_quda(_unit_gauge(L), GaugeParam(X=(L,) * 4,
+                                               cuda_prec="single"))
+    # shifted solves must go through invert_multishift_quda — this
+    # raises QudaError across the invert_quda boundary
+    p = _wilson_param(num_offset=1, offset=(0.5,))
+    with pytest.raises(QudaError):
+        invert_quda(_rand_src(L), p)
+    end_quda()
+    bundles = _bundles(tmp_path)
+    assert len(bundles) == 1
+    m = json.load(open(os.path.join(bundles[0], "manifest.json")))
+    assert m["trigger"] == "exception:QudaError"
+    assert m["exception"]["type"] == "QudaError"
+    report = orep.replay_bundle(bundles[0])
+    assert report["replayed"]["solve_status"] == "raised:QudaError"
+    assert report["verdict"] == "reproduced"
+    end_quda()
+
+
+def test_bundle_cap_suppresses_further_captures(tmp_path, monkeypatch):
+    monkeypatch.setenv("QUDA_TPU_POSTMORTEM", "1")
+    monkeypatch.setenv("QUDA_TPU_POSTMORTEM_MAX_BUNDLES", "1")
+    monkeypatch.setenv("QUDA_TPU_POSTMORTEM_PATH",
+                       str(tmp_path / "pm"))
+    qconf.reset_cache()
+    assert opm.capture("unit_test_a") is not None
+    assert opm.capture("unit_test_b") is None
+    assert len(opm.bundles()) == 1
+    assert opm.suppressed() == 1
+
+
+def test_size_cap_omits_fields_but_keeps_hashes(tmp_path, monkeypatch):
+    monkeypatch.setenv("QUDA_TPU_POSTMORTEM", "1")
+    monkeypatch.setenv("QUDA_TPU_POSTMORTEM_MAX_MB", "0.001")  # 1 KB
+    monkeypatch.setenv("QUDA_TPU_POSTMORTEM_PATH",
+                       str(tmp_path / "pm"))
+    qconf.reset_cache()
+    big = np.zeros((64, 64), np.complex64)          # 32 KB > cap
+    small = np.zeros((8,), np.float32)              # 32 B fits
+    path = opm.capture("unit_test_cap",
+                       fields={"gauge": big, "source": small})
+    m = json.load(open(os.path.join(path, "manifest.json")))
+    assert m["fields"]["gauge"].get("omitted") == "size_cap"
+    assert len(m["fields"]["gauge"]["sha256"]) == 64
+    assert "file" not in m["fields"]["gauge"]
+    assert m["fields"]["source"]["file"]            # priority order:
+    # gauge first ate nothing (omitted), source fit
+    with pytest.raises(ValueError, match="omitted at capture"):
+        orep._load_field(path, m, "gauge")
+
+
+def test_postmortem_knob_explicit_off_beats_flight(monkeypatch):
+    monkeypatch.setenv("QUDA_TPU_POSTMORTEM", "0")
+    qconf.reset_cache()
+    ofl.start(maxlen=4)
+    assert not opm.enabled()
+    assert opm.capture("unit_test_off") is None
+    assert opm.bundles() == []
+
+
+def test_fleet_report_postmortems_section(tmp_path, monkeypatch):
+    from quda_tpu.obs import report as orept
+    monkeypatch.setenv("QUDA_TPU_POSTMORTEM", "1")
+    monkeypatch.setenv("QUDA_TPU_POSTMORTEM_PATH",
+                       str(tmp_path / "pm"))
+    qconf.reset_cache()
+    path = opm.capture("unit_test_report")
+    text = orept.render()
+    assert "## Postmortems" in text
+    assert "unit_test_report: 1" in text
+    assert path in text
+    assert "replay-verified: no" in text
