@@ -230,7 +230,7 @@ def main(argv):
     platform = actual
 
     suites = set(a for a in argv if not a.startswith("-")) or {
-        "blas", "dslash", "solver", "sharded", "costmodel"}
+        "blas", "dslash", "solver", "sharded", "costmodel", "serve"}
 
     if do_trace:
         from quda_tpu.obs import trace as qtrace
@@ -1235,6 +1235,85 @@ def main(argv):
                 "drift_ok": r["ok"],
                 "platform": platform, "lattice": [4] * 4},
                 banner_platform=banner)
+
+    if "serve" in suites and suite_guard("serve"):
+        # solve-service batch amortization (ROADMAP item 2): per-source
+        # throughput of the SAME solve coalesced at N=1/4/8 on the
+        # resident-gauge path.  The MRHS kernels read each gauge tile
+        # once per (t, z-block) and stream all N sources through it
+        # (PERF.md round-7 curve: per-RHS traffic 576+576/N B/site), so
+        # the amortized gflops row is the serving claim the regression
+        # gate owns.  Timing is end to end THROUGH the service (queue +
+        # coalesce + solve + fan-out): serving overhead is part of the
+        # claim, not hidden under it.
+        from quda_tpu.interfaces.params import GaugeParam, InvertParam
+        from quda_tpu.serve import SolveService
+        from quda_tpu.utils import config as _qsc
+        Ls = _conf("QUDA_TPU_BENCH_SOLVER_L") if platform != "cpu" else 8
+        rng_s = np.random.default_rng(17)
+        gh = (rng_s.standard_normal((4, Ls, Ls, Ls, Ls, 3, 3))
+              + 1j * rng_s.standard_normal((4, Ls, Ls, Ls, Ls, 3, 3))
+              ).astype(np.complex64) * 0.3
+        gh += np.eye(3, dtype=np.complex64)     # keep CG well-posed
+
+        def _serve_srcs(n, seed):
+            r = np.random.default_rng(seed)
+            return [(r.standard_normal((Ls, Ls, Ls, Ls, 4, 3))
+                     + 1j * r.standard_normal((Ls, Ls, Ls, Ls, 4, 3))
+                     ).astype(np.complex64) for _ in range(n)]
+
+        # the packed batched-pairs pipeline is the route being measured
+        # (platform default on TPU; pinned so the CPU row exercises the
+        # same dispatch instead of the per-source fallback)
+        with _qsc.overrides(QUDA_TPU_PACKED="1"):
+            svc = SolveService(batch_window_ms=50.0)
+            svc.load_gauge("bench", gh,
+                           GaugeParam(X=(Ls,) * 4, cuda_prec="single"))
+            ip = InvertParam(dslash_type="wilson", inv_type="cg",
+                             solve_type="normop-pc", kappa=0.12,
+                             tol=1e-6, maxiter=500,
+                             cuda_prec="single")
+            svc.start()
+            try:
+                for n in (1, 4, 8):
+                    try:
+                        # warm pass compiles the N-wide executable;
+                        # the timed pass is the serving steady state
+                        warm = [svc.submit(s, ip, "bench")
+                                for s in _serve_srcs(n, 100 + n)]
+                        [t.result(timeout=1200) for t in warm]
+                        srcs = _serve_srcs(n, 200 + n)
+                        t0 = time.perf_counter()
+                        outs = [t.result(timeout=1200) for t in
+                                [svc.submit(s, ip, "bench")
+                                 for s in srcs]]
+                        secs = time.perf_counter() - t0
+                        conv = all(o.status == "converged"
+                                   for o in outs)
+                        gfl = outs[0].param.gflops   # batch total
+                        record_row("serve", {
+                            "name": f"serve_batch_amortization_n{n}",
+                            "nrhs": n,
+                            "secs": round(secs, 6),
+                            "srcs_per_s": round(n / secs, 4),
+                            "gflops": round(gfl / secs, 3),
+                            "iters": int(max(o.iter_count
+                                             for o in outs)),
+                            "converged": conv,
+                            "batch_size": outs[0].batch_size,
+                            "platform": platform,
+                            "lattice": [Ls] * 4},
+                            banner_platform=banner)
+                    except Exception as e:
+                        print(json.dumps({
+                            "suite": "serve",
+                            "name": f"serve_batch_amortization_n{n}",
+                            "error": str(e)[:140]}), flush=True)
+            finally:
+                # leave the bench process's obs sessions alone — the
+                # suite tail flushes them; the service only drains and
+                # persists its warm keys here
+                svc.stop(end_session=False)
 
     # every exporter's output is indexed into artifacts_manifest.json
     # below (the end_quda discipline): one file CI or an operator

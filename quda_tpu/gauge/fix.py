@@ -139,9 +139,15 @@ def gaugefix_fft(gauge: jnp.ndarray, geom: LatticeGeometry,
     @jax.jit
     def one_iter(gauge):
         d = _div_a(gauge, gauge_dirs)           # anti-Hermitian traceless
-        dk = jnp.fft.fftn(d, axes=(0, 1, 2, 3))
+        # XLA caps FFTs at 3 dimensions, so the 4d lattice transform is
+        # factored into a 3d pass + a 1d pass (the DFT is separable —
+        # bit-wise this is the same linear map fftn over all four axes
+        # computes)
+        dk = jnp.fft.fftn(d, axes=(1, 2, 3))
+        dk = jnp.fft.fft(dk, axis=0)
         dk = dk * w[..., None, None].astype(dk.dtype)
-        d_acc = jnp.fft.ifftn(dk, axes=(0, 1, 2, 3))
+        d_acc = jnp.fft.ifft(dk, axis=0)
+        d_acc = jnp.fft.ifftn(d_acc, axes=(1, 2, 3))
         # g = exp(-alpha * d_acc): d_acc anti-Hermitian -> exp(i * (i d)) ...
         h = -1j * d_acc  # Hermitian generator
         g = expm_su3(-alpha * h, order=8)

@@ -312,9 +312,28 @@ def load_gauge_quda(gauge, param: GaugeParam):
         scale = jnp.ones((4, 1, 1, 1, 1, 1, 1), g.real.dtype)
         scale = scale.at[:3].set(1.0 / param.anisotropy)
         g = g * scale.astype(dtype)
+    _install_resident_gauge(g, param, geom)
+
+
+def _install_resident_gauge(g, param: GaugeParam, geom: LatticeGeometry):
+    """Install an ALREADY converted/validated device gauge as the
+    resident one: geometry + param + epoch bump + ledger re-track —
+    the residency-manager seam (serve/residency.py) that generalises
+    the single ``_ctx['gauge']`` slot to multiple cached gauges
+    without re-running load_gauge_quda's host-order conversion and
+    input screens on every activation.  ``load_gauge_quda`` itself
+    ends here, so single-slot callers (MILC interface included) see
+    exactly the pre-round-15 behavior."""
     _ctx["geom"] = geom
     _set_resident_gauge(g)
     _ctx["gauge_param"] = param
+
+
+def resident_gauge_state():
+    """(gauge, gauge_param, geom) of the currently resident gauge —
+    how serve/residency adopts a gauge just loaded through
+    ``load_gauge_quda`` into its multi-gauge table."""
+    return _ctx["gauge"], _ctx["gauge_param"], _ctx["geom"]
 
 
 def free_gauge_quda():
@@ -1398,15 +1417,6 @@ def _invert_multi_src_body(sources, param: InvertParam):
         qlog.errorq("invert_multi_src_quda does not serve multishift; "
                     "use invert_multishift_quda per source")
 
-    split_mode = str(qconf.get("QUDA_TPU_MULTI_SRC_SPLIT", fresh=True))
-    mesh = None
-    if split_mode != "0":
-        from ..parallel.split import auto_split_mesh
-        mesh = auto_split_mesh(n_src)
-        if split_mode == "1" and mesh is None:
-            qlog.errorq("QUDA_TPU_MULTI_SRC_SPLIT=1 but no usable src "
-                        "mesh (need >1 device and >1 source)")
-
     cg_family = param.inv_type in ("cg", "pcg", "cgnr", "cgne")
     # f32 pair storage cannot certify tolerances below the f32 floor —
     # deep-tol batches take the per-source fallback, whose invert_quda
@@ -1429,6 +1439,20 @@ def _invert_multi_src_body(sources, param: InvertParam):
                        else 570) + 24
     else:
         flops_m = 2 * 1320 + 48
+
+    # split-vs-batched dispatch, resolved in its one home
+    # (parallel/split.multi_src_route — the serve/ batcher consults the
+    # same function to label coalesced batches with their route)
+    from ..parallel.split import multi_src_route
+    split_mode = str(qconf.get("QUDA_TPU_MULTI_SRC_SPLIT", fresh=True))
+    try:
+        route, mesh, split_gated = multi_src_route(
+            n_src, split_mode=split_mode,
+            split_gate=(pc and param.dslash_type == "wilson"
+                        and cg_family and tol_ok),
+            batched_gate=batched_able)
+    except ValueError as e:
+        qlog.errorq(str(e))
 
     def _finish(x_full, iters_rhs, res_rhs, mv_applies,
                 converged_rhs=None, breakdown=None):
@@ -1471,9 +1495,7 @@ def _invert_multi_src_body(sources, param: InvertParam):
             f"{param.true_res:.2e}, {param.secs:.2f} s")
         return x_full
 
-    if (mesh is not None
-            and not (pc and param.dslash_type == "wilson" and cg_family
-                     and tol_ok)):
+    if split_gated:
         # a usable src mesh exists but this operator/solver/tolerance
         # is outside the split route's CG-family Wilson-PC gate: say so
         # (an env knob or auto decision must never lose effect without
@@ -1485,9 +1507,8 @@ def _invert_multi_src_body(sources, param: InvertParam):
             f"{param.dslash_type}/{param.inv_type} (tol {param.tol:g}) "
             "falls back to the batched-pairs/per-source routes",
             qlog.SUMMARIZE)
-        mesh = None
 
-    if mesh is not None:
+    if route == "split":
         # split grid: shard sources over the src mesh axis, replicate
         # the gauge, one full PC solve per sub-grid (complex arithmetic
         # — this route serves multi-device hosts, where complex
@@ -1537,7 +1558,7 @@ def _invert_multi_src_body(sources, param: InvertParam):
                            converged_rhs=np.asarray(conv_l),
                            breakdown=bk)
 
-    if mesh is None and batched_able:
+    if route == "batched":
         from ..solvers.block import (_per_rhs_dot, batched_cg_pairs,
                                      block_cg_pairs)
         with otr.phase("setup", "invert_multi_src_quda"):

@@ -215,6 +215,36 @@ def record_execution(api: str, form: str, shape, dtype: str,
     return first
 
 
+def executable_keys() -> set:
+    """Snapshot of the (api, form, shape, dtype, solver) keys executed
+    this session (the rendered-string form ``record_execution`` keys
+    on).  serve/persist.py writes these to the resource path at worker
+    shutdown so the NEXT process knows which executables the persisted
+    XLA compilation cache already holds."""
+    r = _session
+    if r is None:
+        return set()
+    with r.lock:
+        return set(r.seen_keys)
+
+
+def seed_executable_keys(keys) -> int:
+    """Pre-seed the compile-accounting key set (serve/persist.py warm
+    start): a key seeded here was compiled by a PREVIOUS process whose
+    executable the persisted compilation cache serves, so its first
+    execution in THIS process must count as a warm execution, not a
+    compile — ``compiles_total == 0`` for already-keyed executables is
+    the ROADMAP item-2 acceptance instrument.  Returns the number of
+    keys newly seeded (0 when no session is active)."""
+    r = _session
+    if r is None:
+        return 0
+    with r.lock:
+        fresh = {str(k) for k in keys} - r.seen_keys
+        r.seen_keys |= fresh
+    return len(fresh)
+
+
 # -- snapshot / export ------------------------------------------------------
 
 def snapshot() -> dict:

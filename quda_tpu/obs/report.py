@@ -148,6 +148,9 @@ def render(snap: Optional[dict] = None) -> str:
         lines.append("  (no postmortem bundles this session)")
     lines.append("")
 
+    # -- solve service (quda_tpu/serve) --
+    _render_service(snap, lines)
+
     # -- MG setup attribution --
     mg_phases = _by_name(snap, "counters", "mg_setup_phase_seconds_total")
     if mg_phases:
@@ -186,6 +189,91 @@ def render(snap: Optional[dict] = None) -> str:
         lines.append(f"  {row['knob']}: {row['budget_mb']:g} MB "
                      f"[{note}]{last}")
     return "\n".join(lines) + "\n"
+
+
+def _hist_percentile_bounds(h, qs=(0.5, 0.9, 0.99)):
+    """Upper-bound percentile estimates from the cumulative histogram
+    buckets: the tightest bucket bound covering each quantile (the
+    standard Prometheus-histogram read; exact values are not retained
+    by design).  Returns {q: bound-or-None}, None meaning the +Inf
+    bucket."""
+    bounds = {}
+    for q in qs:
+        target = q * h["n"]
+        cum = 0
+        val = None
+        for i, ub in enumerate(omet.HIST_BUCKETS):
+            cum += h["counts"][i]
+            if cum >= target:
+                val = ub
+                break
+        bounds[q] = val
+    return bounds
+
+
+def _render_service(snap: dict, lines: list):
+    """The Service section: rendered only when the solve service
+    recorded anything — queue depth, the batch-size histogram,
+    solve_seconds SLO percentiles, per-gauge residency traffic, and
+    the availability-event roll-up ROADMAP item 2 asks the fleet to
+    page on."""
+    reqs = _by_name(snap, "counters", "serve_requests_total")
+    batches = _by_name(snap, "counters", "serve_batches_total")
+    if not reqs and not batches:
+        return
+    lines.append("## Service (solve-service worker)")
+    for labels, v in reqs:
+        lines.append(f"  requests {labels.get('family', '?'):14s} "
+                     f"{labels.get('status', '?'):24s} {v:g}")
+    depth = {labels.get("scope"): v for labels, v in
+             _by_name(snap, "gauges", "serve_queue_depth")}
+    lines.append(f"  queue depth: last {depth.get('last', 0):g}, "
+                 f"peak {depth.get('peak', 0):g}")
+    if batches:
+        sizes = " ".join(
+            f"n={labels.get('size', '?')} x{v:g}"
+            for labels, v in sorted(
+                batches, key=lambda x: int(x[0].get("size", 0))))
+        lines.append(f"  coalesced batches: {sizes}")
+    for labels, h in _by_name(snap, "histograms",
+                              "serve_request_seconds"):
+        b = _hist_percentile_bounds(h)
+        pct = ", ".join(
+            f"p{int(q * 100)} "
+            + (f"<= {ub:g} s" if ub is not None
+               else f"> {omet.HIST_BUCKETS[-1]:g} s")
+            for q, ub in b.items())
+        mean = h["sum"] / max(1, h["n"])
+        lines.append(f"  solve_seconds SLO "
+                     f"[{labels.get('family', '?')}]: {pct} "
+                     f"(n={h['n']}, mean {mean:.3f} s)")
+    gauges_seen = {}
+    for metric, col in (("serve_gauge_hits_total", "hits"),
+                        ("serve_gauge_activations_total",
+                         "activations"),
+                        ("serve_gauge_evictions_total", "evictions")):
+        for labels, v in _by_name(snap, "counters", metric):
+            gauges_seen.setdefault(labels.get("gauge", "?"),
+                                   {})[col] = v
+    for gid in sorted(gauges_seen):
+        g = gauges_seen[gid]
+        lines.append(f"  gauge {gid}: hits {g.get('hits', 0):g}, "
+                     f"activations {g.get('activations', 0):g}, "
+                     f"evictions {g.get('evictions', 0):g}")
+    avail = _by_name(snap, "counters", "serve_availability_events_total")
+    if avail:
+        for labels, v in avail:
+            lines.append(f"  availability events "
+                         f"[{labels.get('kind', '?')}]: {v:g}")
+    else:
+        lines.append("  availability events: none")
+    warm = {labels.get("scope"): v for labels, v in
+            _by_name(snap, "gauges", "serve_warm_keys")}
+    if warm:
+        lines.append(f"  warm executable keys: "
+                     f"loaded {warm.get('loaded', 0):g}, "
+                     f"saved {warm.get('saved', 0):g}")
+    lines.append("")
 
 
 def save(path: str, snap: Optional[dict] = None) -> str:

@@ -88,6 +88,23 @@ TRACE_EVENTS: dict[str, dict] = {
     "hbm_field_released": {"cat": "memory",
                            "doc": "resident field freed from the HBM "
                                   "ledger"},
+    # solve service (quda_tpu/serve)
+    "serve_batch": {"cat": "serve",
+                    "doc": "one coalesced batch executed by the solve-"
+                           "service worker (gauge, size, route, queue "
+                           "depth at collection)"},
+    "serve_gauge_evicted": {"cat": "serve",
+                            "doc": "residency manager evicted an LRU "
+                                   "gauge to fit the HBM budget"},
+    "serve_availability": {"cat": "serve",
+                           "doc": "a request finished degraded/"
+                                  "unverified/failed — the availability "
+                                  "event a fleet pages on instead of a "
+                                  "stack trace"},
+    "serve_warm_start": {"cat": "serve",
+                         "doc": "worker warm start: persisted "
+                                "compilation-cache dir + executable-key "
+                                "index load stats"},
     # failure capture (obs/postmortem.py / obs/flight.py)
     "postmortem_written": {"cat": "postmortem",
                            "doc": "one failure-capture bundle written "
@@ -207,6 +224,49 @@ METRICS: dict[str, dict] = {
                 "verify_mismatch, construct_error:*, ladder_exhausted:"
                 "*, gauge_rejected, exception:*; 'suppressed' counts "
                 "captures past the per-session bundle cap)"},
+    # solve service (quda_tpu/serve)
+    "serve_requests_total": {
+        "type": COUNTER,
+        "help": "solve-service requests completed, by family/status "
+                "(status is the supervised solve_status, or 'failed' "
+                "for requests whose execution raised)"},
+    "serve_batches_total": {
+        "type": COUNTER,
+        "help": "coalesced MRHS batches executed by the solve-service "
+                "worker, by batch size — the batch-size histogram of "
+                "the fleet report's Service section"},
+    "serve_request_seconds": {
+        "type": HISTOGRAM,
+        "help": "wall seconds from request submission to result "
+                "delivery (queue wait + batch solve), by family — the "
+                "solve_seconds SLO surface of the Service section"},
+    "serve_queue_depth": {
+        "type": GAUGE,
+        "help": "solve-service queue depth, by scope (last = at the "
+                "most recent batch collection, peak = session maximum)"},
+    "serve_gauge_hits_total": {
+        "type": COUNTER,
+        "help": "requests served with their gauge already the active "
+                "resident one (no residency switch), by gauge"},
+    "serve_gauge_activations_total": {
+        "type": COUNTER,
+        "help": "residency switches: a cached gauge installed as the "
+                "active resident one for a batch, by gauge"},
+    "serve_gauge_evictions_total": {
+        "type": COUNTER,
+        "help": "gauges evicted by the residency manager to fit the "
+                "HBM budget (LRU order, never the active one), by "
+                "gauge"},
+    "serve_availability_events_total": {
+        "type": COUNTER,
+        "help": "requests that finished degraded / unverified / "
+                "breakdown / unconverged / failed, by kind — the "
+                "Service section's availability row"},
+    "serve_warm_keys": {
+        "type": GAUGE,
+        "help": "persisted executable-key index at worker warm start, "
+                "by scope (loaded = keys seeded into compile "
+                "accounting, saved = keys written at shutdown)"},
     # bench harness (bench_suite.py)
     "bench_rows_total": {
         "type": COUNTER,

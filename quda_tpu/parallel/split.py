@@ -47,6 +47,38 @@ def auto_split_mesh(n_src: int, devices=None):
                              devices=devs[:n])
 
 
+def multi_src_route(n_src: int, *, split_mode: str = "",
+                    split_gate: bool = True, batched_gate: bool = True,
+                    devices=None):
+    """The split-vs-batched-vs-per-source dispatch of
+    invert_multi_src_quda, in one queryable home (the QUDA split_key
+    decision, re-derived): returns ``(route, mesh, split_gated)`` with
+    ``route`` in {"split", "batched", "per_source"}, ``mesh`` the src
+    mesh when the split route serves, and ``split_gated`` True when a
+    usable mesh existed but the caller's operator/solver gate refused
+    it (the caller owes the user a notice — an env knob or auto
+    decision must never lose effect silently).
+
+    ``split_mode`` is the raw QUDA_TPU_MULTI_SRC_SPLIT value ('1'
+    force / '0' forbid / '' auto); forcing split without a usable mesh
+    raises ValueError.  The solve service (quda_tpu/serve) consults
+    this to label each coalesced batch with the route it will take."""
+    mesh = None
+    if split_mode != "0":
+        mesh = auto_split_mesh(n_src, devices=devices)
+        if split_mode == "1" and mesh is None:
+            raise ValueError(
+                "QUDA_TPU_MULTI_SRC_SPLIT=1 but no usable src mesh "
+                "(need >1 device and >1 source)")
+    split_gated = mesh is not None and not split_gate
+    if split_gated:
+        mesh = None
+    if mesh is not None:
+        return "split", mesh, False
+    return ("batched" if batched_gate else "per_source"), None, \
+        split_gated
+
+
 def split_grid_solve(solve_one: Callable, gauge, B: jnp.ndarray,
                      mesh: Mesh):
     """Run `solve_one(gauge, b) -> x` for a batch B of sources, with the

@@ -1,0 +1,31 @@
+"""quda_tpu.serve — the long-lived multi-tenant solve service.
+
+Reference behavior: QUDA keeps ONE resident gauge (gaugePrecise) and
+exposes batch solving through invertMultiSrcQuda
+(lib/interface_quda.cpp:3064); a serving deployment wraps that API in a
+daemon that owns request queuing, batching, residency, and warm start.
+This package is that daemon for the TPU build, composed entirely from
+instruments earlier rounds landed (ROADMAP item 2):
+
+* ``service.SolveService`` — the worker: a thread draining a
+  thread-safe request queue into coalesced solves, surfacing per-request
+  results on ticket futures and degraded solves as availability events
+  (never stack traces — the robust/ ladder and postmortem capture ride
+  along through the normal invert path).
+* ``batcher`` — pure coalescing logic: requests targeting the same
+  resident gauge and solve configuration group into one MRHS batch
+  routed through ``invert_multi_src_quda`` (batch window + max-batch
+  knobs; per-RHS iters/residuals fan back out per request).
+* ``residency.GaugeResidency`` — multiple resident gauges under the
+  obs/memory ledger's HBM budget with LRU eviction, generalising the
+  single ``_ctx['gauge']`` slot behind ``_install_resident_gauge`` so
+  ``load_gauge_quda`` / MILC callers keep working unchanged.
+* ``persist`` — cross-process warm start: the persistent XLA
+  compilation cache plus an executable-key index next to the tunecache,
+  so a fresh worker's first solve is compile-storm free
+  (``compiles_total`` vs ``executions_total`` is the instrument).
+"""
+
+from .batcher import SolveRequest, group, solve_key         # noqa: F401
+from .residency import GaugeResidency                       # noqa: F401
+from .service import SolveOutcome, SolveService, SolveTicket  # noqa: F401

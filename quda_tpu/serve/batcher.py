@@ -1,0 +1,154 @@
+"""Request coalescing for the solve service — pure logic, no threads.
+
+Reference behavior: invertMultiSrcQuda (lib/interface_quda.cpp:3064)
+amortises the gauge field over a batch of right-hand sides; PLQCD
+(arXiv:1405.0700) keeps the queue draining while the chips compute.
+The policy here: a request names the gauge it targets and carries an
+InvertParam template; requests whose (gauge, operator, solver,
+tolerance, precision) agree are ONE solve — the MRHS kernels read each
+gauge tile once and stream every coalesced source through it
+(PERF.md round-7 amortisation curve), and per-RHS iters/residuals fan
+back out per request through ``InvertParam.iter_count_multi`` /
+``true_res_multi``.
+
+``collect`` is the only time-aware piece: after the first request is
+picked up, the queue keeps draining for the batch window
+(``QUDA_TPU_SERVE_BATCH_WINDOW_MS``) so near-simultaneous arrivals
+coalesce; ``group`` then splits the drained requests into
+solve-key-homogeneous batches capped at ``QUDA_TPU_SERVE_MAX_BATCH``
+(and by ``QUDA_TPU_MAX_MULTI_RHS``), preserving FIFO order within a
+key.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as _queue
+import time
+from typing import Any, List, Optional
+
+
+@dataclasses.dataclass
+class SolveRequest:
+    """One queued solve: ``param`` is a TEMPLATE (the service copies it
+    per execution so result fields never race across requests)."""
+    source: Any
+    param: Any                    # InvertParam template
+    gauge_id: str
+    ticket: Any = None            # service.SolveTicket
+    submitted: float = 0.0        # time.monotonic() at submit
+
+
+# InvertParam fields that do NOT define the solve: results the API
+# writes back, plus presentation-only knobs.  The key below includes
+# EVERY OTHER field by construction — an allowlist would silently
+# merge requests the day someone adds an operator knob (m5 was exactly
+# such a miss), and merged-but-different operators deliver the wrong
+# solution with status 'converged'; a denylist at worst over-splits.
+_NON_KEY_FIELDS = frozenset((
+    # results (returned)
+    "true_res", "iter_count", "secs", "gflops", "true_res_multi",
+    "iter_count_multi", "res_history", "events", "converged",
+    "converged_multi", "verified_res", "solve_status",
+    "solve_attempts", "x_df64_lo",
+    # presentation only
+    "verbosity",
+))
+
+
+def solve_key(req: SolveRequest) -> tuple:
+    """Requests with equal keys may run as one MRHS batch: same gauge
+    and EQUAL InvertParam configuration (every field except results and
+    presentation knobs — the whole batch executes under one copied
+    param, so any field that could change the operator, solver, or
+    stopping criterion must split the batch).  Multishift requests
+    (num_offset > 0) never batch — invert_multi_src_quda refuses
+    them — so each gets a unique key and runs as a singleton through
+    invert_multishift_quda."""
+    p = req.param
+    if getattr(p, "num_offset", 0):
+        return ("multishift", id(req))
+    cfg = tuple(
+        (f.name, _hashable(getattr(p, f.name)))
+        for f in dataclasses.fields(p)
+        if f.name not in _NON_KEY_FIELDS)
+    return (req.gauge_id,) + cfg
+
+
+def _hashable(v):
+    """A hashable stand-in for one param value: sequences become
+    tuples (element-wise hashable via recursion), anything else
+    unhashable falls back to repr — the grouping dict must never raise
+    on an exotic field value (an over-split batch is correct, a dead
+    worker is not)."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    try:
+        hash(v)
+        return v
+    except Exception:        # noqa: BLE001 — proxy/lazy __hash__ can
+        return repr(v)       # raise anything; over-split, never die
+
+
+def max_batch() -> int:
+    from ..utils import config as qconf
+    return max(1, min(int(qconf.get("QUDA_TPU_SERVE_MAX_BATCH",
+                                    fresh=True)),
+                      int(qconf.get("QUDA_TPU_MAX_MULTI_RHS",
+                                    fresh=True))))
+
+
+def window_seconds() -> float:
+    from ..utils import config as qconf
+    return max(0.0, float(qconf.get("QUDA_TPU_SERVE_BATCH_WINDOW_MS",
+                                    fresh=True))) / 1e3
+
+
+def collect(q: "_queue.Queue", window_s: Optional[float] = None,
+            poll_s: float = 0.05) -> List[SolveRequest]:
+    """Blocking drain: wait up to ``poll_s`` for a first request
+    (returning [] on an idle poll so the worker can check its stop
+    flag), then drain everything that arrives within the batch window.
+    Whatever is ALREADY queued batches even at window 0."""
+    if window_s is None:
+        window_s = window_seconds()
+    try:
+        first = q.get(timeout=poll_s)
+    except _queue.Empty:
+        return []
+    out = [first]
+    deadline = time.monotonic() + window_s
+    while True:
+        try:
+            out.append(q.get_nowait())
+            continue
+        except _queue.Empty:
+            pass
+        remaining = deadline - time.monotonic()
+        if remaining <= 0.0:
+            return out
+        try:
+            out.append(q.get(timeout=remaining))
+        except _queue.Empty:
+            return out
+
+
+def group(requests: List[SolveRequest],
+          cap: Optional[int] = None) -> List[List[SolveRequest]]:
+    """FIFO-stable grouping by solve key, chunked at the batch cap:
+    the first request of each key anchors its group's position, so a
+    steady stream of one tenant cannot starve another's earlier
+    request."""
+    if cap is None:
+        cap = max_batch()
+    groups: List[List[SolveRequest]] = []
+    index: dict = {}
+    for req in requests:
+        k = solve_key(req)
+        g = index.get(k)
+        if g is None or len(g) >= cap:
+            g = []
+            groups.append(g)
+            index[k] = g
+        g.append(req)
+    return groups

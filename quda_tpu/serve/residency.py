@@ -1,0 +1,219 @@
+"""Multi-gauge residency under a ledger-driven HBM budget.
+
+Reference behavior: interface_quda.cpp keeps ONE resident gauge
+(gaugePrecise et al.) and loadGaugeQuda replaces it; the device_malloc
+ledger (lib/malloc.cpp) is what tells an operator how much HBM those
+residents hold.  A multi-tenant worker serves solves against SEVERAL
+configurations, so this module generalises the single ``_ctx['gauge']``
+slot behind a manager:
+
+* every cached gauge is a row in the obs/memory field ledger's
+  ``gauge`` family — the ACTIVE one under the pre-existing
+  ``resident_gauge`` name (written by ``_set_resident_gauge``, so
+  ``load_gauge_quda``/MILC callers and their ledger semantics are
+  unchanged), each inactive one as ``serve:<gauge_id>``; one row per
+  gauge, never double-counted;
+* the HBM budget check reads the LEDGER's family total (not a private
+  byte count) against ``QUDA_TPU_SERVE_HBM_BUDGET_MB``, and evicts
+  least-recently-used inactive gauges until it fits
+  (``serve_gauge_evictions_total`` + a ``serve_gauge_evicted`` trace
+  event per eviction);
+* activation installs a cached gauge through
+  ``quda_api._install_resident_gauge`` — the same epoch-bumping seam
+  ``load_gauge_quda`` ends in, so the MG staleness guard and every
+  resident-operator cache keyed on ``gauge_epoch`` behave exactly as
+  if the gauge had been loaded fresh.
+
+All methods must run on ONE thread (the service worker): the manager
+mutates the interface context the solves read.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+
+def _budget_bytes(override_mb: Optional[float]) -> int:
+    from ..utils import config as qconf
+    mb = (float(qconf.get("QUDA_TPU_SERVE_HBM_BUDGET_MB", fresh=True))
+          if override_mb is None else float(override_mb))
+    return int(mb * 2 ** 20) if mb > 0 else 0
+
+
+class GaugeResidency:
+    """The residency table: gauge_id -> cached device gauge + the
+    GaugeParam/geometry needed to re-install it as the resident one."""
+
+    def __init__(self, budget_mb: Optional[float] = None):
+        self._budget_mb = budget_mb
+        self._table: Dict[str, dict] = {}
+        self._active: Optional[str] = None
+        self._evictions = 0
+
+    # -- introspection ------------------------------------------------------
+
+    def active(self) -> Optional[str]:
+        return self._active
+
+    def resident_ids(self):
+        return sorted(self._table)
+
+    def budget_bytes(self) -> int:
+        return _budget_bytes(self._budget_mb)
+
+    def gauge_family_bytes(self) -> int:
+        from ..obs import memory as omem
+        return omem.family_bytes().get("gauge", 0)
+
+    def stats(self) -> dict:
+        return {"active": self._active,
+                "resident": self.resident_ids(),
+                "bytes": self.gauge_family_bytes(),
+                "budget_bytes": self.budget_bytes(),
+                "evictions": self._evictions}
+
+    # -- the service-facing operation ---------------------------------------
+
+    def ensure_active(self, gauge_id: str,
+                      loader: Optional[Callable] = None,
+                      version=None) -> str:
+        """Make ``gauge_id`` the active resident gauge; returns how it
+        got there: ``hit`` (already active), ``activated`` (cached,
+        installed without reloading), or ``loaded`` (``loader()``
+        returned ``(host_gauge, GaugeParam)`` and the full
+        ``load_gauge_quda`` path — validation, conversion, screens —
+        ran).  An unknown id with no loader raises KeyError.
+
+        ``version`` is the caller's registration counter for this id:
+        a cached entry recorded under a different version was loaded
+        from data the caller has since replaced — it is dropped and
+        reloaded fresh, never served stale (with status 'converged'
+        against the wrong configuration)."""
+        from ..interfaces import quda_api as api
+        from ..obs import metrics as omet
+        e = self._table.get(gauge_id)
+        if (e is not None and version is not None
+                and e.get("version") != version):
+            if gauge_id == self._active:
+                # the outgoing array stays on the resident_gauge
+                # ledger row until the reload below replaces it
+                self._table.pop(gauge_id)
+                self._active = None
+            else:
+                self.evict(gauge_id, budget_eviction=False)
+        if gauge_id == self._active and gauge_id in self._table:
+            self._table[gauge_id]["last_used"] = time.monotonic()
+            omet.inc("serve_gauge_hits_total", gauge=gauge_id)
+            return "hit"
+        self._stash_active()
+        if gauge_id in self._table:
+            e = self._table[gauge_id]
+            # the cached row becomes THE resident row (one row per
+            # gauge: release serve:<id>, _install re-tracks it as
+            # resident_gauge through _set_resident_gauge)
+            from ..obs import memory as omem
+            omem.release("gauge", f"serve:{gauge_id}")
+            api._install_resident_gauge(e["gauge"], e["param"],
+                                        e["geom"])
+            e["last_used"] = time.monotonic()
+            self._active = gauge_id
+            omet.inc("serve_gauge_activations_total", gauge=gauge_id)
+            self.ensure_budget()
+            return "activated"
+        if loader is None:
+            raise KeyError(
+                f"gauge {gauge_id!r} is not resident and no loader was "
+                "supplied (evicted under the HBM budget? re-register "
+                "it with SolveService.load_gauge)")
+        host_gauge, gparam = loader()
+        api.load_gauge_quda(host_gauge, gparam)
+        g, p, geom = api.resident_gauge_state()
+        self._table[gauge_id] = {"gauge": g, "param": p, "geom": geom,
+                                 "version": version,
+                                 "last_used": time.monotonic()}
+        self._active = gauge_id
+        omet.inc("serve_gauge_activations_total", gauge=gauge_id)
+        self.ensure_budget()
+        return "loaded"
+
+    def _stash_active(self):
+        """Re-label the outgoing active gauge's ledger row as a cached
+        ``serve:<id>`` row (it stays in HBM until evicted)."""
+        if self._active is None or self._active not in self._table:
+            self._active = None
+            return
+        from ..obs import memory as omem
+        e = self._table[self._active]
+        omem.release("gauge", "resident_gauge")
+        omem.track("gauge", f"serve:{self._active}", e["gauge"])
+        self._active = None
+
+    # -- budget enforcement -------------------------------------------------
+
+    def ensure_budget(self) -> int:
+        """Evict LRU inactive gauges until the ledger's gauge family
+        fits the budget; returns the number evicted.  The ACTIVE gauge
+        is never evicted (a batch is about to solve on it) — when it
+        alone exceeds the budget, a one-time warning says so."""
+        budget = self.budget_bytes()
+        if budget <= 0:
+            return 0
+        evicted = 0
+        while self.gauge_family_bytes() > budget:
+            victims = sorted(
+                (gid for gid in self._table if gid != self._active),
+                key=lambda gid: self._table[gid]["last_used"])
+            if not victims:
+                from ..utils import logging as qlog
+                qlog.warn_once(
+                    "serve_budget_active",
+                    f"serve residency: the active gauge alone exceeds "
+                    f"QUDA_TPU_SERVE_HBM_BUDGET_MB "
+                    f"({self.gauge_family_bytes()} B > {budget} B); "
+                    "nothing evictable")
+                break
+            self.evict(victims[0])
+            evicted += 1
+        return evicted
+
+    def evict(self, gauge_id: str, budget_eviction: bool = True) -> bool:
+        """Drop one cached gauge (ledger row released, device array
+        unreferenced for XLA to reclaim); True iff it was resident.
+        ``budget_eviction=False`` (shutdown drop) releases without
+        counting — ``serve_gauge_evictions_total`` means capacity
+        pressure, and a clean stop must not read as churn."""
+        if gauge_id == self._active:
+            raise ValueError(f"refusing to evict the active gauge "
+                             f"{gauge_id!r}")
+        e = self._table.pop(gauge_id, None)
+        if e is None:
+            return False
+        from ..obs import memory as omem
+        omem.release("gauge", f"serve:{gauge_id}")
+        if budget_eviction:
+            from ..obs import metrics as omet
+            from ..obs import trace as otr
+            omet.inc("serve_gauge_evictions_total", gauge=gauge_id)
+            otr.event("serve_gauge_evicted", cat="serve",
+                      gauge=gauge_id,
+                      family_bytes=self.gauge_family_bytes(),
+                      budget_bytes=self.budget_bytes())
+            self._evictions += 1
+        return True
+
+    def drop_all(self):
+        """Release every cached row (service shutdown); the active
+        gauge stays resident in the interface context — stopping the
+        service must not yank the gauge from under a non-service
+        caller."""
+        for gid in list(self._table):
+            if gid == self._active:
+                continue
+            self.evict(gid, budget_eviction=False)
+        if self._active is not None:
+            # forget the table entry but keep the context + its
+            # resident_gauge ledger row exactly as load_gauge_quda
+            # would have left it
+            self._table.pop(self._active, None)
+            self._active = None

@@ -123,38 +123,64 @@ def eigcg(matvec: Callable, b: jnp.ndarray, n_ev: int = 4, m: int = 24,
 
 class IncrementalEigCG:
     """inc-eigCG: accumulate a deflation space over a sequence of solves
-    (lib/deflation.cpp + the EigCGArgs accumulation loop)."""
+    (lib/deflation.cpp + the EigCGArgs accumulation loop).
+
+    Accumulation is a Rayleigh–Ritz (Galerkin) pass on the grown space,
+    mirroring lib/deflation.cpp's projected-matrix increment: new
+    harvested vectors are orthogonalised against the basis (directions
+    already represented are DROPPED, not renormalised into noise), the
+    projected operator V^dag A V is rediagonalised, and the basis is
+    rotated onto its Ritz vectors before truncating to ``max_space``
+    lowest.  The rotation is what makes ``deflated_guess``'s diagonal
+    spectral inverse valid: plain Gram-Schmidt keeps the SPAN but mixes
+    the vectors, so treating (v_i, rayleigh_i) as eigenpairs mis-weights
+    the guess and near-duplicate harvests across solves turn into
+    amplified noise directions — the pre-round-15 accumulation showed
+    zero acceleration because of exactly that.  A·V is carried alongside
+    the basis (it rotates with the same U), so each increment costs only
+    ``n_ev`` fresh matvecs."""
 
     def __init__(self, matvec: Callable, n_ev: int = 4, m: int = 24,
-                 max_space: int = 32):
+                 max_space: int = 32, drop_tol: float = 1e-4):
         self.matvec = matvec
         self.n_ev = n_ev
         self.m = m
         self.max_space = max_space
-        self.evecs = None   # (n, ...)
-        self.evals = None
+        self.drop_tol = drop_tol
+        self.evecs = None   # (n, ...) Ritz vectors of the space
+        self.evals = None   # (n,) Ritz values
+        self._av = None     # A @ evecs, rotated in lockstep
+        # one jitted wrapper for the life of the accumulator: a fresh
+        # jax.jit per solve would retrace the matvec every increment
+        self._mv = jax.jit(matvec)
 
-    def _orthonormalize_space(self, new_vecs, new_vals):
-        if self.evecs is None:
-            basis = new_vecs
-        else:
-            basis = jnp.concatenate([self.evecs, new_vecs], axis=0)
-        # Gram-Schmidt + drop near-dependent vectors
-        kept = []
-        for i in range(basis.shape[0]):
-            v = basis[i]
-            for u in kept:
+    def _accumulate(self, new_vecs):
+        mv = self._mv
+        V = [] if self.evecs is None else list(self.evecs)
+        W = [] if self._av is None else list(self._av)
+        for i in range(new_vecs.shape[0]):
+            v = new_vecs[i]
+            for u in V:
                 v = v - blas.cdot(u, v) * u
             nrm = float(jnp.sqrt(blas.norm2(v)))
-            if nrm > 1e-8:
-                kept.append(v / nrm)
-            if len(kept) >= self.max_space:
-                break
-        self.evecs = jnp.stack(kept)
-        # Rayleigh quotients for the deflation solve
-        mv = jax.jit(self.matvec)
-        self.evals = jnp.asarray([
-            float(blas.cdot(v, mv(v)).real) for v in self.evecs])
+            if nrm <= self.drop_tol:
+                continue        # already represented: adds no direction
+            v = v / nrm
+            V.append(v)
+            W.append(mv(v))
+        Vs, Ws = jnp.stack(V), jnp.stack(W)
+        # Rayleigh–Ritz on the accumulated space: G = V^dag (A V) is
+        # Hermitian up to rounding; rotate onto its eigenbasis and keep
+        # the lowest max_space Ritz pairs (new directions compete with
+        # old ones instead of being frozen out by arrival order)
+        G = np.asarray(jnp.einsum("i...,j...->ij", jnp.conjugate(Vs), Ws))
+        G = 0.5 * (G + G.conj().T)
+        theta, U = np.linalg.eigh(G)
+        k = min(self.max_space, Vs.shape[0])
+        rot = jnp.asarray(U[:, :k], Vs.dtype)
+        self.evecs = jnp.einsum("ij,i...->j...", rot, Vs)
+        self._av = jnp.einsum("ij,i...->j...", rot, Ws)   # A(VU) = (AV)U
+        self.evals = jnp.asarray(theta[:k])
 
     def solve(self, b: jnp.ndarray, tol: float = 1e-10,
               maxiter: int = 2000) -> EigCGResult:
@@ -164,5 +190,8 @@ class IncrementalEigCG:
             x0 = deflated_guess(space, b)
         res = eigcg(self.matvec, b, self.n_ev, self.m, x0=x0, tol=tol,
                     maxiter=maxiter)
-        self._orthonormalize_space(res.evecs, res.evals)
+        # the Rayleigh–Ritz pass derives its own Ritz values from the
+        # projected operator; the per-solve harvested estimates are not
+        # consumed here
+        self._accumulate(res.evecs)
         return res

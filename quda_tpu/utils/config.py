@@ -410,6 +410,43 @@ _register("QUDA_TPU_GAUGE_UNITARITY_TOL", "float", 0.0,
           reference="checkGauge / unitarize_links_quda tolerance "
                     "(include/svd_quda.h)")
 
+# -- solve service (quda_tpu/serve) -----------------------------------------
+_register("QUDA_TPU_SERVE_BATCH_WINDOW_MS", "float", 2.0,
+          "solve-service coalescing window (milliseconds): after the "
+          "first queued request is picked up, the worker keeps "
+          "draining the queue for this long so requests targeting the "
+          "same resident gauge coalesce into one MRHS batch "
+          "(invert_multi_src_quda).  0 disables waiting — whatever is "
+          "already queued still batches",
+          reference="invertMultiSrcQuda batching "
+                    "(lib/interface_quda.cpp:3064) + PLQCD queue-drain "
+                    "overlap (arXiv:1405.0700)")
+_register("QUDA_TPU_SERVE_MAX_BATCH", "int", 8,
+          "cap on requests coalesced into one solve-service MRHS "
+          "batch; also clamped by QUDA_TPU_MAX_MULTI_RHS.  Larger "
+          "batches amortise gauge reads further (PERF.md round-7 "
+          "curve) at the cost of per-request latency",
+          reference="QUDA_MAX_MULTI_RHS")
+_register("QUDA_TPU_SERVE_HBM_BUDGET_MB", "float", 0.0,
+          "HBM budget (MB) for the solve-service gauge residency "
+          "manager: when the obs/memory ledger's 'gauge' family "
+          "exceeds it, least-recently-used non-active gauges are "
+          "evicted (serve_gauge_evictions_total) until it fits.  "
+          "0 = unlimited (single-tenant behavior)",
+          reference="device_malloc ledger-driven residency "
+                    "(lib/malloc.cpp) for gaugePrecise et al.")
+_register("QUDA_TPU_SERVE_COMPILE_CACHE", "choice", "",
+          "persistent XLA compilation cache for solve-service workers: "
+          "'1' force, '0' off, empty = on when a resource path is "
+          "configured.  Points jax_compilation_cache_dir at "
+          "<QUDA_TPU_RESOURCE_PATH>/jax_compilation_cache so a fresh "
+          "worker process deserialises already-built executables "
+          "instead of recompiling (the compile-storm half of ROADMAP "
+          "item 2; the tunecache warm start is the race-storm half)",
+          ("", "0", "1"),
+          reference="QUDA_RESOURCE_PATH persistent tunecache as the "
+                    "cross-process warm-start surface")
+
 # CUDA-runtime knobs deliberately not carried over: the replacing
 # subsystem answers "where did it go".
 SUBSUMED = {
