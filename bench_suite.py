@@ -1202,6 +1202,164 @@ def main(argv):
             "platform": platform, "lattice": [Lm] * 4,
             "n_vec": 8}, banner_platform=banner)
 
+        # -- round-15 rows ------------------------------------------------
+        # (a) mg_setup_phases: per-phase setup seconds, fast pipeline
+        # (MRHS null block solve + GEMM coarse build) vs the legacy
+        # probe/chunked path behind QUDA_TPU_MG_SETUP=legacy, PLUS a
+        # warm same-shape rebuild (the serve-worker / HMC case where
+        # the opstate jit cache has the programs) — secs units are
+        # TRENDED by --compare, the phase-drop ratios are the claim.
+        from quda_tpu.utils import config as _qmc
+
+        def _phase_sums(m):
+            out = {}
+            for r in m.setup_breakdown:
+                out[r["phase"]] = out.get(r["phase"], 0.0) + r["seconds"]
+            return out
+
+        mg_params = [MGLevelParam(block=(2, 2, 2, 2), n_vec=8,
+                                  setup_iters=150)]
+        # pair_vcycle's pmg above rode the SAME fast pipeline at these
+        # shapes, so the opstate module-level jit cache is already warm
+        # — drop it so the fast column below is a COLD build and the
+        # warm column is the one that demonstrates cache reuse (the
+        # later solve sections re-jit what they need)
+        _jax.clear_caches()
+        with _jax.default_device(cpu_m):
+            with _qmc.overrides(QUDA_TPU_MG_SETUP="legacy"):
+                mg_leg = PairMG(d, geo_m, mg_params)
+            mg_fast = PairMG(d, geo_m, mg_params)
+            U2 = GaugeField.random(_jax.random.PRNGKey(21),
+                                   geo_m).data.astype(jnp.complex64)
+            mg_warm = PairMG(DiracWilson(U2, geo_m, kappa=0.12),
+                             geo_m, mg_params)
+        pls, pfs, pws = (_phase_sums(m) for m in (mg_leg, mg_fast,
+                                                  mg_warm))
+        row = {"name": "mg_setup_phases", "n_vec": 8,
+               "setup_platform": "cpu",
+               "platform": platform, "lattice": [Lm] * 4}
+        for ph in ("null_vectors", "transfer_build", "coarse_probe"):
+            row[f"{ph}_legacy_secs"] = round(pls.get(ph, 0.0), 3)
+            row[f"{ph}_secs"] = round(pfs.get(ph, 0.0), 3)
+            row[f"{ph}_warm_secs"] = round(pws.get(ph, 0.0), 3)
+            row[f"{ph}_drop"] = round(
+                pls.get(ph, 0.0) / max(pfs.get(ph, 1e-9), 1e-9), 2)
+        record_row("mg", row, banner_platform=banner)
+
+        # (b) mg_vs_cg: the serving-solver claim — outer GCR+V-cycle
+        # vs plain CG (CGNR) on the same system, at the suite lattice
+        # (8^4 cpu / 16^4 chip, where the fine level rides the pallas
+        # kernels).  The row name carries the lattice so --compare
+        # trends each volume separately; the 32^3x64 production volume
+        # (ROADMAP item 1's acceptance row) rides the same code when a
+        # chip session raises Lm.
+        from quda_tpu.mg.pair import mg_solve_pairs
+        from quda_tpu.solvers.cg import cg as _cg
+
+        # migrate the (real) fast hierarchy to the timing device, same
+        # discipline as pair_vcycle above
+        _lvf = mg_fast.levels[0]
+        _lvf["transfer"].v = _jax.device_put(_lvf["transfer"].v, dev)
+        _cof = _lvf["coarse"]
+        _cof.x_diag = _jax.device_put(_cof.x_diag, dev)
+        _cof.y = {k: _jax.device_put(vv, dev)
+                  for k, vv in _cof.y.items()}
+
+        b_std = _jax.device_put(_jax.random.normal(
+            _jax.random.PRNGKey(31), geo_m.lattice_shape + (4, 3, 2),
+            jnp.float32), dev)
+        try:
+            if platform == "cpu":
+                _ad = mg_fast.adapter
+                _ad.gauge_pairs = _jax.device_put(_ad.gauge_pairs, dev)
+            else:
+                # the adapter was built under default_device(cpu),
+                # which froze use_pallas=False (the gate follows array
+                # placement): rebuild it WITH pallas state on the host
+                # (the complex gauge pack cannot execute on the axon
+                # runtime), move its f32 arrays on chip, and re-resolve
+                # the coarse apply form now that its links are resident
+                # (the utils.tune race)
+                from quda_tpu.mg.pair import resolve_coarse_form as _rcf
+                with _jax.default_device(cpu_m):
+                    _ad = type(mg_fast.adapter)(d, use_pallas=True)
+                for _attr in ("gauge_pairs", "gauge_pl", "gauge_bw"):
+                    setattr(_ad, _attr,
+                            _jax.device_put(getattr(_ad, _attr), dev))
+                mg_fast.adapter = _ad
+                _lvf["op"] = _ad
+                _lvf["coarse"] = _cof = _rcf(_cof)
+            t0 = time.perf_counter()
+            res_mg, _ = mg_solve_pairs(d, geo_m, b_std, None,
+                                       tol=1e-6, nkrylov=10,
+                                       max_restarts=40, mg=mg_fast)
+            _jax.block_until_ready(res_mg.x)
+            mg_secs = time.perf_counter() - t0
+            a = mg_fast.adapter
+
+            def _mdagm(v):
+                return a.Mdag_std(a.M_std(v))
+
+            t0 = time.perf_counter()
+            res_cg = _cg(_mdagm, a.Mdag_std(b_std), tol=1e-6,
+                         maxiter=4000)
+            _jax.block_until_ready(res_cg.x)
+            cg_secs = time.perf_counter() - t0
+            record_row("mg", {
+                "name": f"mg_vs_cg_{Lm}",
+                "iters": int(res_mg.iters),
+                "converged": bool(res_mg.converged),
+                "secs": round(mg_secs, 3),
+                "cg_iters": int(res_cg.iters),
+                "cg_converged": bool(res_cg.converged),
+                "cg_secs": round(cg_secs, 3),
+                "speedup_vs_cg": round(cg_secs / max(mg_secs, 1e-9), 2),
+                "platform": platform, "lattice": [Lm] * 4},
+                banner_platform=banner)
+        except Exception as e:
+            print(json.dumps({"suite": "mg", "name": "mg_vs_cg",
+                              "error": str(e)[:140]}), flush=True)
+
+        # (c) coarse-kernel roofline: the fused pallas coarse stencil
+        # vs the einsum form on the level-0 coarse operator, attributed
+        # through the nc-parametric traffic model (KERNEL_MODELS
+        # 'mg_coarse_pallas' anchors the drift lint at the canonical
+        # probe size)
+        try:
+            from quda_tpu.ops.coarse_pallas import coarse_model
+            co_f = mg_fast.levels[0]["coarse"]
+            co_e = _dc.replace(co_f, use_embedding=False,
+                               use_pallas=False)
+            co_p = _dc.replace(co_f, use_pallas=True,
+                               pallas_interpret=(platform == "cpu"))
+            vcc = _jax.device_put(_jax.random.normal(
+                _jax.random.PRNGKey(41),
+                co_f.x_diag.shape[:4] + (2, co_f.n_vec, 2),
+                jnp.float32), dev)
+            secs_ein = time_avg(_jax.jit(co_e.M), vcc, n=10)
+            mdl = coarse_model(co_f.nc)        # Nc = 2*n_vec
+            sites = int(np.prod(co_f.x_diag.shape[:4]))
+            if platform != "cpu":
+                secs_pal = time_avg(_jax.jit(co_p.M), vcc, n=10)
+                _emit("mg", "mg_coarse_pallas_apply", secs_pal,
+                      mdl["flops_per_site"] * sites,
+                      mdl["bytes_per_site"] * sites, platform,
+                      co_f.x_diag.shape[:4], banner=banner,
+                      form="mg_coarse_pallas", nc=co_f.nc,
+                      einsum_secs=round(secs_ein, 6))
+            else:
+                # interpret-mode timing is meaningless — record the
+                # einsum-form roofline so the row trends on CPU too
+                _emit("mg", "mg_coarse_einsum_apply", secs_ein,
+                      mdl["flops_per_site"] * sites,
+                      mdl["bytes_per_site"] * sites, platform,
+                      co_f.x_diag.shape[:4], banner=banner,
+                      nc=co_f.nc)
+        except Exception as e:
+            print(json.dumps({"suite": "mg",
+                              "name": "mg_coarse_pallas_apply",
+                              "error": str(e)[:140]}), flush=True)
+
     if "costmodel" in suites and suite_guard("costmodel"):
         # KERNEL_MODELS drift check (obs/costmodel.py): analytic
         # flops/bytes vs the XLA reference-stencil count and the

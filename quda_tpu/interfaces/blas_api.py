@@ -20,9 +20,12 @@ LU-inverse backends (cuBLAS or Eigen).  Semantics implemented here:
   D/Z have no TPU hardware path and run on the host via numpy —
   same split the reference makes between native and generic backends.
 
-The in-framework compute path never uses these (solvers/MG call
-`jnp.einsum`/`jnp.linalg` directly under jit); they exist for API parity
-with host applications that call QUDA as a BLAS utility.
+The flat-array entry points exist for API parity with host applications
+that call QUDA as a BLAS utility; :func:`gemm_batched` is their traced
+in-framework sibling — a jit-safe strided-batched GEMM on device arrays
+(no host roundtrip, no flat addressing) that the MG coarse-stencil
+construction (mg/gemm.py) contracts through, the way the reference's
+calculateY leans on the cuBLAS strided-batch backend.
 """
 
 from __future__ import annotations
@@ -102,6 +105,37 @@ class BLASParam:
     def describe(self) -> str:
         return "\n".join(f"{f.name} = {getattr(self, f.name)}"
                          for f in dataclasses.fields(self))
+
+
+def _op_traced(mats: jnp.ndarray, trans: str) -> jnp.ndarray:
+    """op(X) on a (..., r, c) device array, trans in {n, t, c}."""
+    _check(trans in ("n", "t", "c"), f"bad trans {trans!r}")
+    if trans == "n":
+        return mats
+    out = jnp.swapaxes(mats, -1, -2)
+    return jnp.conjugate(out) if trans == "c" else out
+
+
+def gemm_batched(a: jnp.ndarray, b: jnp.ndarray, trans_a: str = "n",
+                 trans_b: str = "n", alpha=1.0, c: jnp.ndarray = None,
+                 beta=0.0) -> jnp.ndarray:
+    """Traced strided-batched GEMM: alpha op(A) op(B) [+ beta C] over
+    arbitrary leading batch axes — the in-framework (jit-safe, no host
+    roundtrip) sibling of :func:`blas_gemm_quda`, dispatching to XLA's
+    batched dot (the MXU-native path the flat entry point reshapes
+    into).  ``op`` is applied to the STORED arrays (the flat API's
+    convention): op(A) must come out (..., m, k) and op(B) (..., k, n)
+    — i.e. pass A stored as (..., k, m) when trans_a is 't'/'c'.
+    Leading axes broadcast.  Used by the MG coarse-link construction
+    (mg/gemm.py) so the Galerkin contraction is one batched GEMM per
+    hop direction instead of a per-column probe loop."""
+    out = jnp.matmul(_op_traced(a, trans_a), _op_traced(b, trans_b),
+                     preferred_element_type=None)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    if c is not None and beta != 0.0:
+        out = out + jnp.asarray(beta, out.dtype) * c
+    return out
 
 
 def _stored_dims(rows_op, cols_op, trans):

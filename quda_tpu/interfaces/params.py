@@ -187,6 +187,10 @@ class MultigridParamAPI:
     geo_block_size: Sequence[Tuple[int, int, int, int]] = ((2, 2, 2, 2),)
     n_vec: Sequence[int] = (8,)
     setup_iters: Sequence[int] = (150,)
+    # null-vector solve tolerance per level (QudaMultigridParam::
+    # setup_tol): the MRHS setup solve stops at |r| <= tol*|b| with
+    # setup_iters as the cap; ignored by QUDA_TPU_MG_SETUP=legacy
+    setup_tol: Sequence[float] = (5e-6,)
     nu_pre: Sequence[int] = (0,)
     nu_post: Sequence[int] = (4,)
     smoother_omega: float = 0.85

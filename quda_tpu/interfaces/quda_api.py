@@ -336,6 +336,33 @@ def resident_gauge_state():
     return _ctx["gauge"], _ctx["gauge_param"], _ctx["geom"]
 
 
+def resident_mg_state():
+    """The resident MG hierarchy, or None when there is none or it was
+    built for a gauge other than the resident one (stale hierarchies
+    are never handed to the residency manager — they would be restored
+    as 'valid' later).  The serve layer stashes this next to its cached
+    gauge so a multi-tenant worker keeps one warm hierarchy PER gauge
+    instead of rebuilding on every activation."""
+    mg = _ctx.get("mg")
+    if mg is None or _ctx.get("mg_epoch") != _ctx.get("gauge_epoch"):
+        return None
+    return mg
+
+
+def _install_resident_mg(mg):
+    """Adopt a hierarchy known to match the CURRENTLY resident gauge
+    (the residency manager's table pairs them): epoch pinned to the
+    live gauge epoch + ledger re-track — the MG sibling of
+    ``_install_resident_gauge``.  ``mg=None`` clears the slot (the
+    ledger row is the caller's to move)."""
+    _ctx["mg"] = mg
+    if mg is None:
+        return
+    _ctx["mg_epoch"] = _ctx["gauge_epoch"]
+    from ..obs import memory as omem
+    omem.track("mg", "hierarchy", mg)
+
+
 def free_gauge_quda():
     _ctx["gauge"] = None
     from ..obs import memory as omem
@@ -1718,6 +1745,8 @@ def _mg_level_params(mp: "MultigridParamAPI"):
                          n_vec=mp.n_vec[i],
                          setup_iters=mp.setup_iters[i]
                          if i < len(mp.setup_iters) else 150,
+                         setup_tol=mp.setup_tol[i]
+                         if i < len(mp.setup_tol) else 5e-6,
                          pre_smooth=mp.nu_pre[i] if i < len(mp.nu_pre)
                          else 0,
                          post_smooth=mp.nu_post[i] if i < len(mp.nu_post)

@@ -31,7 +31,86 @@ from ..ops import blas
 from ..solvers.cg import cg_fixed_iters
 from ..solvers.gcr import gcr, gcr_fixed, mr_fixed
 from .coarse import CoarseOperator, build_coarse
+from .gemm import build_coarse_gemm
 from .transfer import Transfer, from_chiral, to_chiral
+
+
+def parity_eps(lat, trailing):
+    """Site-parity mask ``(t+z+y+x) % 2`` with ``trailing`` broadcast
+    axes appended — the staggered chiral embedding's epsilon, built in
+    ONE place so the level-op constructors (here and mg/pair.py) and
+    the opstate restore cannot drift."""
+    import numpy as np
+    T, Z, Y, X = lat
+    t = np.arange(T)[:, None, None, None]
+    z = np.arange(Z)[None, :, None, None]
+    y = np.arange(Y)[None, None, :, None]
+    x = np.arange(X)[None, None, None, :]
+    return ((t + z + y + x) % 2).reshape((T, Z, Y, X) + (1,) * trailing)
+
+
+def _legacy_setup() -> bool:
+    """QUDA_TPU_MG_SETUP=legacy selects the pre-round-15 pipeline
+    (chunked-vmap fixed-iteration null solves + masked probe loop) —
+    kept for the A/B the mg_setup_phase_seconds_total counters own."""
+    from ..utils import config as qconf
+    return str(qconf.get("QUDA_TPU_MG_SETUP", fresh=True)) == "legacy"
+
+
+def _normalized_batch(xs):
+    from ..ops import blas as _blas
+    norms = jax.vmap(_blas.norm2)(xs)
+    scale = (1.0 / jnp.sqrt(norms)).astype(xs.dtype)
+    return xs * scale.reshape(scale.shape + (1,) * (xs.ndim - 1))
+
+
+import functools as _functools
+
+
+def _pick_null_mv(op, use_cg):
+    """The level's batched matvec for the null-vector block solve:
+    the MRHS stencil when the operator exposes one (link tiles fetched
+    once for all lanes), a vmap of the single-RHS form otherwise."""
+    if use_cg:
+        return getattr(op, "MdagM_mrhs", None) or \
+            (lambda V: jax.vmap(op.MdagM)(V))
+    return getattr(op, "M_mrhs", None) or \
+        (lambda V: jax.vmap(op.M)(V))
+
+
+def _null_solve_body(mv, bb, tol, maxiter, use_cg, cplx):
+    """Tolerance-stopped block solve + normalisation shared by the
+    cached (opstate) and closure-jit null-vector routes: ``mv`` is the
+    batched matvec in the operator's native dtype (MdagM for cg, M for
+    bicgstab); complex systems realify around BiCGStab (its scalar
+    lanes are real — the pair-route embedding)."""
+    from ..solvers.block import batched_bicgstab_pairs, batched_cg_pairs
+    if use_cg:
+        return _normalized_batch(
+            batched_cg_pairs(mv, bb, tol=tol, maxiter=maxiter).x)
+    if cplx:
+        def mvp(Vp):
+            out = mv(Vp[..., 0] + 1j * Vp[..., 1])
+            return jnp.stack([jnp.real(out), jnp.imag(out)], -1)
+        bp = jnp.stack([jnp.real(bb), jnp.imag(bb)], -1)
+    else:
+        mvp, bp = mv, bb
+    xs = batched_bicgstab_pairs(mvp, bp, tol=tol, maxiter=maxiter).x
+    if cplx:
+        xs = (xs[..., 0] + 1j * xs[..., 1]).astype(bb.dtype)
+    return _normalized_batch(xs)
+
+
+@_functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+def _null_solve_cached(restore, spec, tol, maxiter, use_cg, cplx,
+                       arrays, bb):
+    """Module-level cached null-vector block solve (see mg/opstate.py:
+    arrays as arguments -> constant-free compiles + jit-cache hits on
+    every same-shaped rebuild).  Returns the normalised solution
+    batch."""
+    op = restore(spec, arrays)
+    return _null_solve_body(_pick_null_mv(op, use_cg), bb, tol,
+                            maxiter, use_cg, cplx)
 
 
 @dataclasses.dataclass
@@ -39,7 +118,18 @@ class MGLevelParam:
     """Per-level knobs (QudaMultigridParam analog)."""
     block: Tuple[int, int, int, int] = (2, 2, 2, 2)
     n_vec: int = 8
-    setup_iters: int = 150          # inverse-iteration count per null vector
+    setup_iters: int = 150          # inverse-iteration cap per null vector
+    # null-vector solve tolerance (QudaMultigridParam::setup_tol): the
+    # fast MRHS setup stops a lane once |r| <= setup_tol * |b| instead
+    # of burning the full fixed iteration count — the legacy pipeline
+    # has no convergence test and always runs setup_iters
+    setup_tol: float = 5e-6
+    # fast-setup null-vector solver (QudaMultigridParam::
+    # setup_inv_type): 'bicgstab' = batched BiCGStab on the DIRECT
+    # system M v = r (the reference's generateNullVectors discipline;
+    # ~3-5x fewer dslash than the normal equations near kappa
+    # critical), 'cg' = tolerance-stopped inverse iteration on MdagM
+    setup_solver: str = "bicgstab"
     pre_smooth: int = 0             # QUDA default: no pre-smoothing
     post_smooth: int = 4
     smoother: str = "mr"            # "mr" | "ca-gcr" (QUDA smoother types)
@@ -108,18 +198,10 @@ class _StaggeredLevelOp:
     k_fine = 3
 
     def __init__(self, dirac, kd: bool = False):
-        from functools import lru_cache
-
-        import numpy as np
         self.dirac = dirac
         self.geom = dirac.geom
         self.dtype = dirac.fat.dtype
-        T, Z, Y, X = self.geom.lattice_shape
-        t = np.arange(T)[:, None, None, None]
-        z = np.arange(Z)[None, :, None, None]
-        y = np.arange(Y)[None, None, :, None]
-        x = np.arange(X)[None, None, None, :]
-        self._eps = ((t + z + y + x) % 2)[..., None, None]  # (lat,1,1)
+        self._eps = parity_eps(self.geom.lattice_shape, 2)  # (lat,1,1)
         self.kd = kd
         if kd:
             from .staggered_kd import build_kd_xinv
@@ -181,6 +263,13 @@ class _StaggeredLevelOp:
             s = self._xinv_std(s)
         return self.to_chiral(self.dirac.hop(s, mu, sign))
 
+    def project_null_source(self, bs):
+        """Project random chiral sources onto the parity-masked
+        subspace the staggered chiral embedding actually spans (see
+        MG._generate_null_vectors — tolerance-stopped setup solves
+        need a consistent system)."""
+        return self.to_chiral(self.from_chiral(bs))
+
 
 def _make_fine_adapter(dirac, kd: bool = False):
     if getattr(dirac, "nspin", 4) == 1:
@@ -198,7 +287,8 @@ class MG:
     for TPU runtimes without complex execution."""
 
     _transfer_from_nulls = staticmethod(Transfer.from_null_vectors)
-    _build_coarse = staticmethod(build_coarse)
+    _build_coarse = staticmethod(build_coarse)           # legacy probe
+    _build_coarse_gemm = staticmethod(build_coarse_gemm)  # fast default
 
     def __init__(self, fine_dirac, geom, params: Sequence[MGLevelParam],
                  key=None, verbosity: int = 0, kd: bool = False):
@@ -231,32 +321,113 @@ class MG:
         return (re + 1j * im).astype(example.dtype)
 
     # -- setup ---------------------------------------------------------
-    def _generate_null_vectors(self, op_M, op_MdagM, example, n_vec, iters,
-                               key):
-        """Inverse iteration: v = (MdagM)^{-1}-ish random, normalised.
-        All n_vec solves run as ONE vmapped fixed-iteration CG (a single
-        compiled computation — the setup-dominant cost of MG::reset)."""
+    def _generate_null_vectors(self, level_op, example, n_vec, p, key):
+        """Near-null vectors for one level, normalised.
+
+        Fast path (default): ONE MRHS block solve of M v = r over all
+        n_vec random sources at once — QUDA's generateNullVectors
+        discipline (lib/multigrid.cpp:1249: the setup solver runs on
+        the DIRECT system at setup_tol), through
+        ``solvers/block.batched_bicgstab_pairs`` (per-RHS scalar
+        lanes, two batched matvecs per iteration).  On kappa-critical
+        Wilson drills the direct solve needs ~3-5x fewer dslash
+        applications than CG on the squared-condition normal
+        equations, and the batch runs the level's MRHS stencil
+        (``M_mrhs`` — the MRHS pallas kernel on fine Wilson/staggered
+        levels, one link fetch amortised over all n_vec).  Complex
+        levels realify into pair arrays around the batched solve
+        (real-coefficient Krylov on the realified operator — the
+        standard pair-route embedding).  ``p.setup_solver='cg'``
+        selects tolerance-stopped inverse iteration on MdagM instead
+        (``batched_cg_pairs``, complex-safe lanes).
+        QUDA_TPU_MG_NULL_CHUNK caps the batch width (HBM valve).
+
+        Legacy path (QUDA_TPU_MG_SETUP=legacy): the pre-round-15
+        chunked-vmap fixed-iteration CG on MdagM — no convergence
+        test, always ``setup_iters`` iterations per vector — kept for
+        the A/B the phase counters arbitrate."""
+        from ..utils import config as qconf
         bs = jnp.stack([
             self._random_like(example, jax.random.fold_in(key, i))
             for i in range(n_vec)])
+        chunk = int(qconf.get("QUDA_TPU_MG_NULL_CHUNK", fresh=True))
+        iters = p.setup_iters
+        proj = getattr(level_op, "project_null_source", None)
+        if proj is not None and not _legacy_setup():
+            # staggered chiral layouts embed the site fields in a
+            # larger space (parity-masked components): a raw random
+            # chiral source has a component outside the operator's
+            # range, which a TOLERANCE-stopped solve can never
+            # converge away (the fixed-iteration legacy never
+            # noticed).  Projecting onto the valid subspace makes the
+            # system consistent without changing the Krylov span.
+            bs = proj(bs)
 
-        # chunked vmap: all solves in one compiled computation per chunk,
-        # but peak memory capped at ~chunk Krylov states (a full-width
-        # vmap holds n_vec concurrent (x, r, p, Ap) sets — an OOM risk
-        # on fine lattices where the sequential loop fit)
-        chunk = min(n_vec, 4)
+        if _legacy_setup():
+            # chunked vmap: all solves in one compiled computation per
+            # chunk, peak memory capped at ~chunk Krylov states
+            # (historical hard-coded width: min(n_vec, 4))
+            op_MdagM = level_op.MdagM
+            chunk = chunk if chunk > 0 else min(n_vec, 4)
 
-        @jax.jit
-        def solve_chunk(bb):
-            xs = jax.vmap(
-                lambda b: cg_fixed_iters(op_MdagM, b, None, iters)[0].x)(bb)
-            norms = jax.vmap(blas.norm2)(xs)
-            scale = (1.0 / jnp.sqrt(norms)).astype(xs.dtype)
-            return xs * scale.reshape(scale.shape + (1,) * (xs.ndim - 1))
+            @jax.jit
+            def solve_chunk(bb):
+                return _normalized_batch(jax.vmap(
+                    lambda b: cg_fixed_iters(op_MdagM, b, None,
+                                             iters)[0].x)(bb))
 
-        outs = [solve_chunk(bs[i:i + chunk])
-                for i in range(0, n_vec, chunk)]
-        return jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+            outs = [solve_chunk(bs[i:i + chunk])
+                    for i in range(0, n_vec, chunk)]
+            return jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+
+        chunk = n_vec if chunk <= 0 else min(chunk, n_vec)
+        use_cg = getattr(p, "setup_solver", "bicgstab") == "cg"
+        cplx = bool(jnp.iscomplexobj(bs))
+
+        from .opstate import op_state
+        st = op_state(level_op)
+
+        def run_solve(cg_flag):
+            """Chunked block solve with the (cg?, chunk)-shaped program
+            picked per call: the cached constant-free route when the
+            level op exposes its opstate (rebuilds of same-shaped
+            hierarchies skip tracing AND compiling), a closure jit
+            otherwise."""
+            if st is not None:
+                restore, spec, arrays = st
+
+                def solve_block(bb):
+                    return _null_solve_cached(restore, spec,
+                                              float(p.setup_tol),
+                                              int(iters), cg_flag, cplx,
+                                              arrays, bb)
+            else:
+                mvb = _pick_null_mv(level_op, cg_flag)
+
+                @jax.jit
+                def solve_block(bb):
+                    return _null_solve_body(mvb, bb, float(p.setup_tol),
+                                            int(iters), cg_flag, cplx)
+            outs = [solve_block(bs[i:i + chunk])
+                    for i in range(0, n_vec, chunk)]
+            return jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+
+        nulls = run_solve(use_cg)
+        if not use_cg and not bool(jnp.all(jnp.isfinite(nulls))):
+            # BiCGStab breakdown (r0-orthogonality collapse near
+            # kappa critical): a non-finite lane halts the whole
+            # batch, and baking it into the transfer would hand every
+            # later gcr_mg solve a garbage hierarchy with nothing
+            # pointing at setup.  Fall back to tolerance-stopped CG on
+            # the SPD normal equations, which cannot break down.
+            from ..utils import logging as qlog
+            qlog.warn_once(
+                "mg_null_bicgstab_breakdown",
+                "MG setup: BiCGStab null-vector solve broke down "
+                "(non-finite lanes); falling back to CG on the normal "
+                "equations for this level")
+            nulls = run_solve(True)
+        return nulls
 
     @staticmethod
     def _await_phase(obj):
@@ -345,19 +516,22 @@ class MG:
                 dtype = (level_op.dtype if hasattr(level_op, "dtype")
                          else level_op.x_diag.dtype)
                 example = self._example_field(lat_shape, k_fine, dtype)
-                MdagM = level_op.MdagM
                 parts = level_op           # all adapters expose diag/hop
+                legacy = _legacy_setup()
                 with self._phase(li, "null_vectors"):
                     nulls = self._await_phase(
                         self._generate_null_vectors(
-                            level_op.M, MdagM, example, p.n_vec,
-                            p.setup_iters, jax.random.fold_in(key, li)))
+                            level_op, example, p.n_vec, p,
+                            jax.random.fold_in(key, li)))
                 with self._phase(li, "transfer_build"):
                     transfer = self._await_phase(
                         self._transfer_from_nulls(nulls, p.block))
                 with self._phase(li, "coarse_probe"):
-                    coarse = self._await_phase(
-                        self._build_coarse(parts, transfer))
+                    # phase name kept across pipelines: the counters'
+                    # time series IS the A/B record
+                    builder = (self._build_coarse if legacy
+                               else self._build_coarse_gemm)
+                    coarse = self._await_phase(builder(parts, transfer))
                 self.levels.append(dict(op=level_op, transfer=transfer,
                                         coarse=coarse, param=p))
                 if verbosity:

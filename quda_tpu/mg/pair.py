@@ -45,7 +45,7 @@ from ..ops.pair import (color_mul_pairs, dagger_pairs,
                         to_pairs)
 from ..ops.shift import shift
 from .coarse import DIRS
-from .mg import MG, MGLevelParam
+from .mg import MG, MGLevelParam, parity_eps
 
 F32 = jnp.float32
 
@@ -195,6 +195,12 @@ class PairCoarseOperator:
     g5_hermitian: bool = True
     use_embedding: bool = False
     identity_diag: bool = False              # Yhat form (yhat_links)
+    # fused single-pass coarse stencil (ops/coarse_pallas.py): diag +
+    # all 8 hops in one kernel launch over the embedded links — raced
+    # against the einsum/embedding forms via QUDA_TPU_MG_COARSE_FORM
+    # (resolve_coarse_form); interpret only drives off-chip tests
+    use_pallas: bool = False
+    pallas_interpret: bool = False
 
     @property
     def nc(self):
@@ -233,7 +239,39 @@ class PairCoarseOperator:
         nbr = jnp.roll(f, -sign, axis=axis_of_mu(mu))
         return self._unflat(self._apply((mu, sign), nbr))
 
+    def _pl_links(self):
+        """(9, S, E, E) embedded link stack [diag, *DIRS] for the fused
+        pallas apply (built lazily, cached like the embeddings).  The
+        per-direction embeddings are interleaved directly — NOT via
+        ``_emb`` — so the pallas form holds one resident stack, not the
+        stack plus 9 dead per-key copies the apply path never reads."""
+        cache = self.__dict__.setdefault("_emb_cache", {})
+        if "_pl_links" not in cache:
+            mats = [_interleave(self.x_diag)] + \
+                [_interleave(self.y[d]) for d in DIRS]
+            e = 2 * self.nc
+            cache["_pl_links"] = jnp.stack(mats).reshape(9, -1, e, e)
+        return cache["_pl_links"]
+
+    def _pallas_apply(self, v):
+        """Fused single-pass coarse M (ops/coarse_pallas.py): the input
+        and its 8 pre-rolled neighbour copies stream once through the
+        kernel against the resident embedded link stack."""
+        from ..ops.coarse_pallas import coarse_apply_pallas
+        f = self._flat(v)
+        latc = f.shape[:4]
+        e = 2 * self.nc
+        fi = f.reshape(latc + (e,))            # interleaved (re0,im0,..)
+        rolls = [fi] + [jnp.roll(fi, -sign, axis_of_mu(mu))
+                        for mu, sign in DIRS]
+        psi9 = jnp.stack(rolls).reshape(9, -1, e)
+        out = coarse_apply_pallas(self._pl_links(), psi9,
+                                  interpret=self.pallas_interpret)
+        return self._unflat(out.reshape(latc + (self.nc, 2)))
+
     def M(self, v):
+        if self.use_pallas and not self.identity_diag:
+            return self._pallas_apply(v)
         out = self.diag(v)
         for mu, sign in DIRS:
             out = out + self.hop(v, mu, sign)
@@ -253,10 +291,10 @@ class PairCoarseOperator:
 
     @classmethod
     def from_complex(cls, coarse) -> "PairCoarseOperator":
-        return cls(to_pairs(coarse.x_diag, F32),
-                   {d: to_pairs(coarse.y[d], F32) for d in DIRS},
-                   coarse.n_vec, coarse.g5_hermitian,
-                   use_embedding=_embed_default())
+        return resolve_coarse_form(cls(
+            to_pairs(coarse.x_diag, F32),
+            {d: to_pairs(coarse.y[d], F32) for d in DIRS},
+            coarse.n_vec, coarse.g5_hermitian))
 
 
 def yhat_links(coarse: PairCoarseOperator,
@@ -289,6 +327,63 @@ def _embed_default() -> bool:
     embedding matmuls (MXU-shaped) instead of 4-einsum pair products."""
     from ..utils import config as qconf
     return str(qconf.get("QUDA_TPU_MG_EMBED", fresh=True)) == "1"
+
+
+def _arr_on_tpu(x) -> bool:
+    """Whether the array actually LIVES on TPU devices — the pallas
+    gates must follow placement, not the global backend: a hierarchy
+    built under ``jax.default_device(cpu)`` on a chip host (the bench
+    suite's setup discipline) holds CPU arrays, and a non-interpret
+    pallas call on them would fail to lower."""
+    import jax as _jax
+    try:
+        devs = x.devices() if callable(getattr(x, "devices", None)) \
+            else None
+        if devs:
+            return all(d.platform == "tpu" for d in devs)
+    except Exception:
+        pass
+    return _jax.default_backend() == "tpu"
+
+
+def resolve_coarse_form(op: PairCoarseOperator) -> PairCoarseOperator:
+    """Pick the coarse-apply form per QUDA_TPU_MG_COARSE_FORM: an
+    explicit pin is honored (pallas runs interpret off-chip — test
+    territory), 'auto' races einsum vs embedding vs the fused pallas
+    kernel via utils.tune on chip (cached per (coarse shape, Nc) like
+    every other kernel race) and falls back to the static
+    QUDA_TPU_MG_EMBED default off-chip, where interpret-mode timings
+    would be meaningless."""
+    from ..utils import config as qconf
+    form = str(qconf.get("QUDA_TPU_MG_COARSE_FORM", fresh=True)) \
+        or "auto"
+    on_tpu = _arr_on_tpu(op.x_diag)
+    if form == "einsum":
+        return dataclasses.replace(op, use_embedding=False,
+                                   use_pallas=False)
+    if form == "embed":
+        return dataclasses.replace(op, use_embedding=True,
+                                   use_pallas=False)
+    if form == "pallas":
+        return dataclasses.replace(op, use_pallas=True,
+                                   pallas_interpret=not on_tpu)
+    if not on_tpu:
+        return dataclasses.replace(op, use_embedding=_embed_default(),
+                                   use_pallas=False)
+    from ..utils import tune
+    latc = tuple(int(s) for s in op.x_diag.shape[:4])
+    probe = jax.random.normal(jax.random.PRNGKey(7),
+                              latc + (2, op.n_vec, 2), F32)
+    cands = {
+        "einsum": jax.jit(dataclasses.replace(
+            op, use_embedding=False, use_pallas=False).M),
+        "embed": jax.jit(dataclasses.replace(
+            op, use_embedding=True, use_pallas=False).M),
+        "pallas": jax.jit(dataclasses.replace(op, use_pallas=True).M),
+    }
+    win = tune.tune("mg_coarse_form", latc + (op.nc,), cands, (probe,))
+    return dataclasses.replace(
+        op, use_embedding=(win == "embed"), use_pallas=(win == "pallas"))
 
 
 def build_coarse_pairs(fine_parts, transfer: PairTransfer,
@@ -384,6 +479,17 @@ def wilson_hop_pairs(gauge_pairs, psi, mu, sign, kappa):
     return -kappa * spin_mul_pairs(proj, h)
 
 
+def _fine_pallas_default(arr) -> bool:
+    """Fine-level MG operators ride the pallas kernels when their
+    arrays live on chip unless QUDA_TPU_PALLAS forbids them — the same
+    gate as the API solvers (placement-checked via ``arr``), so the
+    gcr_mg outer solve's smoother/residual applies run on the kernel
+    form the fused-iteration solver proved out."""
+    from ..utils import config as qconf
+    return (_arr_on_tpu(arr)
+            and str(qconf.get("QUDA_TPU_PALLAS", fresh=True)) != "0")
+
+
 class PairWilsonLevelOp:
     """Fine-level adapter for Wilson on pair arrays: the realified
     mg/mg._LevelOp (K = 6 chiral components, gamma5 = chirality sign).
@@ -391,17 +497,37 @@ class PairWilsonLevelOp:
     Standard layout here means canonical pair spinors (T,Z,Y,X,4,3,2);
     the gauge (with t-boundary phases folded in by the wrapped Dirac
     operator) is converted to f32 pairs once at construction.
+
+    On chip the fine dslash rides the v2 pallas kernel with resident
+    packed links + pre-shifted backward copy (one layout transpose per
+    apply, amortised against the 1,152 B/site kernel traffic), so the
+    outer GCR's residuals, the V-cycle smoother, AND the MRHS
+    null-vector block solve (``MdagM_mrhs`` -> the MRHS kernel: gauge
+    tiles fetched once per (t, z-block) for all n_vec) all run the
+    measured-fastest stencil; off-chip the XLA pair stencil serves, as
+    everywhere else.
     """
 
     k_fine = 6
     dtype = F32
 
-    def __init__(self, dirac):
+    def __init__(self, dirac, use_pallas: Optional[bool] = None,
+                 pallas_interpret: bool = False):
         from ..ops.pair import dslash_full_pairs
         self.dirac = dirac
         self.kappa = dirac.kappa
         self.gauge_pairs = to_pairs(dirac.gauge, F32)
         self._dslash = dslash_full_pairs
+        self.use_pallas = (_fine_pallas_default(self.gauge_pairs)
+                           if use_pallas is None else bool(use_pallas))
+        self._interp = bool(pallas_interpret)
+        if self.use_pallas:
+            from ..ops import wilson_packed as wpk
+            from ..ops.wilson_pallas_packed import (backward_gauge,
+                                                    to_pallas_layout)
+            self._X = int(dirac.geom.lattice_shape[-1])
+            self.gauge_pl = to_pallas_layout(wpk.pack_gauge(dirac.gauge))
+            self.gauge_bw = backward_gauge(self.gauge_pl, self._X)
 
     def to_chiral(self, v):
         return to_chiral_pairs(v)
@@ -409,15 +535,69 @@ class PairWilsonLevelOp:
     def from_chiral(self, v):
         return from_chiral_pairs(v)
 
+    # -- pallas-layout shuttles ----------------------------------------
+    @staticmethod
+    def _pl_of(v):
+        """canonical pairs (T,Z,Y,X,4,3,2) -> kernel layout
+        (4,3,2,T,Z,YX)."""
+        T, Z, Y, X = v.shape[:4]
+        return jnp.transpose(v, (4, 5, 6, 0, 1, 2, 3)).reshape(
+            4, 3, 2, T, Z, Y * X)
+
+    @staticmethod
+    def _pl_back(out, lat):
+        T, Z, Y, X = lat
+        return jnp.transpose(out.reshape(4, 3, 2, T, Z, Y, X),
+                             (3, 4, 5, 6, 0, 1, 2))
+
     # -- standard (canonical pair) layout ------------------------------
+    def _d_std(self, v):
+        if self.use_pallas:
+            from ..ops.wilson_pallas_packed import dslash_pallas_packed
+            d = dslash_pallas_packed(self.gauge_pl, self._pl_of(v),
+                                     self._X, interpret=self._interp,
+                                     gauge_bw=self.gauge_bw)
+            return self._pl_back(d, v.shape[:4])
+        return self._dslash(self.gauge_pairs, v, out_dtype=F32)
+
     def M_std(self, v):
-        return v - self.kappa * self._dslash(self.gauge_pairs, v,
-                                             out_dtype=F32)
+        return v - self.kappa * self._d_std(v)
 
     def Mdag_std(self, v):
         g5 = jnp.array([1.0, 1.0, -1.0, -1.0], v.dtype)
         sgn = g5[:, None, None]
         return sgn * self.M_std(sgn * v)
+
+    # -- batched MRHS forms (the null-vector block solve's matvec) -----
+    def _d_std_mrhs(self, V):
+        if self.use_pallas:
+            from ..ops.wilson_pallas_packed import \
+                dslash_pallas_packed_mrhs
+            lat = V.shape[1:5]
+            pp = jax.vmap(self._pl_of)(V)
+            d = dslash_pallas_packed_mrhs(self.gauge_pl, pp, self._X,
+                                          interpret=self._interp,
+                                          gauge_bw=self.gauge_bw)
+            return jax.vmap(lambda o: self._pl_back(o, lat))(d)
+        return jax.vmap(lambda v: self._dslash(self.gauge_pairs, v,
+                                               out_dtype=F32))(V)
+
+    def M_mrhs(self, Vc):
+        """(N, lat, 2, 6, 2) chiral batch -> M per RHS through ONE
+        batched stencil — the null-vector block solve's direct-system
+        matvec."""
+        s = from_chiral_pairs(Vc)          # reshape works batched
+        return to_chiral_pairs(s - self.kappa * self._d_std_mrhs(s))
+
+    def MdagM_mrhs(self, Vc):
+        """(N, lat, 2, 6, 2) chiral batch -> MdagM per RHS through ONE
+        batched stencil (the MRHS kernel on chip: link tiles read once
+        per (t, z-block) and all N RHS streamed through them)."""
+        s = from_chiral_pairs(Vc)
+        g5 = jnp.array([1.0, 1.0, -1.0, -1.0], s.dtype)[:, None, None]
+        ms = s - self.kappa * self._d_std_mrhs(s)
+        md = g5 * (g5 * ms - self.kappa * self._d_std_mrhs(g5 * ms))
+        return to_chiral_pairs(md)
 
     # -- chiral layout (the MG hierarchy's view) -----------------------
     def M(self, v):
@@ -451,12 +631,23 @@ class PairStaggeredLevelOp:
     dtype = F32
     nspin = 1
 
-    def __init__(self, dirac):
-        import numpy as np
+    def __init__(self, dirac, use_pallas: Optional[bool] = None,
+                 pallas_interpret: bool = False):
         self.dirac = dirac
         self.geom = dirac.geom
         self.mass = float(dirac.mass)
         self.fat_pairs = to_pairs(dirac.fat, F32)
+        self.use_pallas = (_fine_pallas_default(self.fat_pairs)
+                           if use_pallas is None else bool(use_pallas))
+        self._interp = bool(pallas_interpret)
+        if self.use_pallas:
+            from ..ops.staggered_pallas import backward_links
+            from ..ops.wilson_packed import pack_gauge, to_packed_pairs
+            self._X = int(dirac.geom.lattice_shape[-1])
+            # the hierarchy represents the FAT-ONLY stencil — only the
+            # fat links go resident in kernel layout
+            self.fat_pl = to_packed_pairs(pack_gauge(dirac.fat), F32)
+            self.fat_bw = backward_links(self.fat_pl, self._X, 1)
         # Improved staggered: the HIERARCHY represents the fat-link
         # stencil (the standard preconditioner simplification, matching
         # mg/mg._StaggeredLevelOp and QUDA's coarse construction,
@@ -467,17 +658,64 @@ class PairStaggeredLevelOp:
         self.long_pairs = (to_pairs(dirac.long, F32)
                            if getattr(dirac, "long", None) is not None
                            else None)
-        T, Z, Y, X = self.geom.lattice_shape
-        t = np.arange(T)[:, None, None, None]
-        z = np.arange(Z)[None, :, None, None]
-        y = np.arange(Y)[None, None, :, None]
-        x = np.arange(X)[None, None, None, :]
-        self._eps = ((t + z + y + x) % 2)[..., None, None, None]
+        self._eps = parity_eps(self.geom.lattice_shape, 3)
+
+    # -- pallas-layout shuttles ----------------------------------------
+    @staticmethod
+    def _pl_of(v):
+        """canonical pairs (T,Z,Y,X,1,3,2) -> kernel layout
+        (3,2,T,Z,YX)."""
+        T, Z, Y, X = v.shape[:4]
+        return jnp.transpose(v[..., 0, :, :],
+                             (4, 5, 0, 1, 2, 3)).reshape(
+            3, 2, T, Z, Y * X)
+
+    @staticmethod
+    def _pl_back(out, lat):
+        T, Z, Y, X = lat
+        return jnp.transpose(out.reshape(3, 2, T, Z, Y, X),
+                             (2, 3, 4, 5, 0, 1))[..., None, :, :]
 
     # -- standard (canonical pair, (lat, 1, 3, 2)) layout --------------
     def _d_std(self, v):
+        if self.use_pallas:
+            from ..ops.staggered_pallas import dslash_staggered_pallas
+            d = dslash_staggered_pallas(self.fat_pl, self.fat_bw,
+                                        self._pl_of(v), self._X,
+                                        interpret=self._interp)
+            return self._pl_back(d, v.shape[:4])
         from ..ops import staggered as sops
         return sops.dslash_full(self.fat_pairs, v)
+
+    def _d_std_mrhs(self, V):
+        """(N, lat, 1, 3, 2) batched fat-only D through ONE stencil —
+        the MRHS kernel on chip (link tiles amortised over all N)."""
+        if self.use_pallas:
+            from ..ops.staggered_pallas import \
+                dslash_staggered_pallas_mrhs
+            lat = V.shape[1:5]
+            pp = jax.vmap(self._pl_of)(V)
+            d = dslash_staggered_pallas_mrhs(self.fat_pl, self.fat_bw,
+                                             pp, self._X,
+                                             interpret=self._interp)
+            return jax.vmap(lambda o: self._pl_back(o, lat))(d)
+        from ..ops import staggered as sops
+        return jax.vmap(lambda v: sops.dslash_full(self.fat_pairs,
+                                                   v))(V)
+
+    def M_mrhs(self, Vc):
+        """(N, lat, 2, 3, 2) chiral batch -> M per RHS, one batched
+        stencil (null-vector block solve direct matvec)."""
+        s = self.from_chiral(Vc)
+        return self.to_chiral(2.0 * self.mass * s + self._d_std_mrhs(s))
+
+    def MdagM_mrhs(self, Vc):
+        """(N, lat, 2, 3, 2) chiral batch -> MdagM per RHS, one batched
+        stencil per application (null-vector block solve matvec)."""
+        s = self.from_chiral(Vc)
+        ms = 2.0 * self.mass * s + self._d_std_mrhs(s)
+        md = 2.0 * self.mass * ms - self._d_std_mrhs(ms)
+        return self.to_chiral(md)
 
     def M_std(self, v):
         return 2.0 * self.mass * v + self._d_std(v)
@@ -532,6 +770,11 @@ class PairStaggeredLevelOp:
                                             self.from_chiral(v), mu,
                                             sign))
 
+    def project_null_source(self, bs):
+        """Parity-subspace projection of random chiral sources (the
+        complex adapter's project_null_source, pair layout)."""
+        return self.to_chiral(self.from_chiral(bs))
+
 
 # -- the hierarchy ----------------------------------------------------------
 
@@ -542,7 +785,12 @@ class PairMG(MG):
     orthonormalisation, and real probing — no complex dtype anywhere."""
 
     _transfer_from_nulls = staticmethod(PairTransfer.from_null_vectors)
-    _build_coarse = staticmethod(build_coarse_pairs)
+    _build_coarse = staticmethod(build_coarse_pairs)     # legacy probe
+
+    @staticmethod
+    def _build_coarse_gemm(parts, transfer):
+        from .gemm import build_coarse_pairs_gemm
+        return build_coarse_pairs_gemm(parts, transfer)
 
     def _example_field(self, lat_shape, k, dtype):
         rdt = jnp.zeros((), dtype).real.dtype

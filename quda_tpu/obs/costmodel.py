@@ -148,6 +148,16 @@ def _ref_flops_per_site(family: str) -> float:
         cost = xla_cost(
             lambda f, ln, p: spk.dslash_staggered_packed_pairs(
                 f, p, X, Y, long_pp=ln), f, ln, p)
+    elif family == "mg_coarse":
+        # the MG coarse stencil at the canonical probe size (n_vec=4,
+        # E=16): the XLA form of the identical stacked contraction the
+        # pallas kernel computes (ops/coarse_pallas.coarse_apply_ref)
+        # on a 4^4 COARSE lattice — vol below is coarse sites
+        from ..ops.coarse_pallas import coarse_apply_ref
+        E = 16
+        links = arr((9, vol, E, E))
+        psi9 = arr((9, vol, E))
+        cost = xla_cost(coarse_apply_ref, links, psi9)
     else:
         raise KeyError(f"no reference stencil for family {family!r}")
     fps = float(cost["flops"] or 0.0) / vol
@@ -200,6 +210,13 @@ _FOOTPRINTS: Dict[str, dict] = {
                            "floor": lambda n: 2 * _G / n + 2 * _SPSI},
     "staggered_sharded_fat": {"alias": "staggered_fat"},
     "staggered_sharded_fat_naik": {"alias": "staggered_fat_naik"},
+    # fused MG coarse stencil at the canonical probe size (E=16): the
+    # distinct operands of one invocation are the 9 embedded link
+    # matrices (36*E^2 B/site), the input vector read once (4*E) and
+    # the output (4*E); the model's 9 psi stream reads (pre-rolled
+    # neighbour copies) are re-reads over this floor
+    "mg_coarse_pallas": {"family": "mg_coarse",
+                         "floor": lambda n: 36.0 * 256 + 8 * 16.0},
 }
 
 

@@ -96,6 +96,14 @@ KERNEL_MODELS: Dict[str, dict] = {
                                    "bytes_per_site": 1512},
     # XLA pair stencil: flop model only (same honesty rule as wilson_xla)
     "staggered_xla": {"flops_per_site": 1146, "bytes_per_site": None},
+    # fused MG coarse-stencil kernel (ops/coarse_pallas.py) at the
+    # CANONICAL probe size n_vec=4 (Nc=8, embedding dim E=16): 9 real
+    # ExE matvecs = 18*E^2 flops/site; links once (36*E^2 B) + the
+    # input and its 8 pre-rolled neighbour copies (36*E B) + out (4*E).
+    # Nc-parametric attribution goes through
+    # ops/coarse_pallas.coarse_model(nc) — this row is the drift-lint
+    # anchor (obs/costmodel.py family 'mg_coarse')
+    "mg_coarse_pallas": {"flops_per_site": 4608, "bytes_per_site": 9856},
     # operator-supplied flop count, no traffic model
     "generic": {"flops_per_site": None, "bytes_per_site": None},
 }

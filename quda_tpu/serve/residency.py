@@ -66,10 +66,25 @@ class GaugeResidency:
         from ..obs import memory as omem
         return omem.family_bytes().get("gauge", 0)
 
+    def resident_bytes(self) -> int:
+        """What the residency decisions cost in HBM: the gauge family
+        PLUS the per-gauge MG hierarchies that ride them (ledger family
+        'mg' — stashed `serve:<id>` rows and the active `hierarchy`
+        row, which pairs with the never-evicted active gauge).  The
+        budget check reads this, not the gauge family alone: a cached
+        hierarchy is typically a multiple of its gauge's size."""
+        from ..obs import memory as omem
+        fam = omem.family_bytes()
+        return fam.get("gauge", 0) + fam.get("mg", 0)
+
     def stats(self) -> dict:
         return {"active": self._active,
                 "resident": self.resident_ids(),
                 "bytes": self.gauge_family_bytes(),
+                # what ensure_budget actually compares to budget_bytes
+                # (gauges + per-gauge MG hierarchies) — surfaced so an
+                # eviction is explainable from the stats alone
+                "resident_bytes": self.resident_bytes(),
                 "budget_bytes": self.budget_bytes(),
                 "evictions": self._evictions}
 
@@ -97,9 +112,15 @@ class GaugeResidency:
                 and e.get("version") != version):
             if gauge_id == self._active:
                 # the outgoing array stays on the resident_gauge
-                # ledger row until the reload below replaces it
+                # ledger row until the reload below replaces it; its
+                # hierarchy is retired NOW — the reload bumps the
+                # epoch, so keeping it installed would pin dead arrays
+                # in the ledger (and resident_bytes) forever
                 self._table.pop(gauge_id)
                 self._active = None
+                from ..obs import memory as omem
+                omem.release("mg", "hierarchy")
+                api._install_resident_mg(None)
             else:
                 self.evict(gauge_id, budget_eviction=False)
         if gauge_id == self._active and gauge_id in self._table:
@@ -116,6 +137,20 @@ class GaugeResidency:
             omem.release("gauge", f"serve:{gauge_id}")
             api._install_resident_gauge(e["gauge"], e["param"],
                                         e["geom"])
+            mg = e.get("mg")
+            if mg is not None:
+                # warm per-gauge hierarchy: restore it with its epoch
+                # pinned to the just-bumped gauge epoch (the table
+                # pairs hierarchy and gauge), one ledger row moving
+                # serve:<id> -> hierarchy — the gcr_mg solve then
+                # reuses it instead of re-running setup.  Ownership
+                # moves to the live slot: the table entry is cleared so
+                # the next stash re-captures only a STILL-VALID
+                # hierarchy (if the gauge mutates while active, the
+                # epoch guard retires it and it is never re-stashed)
+                omem.release("mg", f"serve:{gauge_id}")
+                api._install_resident_mg(mg)
+                e["mg"] = None
             e["last_used"] = time.monotonic()
             self._active = gauge_id
             omet.inc("serve_gauge_activations_total", gauge=gauge_id)
@@ -139,28 +174,46 @@ class GaugeResidency:
 
     def _stash_active(self):
         """Re-label the outgoing active gauge's ledger row as a cached
-        ``serve:<id>`` row (it stays in HBM until evicted)."""
+        ``serve:<id>`` row (it stays in HBM until evicted), and stash
+        its MG hierarchy (if one was built and is current) the same
+        way — per-gauge resident hierarchies, one ledger row each."""
         if self._active is None or self._active not in self._table:
             self._active = None
             return
+        from ..interfaces import quda_api as api
         from ..obs import memory as omem
         e = self._table[self._active]
         omem.release("gauge", "resident_gauge")
         omem.track("gauge", f"serve:{self._active}", e["gauge"])
+        mg = api.resident_mg_state()
+        if mg is not None:
+            omem.release("mg", "hierarchy")
+            omem.track("mg", f"serve:{self._active}", mg)
+            e["mg"] = mg
+        else:
+            # no CURRENT hierarchy for the outgoing gauge — a stale
+            # one (gauge mutated while active: epoch guard tripped)
+            # must not linger in the live slot, its ledger row, or the
+            # table, where a later activation would restore it as
+            # valid (the silent wrong-preconditioner case)
+            omem.release("mg", "hierarchy")
+            e["mg"] = None
+        api._install_resident_mg(None)
         self._active = None
 
     # -- budget enforcement -------------------------------------------------
 
     def ensure_budget(self) -> int:
-        """Evict LRU inactive gauges until the ledger's gauge family
-        fits the budget; returns the number evicted.  The ACTIVE gauge
-        is never evicted (a batch is about to solve on it) — when it
-        alone exceeds the budget, a one-time warning says so."""
+        """Evict LRU inactive gauges (each taking its stashed MG
+        hierarchy with it) until gauges + hierarchies fit the budget;
+        returns the number evicted.  The ACTIVE gauge is never evicted
+        (a batch is about to solve on it) — when it alone exceeds the
+        budget, a one-time warning says so."""
         budget = self.budget_bytes()
         if budget <= 0:
             return 0
         evicted = 0
-        while self.gauge_family_bytes() > budget:
+        while self.resident_bytes() > budget:
             victims = sorted(
                 (gid for gid in self._table if gid != self._active),
                 key=lambda gid: self._table[gid]["last_used"])
@@ -168,9 +221,10 @@ class GaugeResidency:
                 from ..utils import logging as qlog
                 qlog.warn_once(
                     "serve_budget_active",
-                    f"serve residency: the active gauge alone exceeds "
+                    f"serve residency: the active gauge (plus its MG "
+                    f"hierarchy, if resident) alone exceeds "
                     f"QUDA_TPU_SERVE_HBM_BUDGET_MB "
-                    f"({self.gauge_family_bytes()} B > {budget} B); "
+                    f"({self.resident_bytes()} B > {budget} B); "
                     "nothing evictable")
                 break
             self.evict(victims[0])
@@ -191,6 +245,11 @@ class GaugeResidency:
             return False
         from ..obs import memory as omem
         omem.release("gauge", f"serve:{gauge_id}")
+        if e.get("mg") is not None:
+            # the hierarchy goes with its gauge: ledger row dropped
+            # here, device arrays unreferenced for XLA to reclaim; a
+            # later reload rebuilds it lazily on the first gcr_mg solve
+            omem.release("mg", f"serve:{gauge_id}")
         if budget_eviction:
             from ..obs import metrics as omet
             from ..obs import trace as otr

@@ -13,8 +13,9 @@ from .ca import ca_cg, ca_gcr  # noqa: F401
 from .multishift import multishift_cg  # noqa: F401
 from .mixed import (cg_reliable, cg_reliable_df, dtype_codec,  # noqa: F401
                     pair_codec, pair_inplace_codec, solve_refined)
-from .block import (batched_cg, batched_cg_pairs, block_cg,  # noqa: F401
-                    block_cg_pairs, BatchedCGResult, BlockCGResult)
+from .block import (batched_bicgstab_pairs, batched_cg,  # noqa: F401
+                    batched_cg_pairs, block_cg, block_cg_pairs,
+                    BatchedCGResult, BlockCGResult)
 from .chrono import ChronoStore, mre_guess  # noqa: F401
 
 _REGISTRY = {

@@ -89,6 +89,52 @@ _register("QUDA_TPU_MG_EMBED", "choice", "",
           "= pair einsums (flip after chip measurement)",
           ("", "0", "1"),
           reference="coarse-dslash MMA path (lib/dslash_coarse.cu)")
+_register("QUDA_TPU_MG_SETUP", "choice", "",
+          "MG setup pipeline: ''/'fast' = MRHS null-vector block solve "
+          "(one tolerance-stopped batched BiCGStab on the direct "
+          "system over all n_vec sources, "
+          "solvers/block.batched_bicgstab_pairs; MGLevelParam."
+          "setup_solver='cg' selects batched_cg_pairs on MdagM) + "
+          "GEMM-built coarse stencil (mg/gemm.py: 9 batched "
+          "contractions instead of the ~34*n_vec-dispatch masked "
+          "probe loop); 'legacy' = the "
+          "pre-round-15 chunked-vmap fixed-iteration CG and probe loop "
+          "(kept for the A/B the mg_setup_phase_seconds_total counters "
+          "arbitrate)",
+          ("", "fast", "legacy"),
+          reference="MG::reset setup pipeline (lib/multigrid.cpp:91, "
+                    "generateNullVectors :1249, calculateY)")
+_register("QUDA_TPU_MG_NULL_CHUNK", "int", 0,
+          "cap on simultaneously-batched null-vector solves in MG "
+          "setup: 0 = one full-width block solve over all n_vec "
+          "sources (the fast-path default; big-HBM chips keep it), "
+          "k > 0 = chunk the batch at width k (a full-width batch "
+          "holds n_vec concurrent (x, r, p, Ap) Krylov states — an "
+          "OOM valve on fine lattices).  The legacy pipeline "
+          "(QUDA_TPU_MG_SETUP=legacy) treats 0 as its historical "
+          "hard-coded min(n_vec, 4)",
+          reference="QUDA_MAX_MULTI_RHS / setup batching "
+                    "(lib/multigrid.cpp generateNullVectors)")
+_register("QUDA_TPU_MG_COARSE_CHUNK", "int", 0,
+          "cap on simultaneously-contracted coarse-stencil columns in "
+          "the GEMM coarse build (mg/gemm.py): 0 = all 2*n_vec null-"
+          "vector columns in one batch (one fine-field batch of 2*n_vec "
+          "resident at once), k > 0 = process k columns per pass — the "
+          "HBM valve for fine lattices where 2*n_vec fine fields "
+          "exceed residency",
+          reference="calculateY batching (lib/coarse_op.in.cu)")
+_register("QUDA_TPU_MG_COARSE_FORM", "choice", "auto",
+          "pair-MG coarse-operator apply form: 'einsum' = 4-einsum "
+          "pair products per link, 'embed' = interleaved-embedding "
+          "matmuls, 'pallas' = the fused single-pass coarse stencil "
+          "kernel (ops/coarse_pallas.py: diag + 8 hops in one launch, "
+          "links read once), 'auto' = race all forms via utils.tune at "
+          "hierarchy construction on chip (static einsum/embed default "
+          "off-chip, honoring QUDA_TPU_MG_EMBED) — A/B'd, not assumed, "
+          "like every other kernel form",
+          ("", "auto", "einsum", "embed", "pallas"),
+          reference="coarse-dslash MMA/policy selection "
+                    "(lib/dslash_coarse.cu + tune.cpp:862)")
 _register("QUDA_TPU_RECONSTRUCT", "choice", "18",
           "gauge link storage for v3 pallas kernels: '18' = full, "
           "'12' = two rows + in-kernel third-row reconstruction "

@@ -57,6 +57,41 @@ def test_checkable_forms_are_the_pallas_models():
     assert "wilson_xla" not in forms and "generic" not in forms
 
 
+def test_mg_coarse_form_is_checkable():
+    """The fused coarse-stencil kernel's row (round 15) is covered by
+    the drift lint like every other pallas traffic model."""
+    assert "mg_coarse_pallas" in ocost.checkable_forms()
+    row = ocost.drift_row("mg_coarse_pallas")
+    assert row["checked"] and row["ok"], row
+
+
+def test_mg_coarse_wrong_flops_model_fails(monkeypatch):
+    """A KERNEL_MODELS edit that disagrees with XLA's flop count for
+    the coarse reference contraction must fail tier-1."""
+    wrong = dict(KERNEL_MODELS["mg_coarse_pallas"],
+                 flops_per_site=3 * 4608)
+    monkeypatch.setitem(KERNEL_MODELS, "mg_coarse_pallas", wrong)
+    ocost.reset()
+    row = ocost.drift_row("mg_coarse_pallas")
+    assert not row["ok"] and any("flops drift" in r
+                                 for r in row["reasons"])
+    with pytest.raises(AssertionError, match="flops drift"):
+        ocost.lint(["mg_coarse_pallas"])
+
+
+def test_mg_coarse_inflated_bytes_model_fails(monkeypatch):
+    """Claiming 4x the operand-footprint floor (or less than one read
+    of the links) fails the bytes cross-check."""
+    for bad in (4 * 9856, 2000):
+        wrong = dict(KERNEL_MODELS["mg_coarse_pallas"],
+                     bytes_per_site=bad)
+        monkeypatch.setitem(KERNEL_MODELS, "mg_coarse_pallas", wrong)
+        ocost.reset()
+        row = ocost.drift_row("mg_coarse_pallas")
+        assert not row["ok"] and any("bytes drift" in r
+                                     for r in row["reasons"]), (bad, row)
+
+
 def test_deliberately_inflated_bytes_model_fails(monkeypatch):
     """A factor-2 bytes inflation (the classic copied-table slip) must
     fail the lint."""

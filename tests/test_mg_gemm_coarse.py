@@ -1,0 +1,204 @@
+"""GEMM-built coarse stencil (mg/gemm.py) vs the legacy probe loop.
+
+Reference behavior: lib/coarse_op.in.cu calculateY — the coarse link
+field Y and coarse clover X assembled by batched contractions must be
+the SAME operator the probing construction (mg/coarse.build_coarse,
+mg/pair.build_coarse_pairs) produces, to fp tolerance: both chiralities,
+complex and pair layouts, the ext==1 edge case, the chunked HBM-valve
+path, and the closure-jit fallback for operator types without a
+registered opstate.  The fast-vs-legacy setup A/B (null-vector MRHS
+block solve, phase counters) is drilled here too.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.fields.geometry import LatticeGeometry
+from quda_tpu.mg.coarse import DIRS, build_coarse
+from quda_tpu.mg.gemm import (build_coarse_gemm, build_coarse_pairs_gemm)
+from quda_tpu.mg.mg import MG, MGLevelParam, _LevelOp
+from quda_tpu.mg.pair import (PairMG, PairTransfer, PairWilsonLevelOp,
+                              build_coarse_pairs)
+from quda_tpu.mg.transfer import Transfer
+from quda_tpu.models.wilson import DiracWilson
+from quda_tpu.ops.pair import to_pairs
+from quda_tpu.utils import config as qconf
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+BLOCK = (2, 2, 2, 2)
+NVEC = 3
+KAPPA = 0.12
+
+
+@pytest.fixture(autouse=True)
+def _fresh_knobs():
+    qconf.reset_cache()
+    yield
+    qconf.reset_cache()
+
+
+@pytest.fixture(scope="module")
+def dirac():
+    U = GaugeField.random(jax.random.PRNGKey(0), GEOM)
+    return DiracWilson(U.data.astype(jnp.complex64), GEOM, kappa=KAPPA)
+
+
+def _nulls(key, n_vec=NVEC, shape=GEOM.lattice_shape):
+    k1, k2 = jax.random.split(key)
+    s = (n_vec,) + shape + (2, 6)
+    return (jax.random.normal(k1, s)
+            + 1j * jax.random.normal(k2, s)).astype(jnp.complex64)
+
+
+def _assert_same_op(fast, ref, tol, cplx=True):
+    """X and all 8 Y links agree to fp tolerance — both chirality
+    blocks live inside the (..., nc, nc) coarse color axes."""
+    def _c(a):
+        return a if cplx else a[..., 0] + 1j * a[..., 1]
+    scale = float(jnp.max(jnp.abs(_c(ref.x_diag))))
+    err = float(jnp.max(jnp.abs(_c(fast.x_diag) - _c(ref.x_diag))))
+    assert err < tol * scale, ("x_diag", err, scale)
+    for d in DIRS:
+        err = float(jnp.max(jnp.abs(_c(fast.y[d]) - _c(ref.y[d]))))
+        assert err < tol * scale, (d, err, scale)
+
+
+def test_gemm_matches_probe_complex_wilson(dirac):
+    parts = _LevelOp(dirac)
+    tr = Transfer.from_null_vectors(_nulls(jax.random.PRNGKey(1)), BLOCK)
+    ref = build_coarse(parts, tr)
+    fast = build_coarse_gemm(parts, tr)
+    _assert_same_op(fast, ref, 5e-5)
+
+
+def test_gemm_matches_probe_pair_wilson(dirac):
+    parts = PairWilsonLevelOp(dirac)
+    tr = PairTransfer.from_null_vectors(
+        to_pairs(_nulls(jax.random.PRNGKey(2)), jnp.float32), BLOCK)
+    ref = build_coarse_pairs(parts, tr)
+    fast = build_coarse_pairs_gemm(parts, tr)
+    _assert_same_op(fast, ref, 5e-5, cplx=False)
+
+
+def test_gemm_matches_probe_ext1_edge(dirac):
+    """Coarse extent 1 along t: the neighbour aggregate IS the
+    aggregate — the whole direction output feeds the link, matching
+    the probe loop's single-unmasked-probe convention."""
+    parts = _LevelOp(dirac)
+    tr = Transfer.from_null_vectors(_nulls(jax.random.PRNGKey(3)),
+                                    (4, 2, 2, 2))
+    assert tr.coarse_shape[0] == 1
+    ref = build_coarse(parts, tr)
+    fast = build_coarse_gemm(parts, tr)
+    _assert_same_op(fast, ref, 5e-5)
+
+
+def test_gemm_chunked_matches_full(dirac):
+    """QUDA_TPU_MG_COARSE_CHUNK (the HBM valve) slices the column batch
+    without changing the result."""
+    parts = _LevelOp(dirac)
+    tr = Transfer.from_null_vectors(_nulls(jax.random.PRNGKey(4)), BLOCK)
+    full = build_coarse_gemm(parts, tr)
+    with qconf.overrides(QUDA_TPU_MG_COARSE_CHUNK="2"):
+        chunked = build_coarse_gemm(parts, tr)
+    _assert_same_op(chunked, full, 1e-6)
+
+
+def test_gemm_fallback_without_opstate(dirac):
+    """An operator type with no registered opstate takes the
+    closure-jit route — identical coarse operator."""
+    parts = _LevelOp(dirac)
+
+    class _Proxy:                      # not in the opstate registry
+        diag = staticmethod(parts.diag)
+        hop = staticmethod(parts.hop)
+
+    from quda_tpu.mg.opstate import op_state
+    assert op_state(_Proxy()) is None
+    tr = Transfer.from_null_vectors(_nulls(jax.random.PRNGKey(5)), BLOCK)
+    reg = build_coarse_gemm(parts, tr)
+    fb = build_coarse_gemm(_Proxy(), tr)
+    _assert_same_op(fb, reg, 1e-6)
+
+
+# -- the fast setup pipeline end to end -------------------------------------
+
+def _vcycle_quality(mg):
+    """Residual drop of one preconditioned application: the hierarchy
+    works iff the V-cycle contracts the error."""
+    b = jax.random.normal(jax.random.PRNGKey(9),
+                          GEOM.lattice_shape + (4, 3, 2), jnp.float32)
+    from quda_tpu.ops import blas
+    x = mg.precondition(b)
+    r = b - mg.adapter.M_std(x)
+    return float(jnp.sqrt(blas.norm2(r) / blas.norm2(b)))
+
+
+def test_fast_setup_verifies_and_contracts(dirac):
+    params = [MGLevelParam(block=BLOCK, n_vec=4, setup_iters=60)]
+    mg = PairMG(dirac, GEOM, params, key=jax.random.PRNGKey(7))
+    rep = mg.verify(galerkin_tol=1e-4, pr_tol=1e-4)
+    assert rep[0]["galerkin"] < 1e-4
+    assert _vcycle_quality(mg) < 1.0
+
+
+def test_null_chunk_knob_still_converges(dirac):
+    """QUDA_TPU_MG_NULL_CHUNK=2 chunks the MRHS block solve (the HBM
+    valve for fine lattices) without breaking the hierarchy."""
+    params = [MGLevelParam(block=BLOCK, n_vec=4, setup_iters=60)]
+    with qconf.overrides(QUDA_TPU_MG_NULL_CHUNK="2"):
+        mg = PairMG(dirac, GEOM, params, key=jax.random.PRNGKey(7))
+    rep = mg.verify(galerkin_tol=1e-4, pr_tol=1e-4)
+    assert rep[0]["galerkin"] < 1e-4
+
+
+def test_setup_solver_cg_route(dirac):
+    """setup_solver='cg' selects tolerance-stopped inverse iteration on
+    MdagM (batched_cg_pairs) — the alternative fast-path solver."""
+    params = [MGLevelParam(block=BLOCK, n_vec=4, setup_iters=60,
+                           setup_solver="cg")]
+    mg = PairMG(dirac, GEOM, params, key=jax.random.PRNGKey(7))
+    rep = mg.verify(galerkin_tol=1e-4, pr_tol=1e-4)
+    assert rep[0]["galerkin"] < 1e-4
+
+
+def test_legacy_knob_routes_probe_loop(dirac, tmp_path):
+    """QUDA_TPU_MG_SETUP=legacy keeps the pre-round-15 pipeline alive
+    for the A/B: the probe-loop span (not the GEMM builder's) appears
+    in the trace, and the hierarchy still works."""
+    import json
+
+    from quda_tpu.obs import trace as otr
+    otr.start(str(tmp_path))
+    try:
+        with qconf.overrides(QUDA_TPU_MG_SETUP="legacy"):
+            mg = PairMG(dirac, GEOM,
+                        [MGLevelParam(block=BLOCK, n_vec=4,
+                                      setup_iters=20)],
+                        key=jax.random.PRNGKey(7))
+    finally:
+        paths = otr.stop()
+    doc = json.load(open(paths["chrome"]))
+    names = {e.get("name") for e in doc["traceEvents"]}
+    assert "mg_coarse_probe_loop" in names
+    assert "mg_coarse_gemm_build" not in names
+    assert _vcycle_quality(mg) < 1.0
+
+
+def test_complex_mg_fast_setup(dirac):
+    """The complex hierarchy's fast setup (realified BiCGStab around
+    the complex matvec) produces a working preconditioner."""
+    params = [MGLevelParam(block=BLOCK, n_vec=4, setup_iters=60)]
+    mg = MG(dirac, GEOM, params, key=jax.random.PRNGKey(7))
+    b = (jax.random.normal(jax.random.PRNGKey(9),
+                           GEOM.lattice_shape + (4, 3))
+         + 1j * jax.random.normal(jax.random.PRNGKey(10),
+                                  GEOM.lattice_shape + (4, 3))
+         ).astype(jnp.complex64)
+    from quda_tpu.ops import blas
+    x = mg.precondition(b)
+    r = b - dirac.M(x)
+    assert float(jnp.sqrt(blas.norm2(r) / blas.norm2(b))) < 1.0

@@ -76,7 +76,9 @@ def test_mg_setup_acceptance_drill(tmp_path):
         assert p in txt
 
     # trace: the mg_setup span nests the per-phase spans and the
-    # coarse-probe loop detail
+    # coarse-build detail (the GEMM builder's span on the fast default
+    # pipeline; QUDA_TPU_MG_SETUP=legacy would emit
+    # mg_coarse_probe_loop instead)
     omet.stop(flush_files=False)
     paths = otr.stop()
     doc = json.load(open(paths["chrome"]))
@@ -84,7 +86,7 @@ def test_mg_setup_acceptance_drill(tmp_path):
     assert "mg_setup" in names
     for p in PHASES:
         assert f"mg:{p}" in names
-    assert "mg_coarse_probe_loop" in names
+    assert "mg_coarse_gemm_build" in names
 
 
 def test_breakdown_maintained_without_sessions():

@@ -12,8 +12,9 @@ Two emission surfaces are linted:
   composites a grep would miss;
 * literal form strings recorded by the API routes and benches —
   AST-harvested from (a) first string args of record()/attribute()/
-  model() calls and (b) string constants assigned to a ``form``
-  variable, filtered to the roofline namespace prefixes.
+  model() calls, (b) string constants assigned to a ``form`` variable,
+  and (c) ``form="..."`` keyword arguments (the bench _emit idiom),
+  filtered to the roofline namespace prefixes.
 """
 
 import ast
@@ -71,7 +72,7 @@ def test_solve_form_labels_have_models():
         "None bytes for an honest flops-only row)")
 
 
-_FORM_PREFIXES = ("wilson", "staggered", "generic")
+_FORM_PREFIXES = ("wilson", "staggered", "generic", "mg_coarse")
 
 
 def _harvested_literals(path):
@@ -87,6 +88,10 @@ def _harvested_literals(path):
                 if (isinstance(a0, ast.Constant)
                         and isinstance(a0.value, str)):
                     out.add(a0.value)
+            for kw in node.keywords:
+                if (kw.arg == "form" and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)):
+                    out.add(kw.value.value)
         elif isinstance(node, ast.Assign):
             if any(getattr(t, "id", "") == "form"
                    for t in node.targets):
@@ -117,6 +122,17 @@ def test_recorded_form_literals_have_models():
     assert not missing, (
         f"form literals recorded without a KERNEL_MODELS entry: "
         f"{missing}")
+
+
+def test_mg_coarse_bench_literal_is_harvested_and_modeled():
+    """The round-15 coarse-kernel bench row attributes through
+    form='mg_coarse_pallas' (a keyword literal): the harvest must see
+    it and the model must exist, so editing either side alone fails."""
+    pkg = os.path.dirname(os.path.abspath(quda_tpu.__file__))
+    bench = os.path.join(os.path.dirname(pkg), "bench_suite.py")
+    lits = _harvested_literals(bench)
+    assert "mg_coarse_pallas" in lits
+    assert "mg_coarse_pallas" in orf.KERNEL_MODELS
 
 
 def test_fused_model_meets_round10_traffic_target():

@@ -306,6 +306,114 @@ def test_residency_activation_vs_hit_counters():
     svc.stop()
 
 
+def test_residency_stashes_restores_and_evicts_mg_per_gauge():
+    """Round-15 headroom item: a resident MG hierarchy rides its gauge
+    through the residency table — stashed on switch (ledger row moves
+    hierarchy -> serve:<id>), restored warm on re-activation, and its
+    ledger rows dropped when the gauge is evicted (a reload rebuilds
+    lazily)."""
+    from quda_tpu.interfaces import quda_api as api
+    from quda_tpu.serve.residency import GaugeResidency
+    api.init_quda()
+    res = GaugeResidency()
+    res.ensure_active("gA",
+                      loader=lambda: (_unit_gauge(), _gauge_param()))
+
+    class _FakeMG:                    # hierarchy stand-in with arrays
+        def __init__(self):
+            self.v = np.ones((64, 2), np.float32)
+
+    mg_a = _FakeMG()
+    api._install_resident_mg(mg_a)
+    assert api.resident_mg_state() is mg_a
+    mg_bytes = omem.family_bytes().get("mg", 0)
+    assert mg_bytes > 0                          # one ledger row
+
+    # switching gauges stashes the hierarchy next to its gauge
+    res.ensure_active("gB",
+                      loader=lambda: (_unit_gauge(), _gauge_param()))
+    assert api.resident_mg_state() is None       # gB has no hierarchy
+    assert omem.family_bytes().get("mg", 0) == mg_bytes  # row moved
+
+    # re-activating gA restores the SAME warm hierarchy (no rebuild)
+    assert res.ensure_active("gA") == "activated"
+    assert api.resident_mg_state() is mg_a
+    assert omem.family_bytes().get("mg", 0) == mg_bytes
+
+    # evicting the gauge drops the hierarchy's ledger rows with it
+    res.ensure_active("gB")
+    assert res.evict("gA", budget_eviction=False)
+    assert omem.family_bytes().get("mg", 0) == 0
+
+
+def test_stale_hierarchy_is_dropped_not_restashed():
+    """If the gauge mutates while active (epoch bump: smear/HMC), its
+    hierarchy is retired by the epoch guard — the switch must DROP it
+    (ledger row included), and a later re-activation must not restore
+    it as valid (the silent wrong-preconditioner case)."""
+    from quda_tpu.interfaces import quda_api as api
+    from quda_tpu.serve.residency import GaugeResidency
+    api.init_quda()
+    res = GaugeResidency()
+    res.ensure_active("gA",
+                      loader=lambda: (_unit_gauge(), _gauge_param()))
+
+    class _FakeMG:
+        def __init__(self):
+            self.v = np.ones((16,), np.float32)
+
+    api._install_resident_mg(_FakeMG())
+    api._ctx["gauge_epoch"] += 1          # the gauge mutated under us
+    assert api.resident_mg_state() is None
+    res.ensure_active("gB",
+                      loader=lambda: (_unit_gauge(), _gauge_param()))
+    assert omem.family_bytes().get("mg", 0) == 0     # dropped, not kept
+    assert res.ensure_active("gA") == "activated"
+    assert api.resident_mg_state() is None           # no stale restore
+
+
+def test_budget_counts_stashed_hierarchies():
+    """The HBM budget decision reads gauges + hierarchies: a stashed
+    per-gauge hierarchy big enough to blow the budget evicts its (LRU)
+    gauge even though the gauge family alone fits."""
+    from quda_tpu.interfaces import quda_api as api
+    from quda_tpu.serve.residency import GaugeResidency
+    api.init_quda()
+    res = GaugeResidency(budget_mb=0.5)      # two L=4 gauges fit easily
+    res.ensure_active("gA",
+                      loader=lambda: (_unit_gauge(), _gauge_param()))
+
+    class _BigMG:
+        def __init__(self):
+            self.v = np.ones((1 << 20,), np.float32)     # 4 MB
+
+    api._install_resident_mg(_BigMG())
+    res.ensure_active("gB",
+                      loader=lambda: (_unit_gauge(), _gauge_param()))
+    # stash(gA + 4MB hierarchy) then load gB -> ensure_budget sees
+    # resident_bytes > budget and evicts gA, hierarchy rows included
+    assert "gA" not in res.resident_ids()
+    assert omem.family_bytes().get("mg", 0) == 0
+    assert res.resident_bytes() <= res.budget_bytes()
+
+
+def test_resident_mg_state_never_serves_stale_hierarchy():
+    """A gauge reload bumps the epoch: the old hierarchy must read as
+    absent (a stale one silently degrades to a wrong preconditioner)."""
+    from quda_tpu.interfaces import quda_api as api
+    api.init_quda()
+    api.load_gauge_quda(_unit_gauge(), _gauge_param())
+
+    class _FakeMG:
+        def __init__(self):
+            self.v = np.ones((8,), np.float32)
+
+    api._install_resident_mg(_FakeMG())
+    assert api.resident_mg_state() is not None
+    api.load_gauge_quda(_unit_gauge(), _gauge_param())   # epoch bump
+    assert api.resident_mg_state() is None
+
+
 # -- cross-process warm start ------------------------------------------------
 
 def test_acceptance_two_workers_warm_start(tmp_path):
