@@ -1496,6 +1496,28 @@ def main(argv):
             print(json.dumps({"suite": "harness", "roofline": path}),
                   flush=True)
 
+    # static-analysis artifact (quda_tpu/analysis): whenever this
+    # invocation collects artifacts, the engine runs over the package
+    # and its findings land as analysis.tsv/analysis.json in the
+    # manifest, with per-rule counts mirrored onto the fleet report's
+    # Static analysis section (before the metrics session flushes)
+    if opts["--artifacts-dir"] is not None:
+        try:
+            from quda_tpu import analysis as qsa
+            ares = qsa.run()
+            qsa.emit_metrics(ares)
+            suite_artifacts.update(qsa.save_artifacts(ares,
+                                                      artifacts_dir))
+            print(json.dumps({"suite": "harness", "analysis": {
+                "unsuppressed": len(ares.unsuppressed),
+                "suppressed": (len(ares.findings)
+                               - len(ares.unsuppressed)),
+                "modules": ares.n_modules}}), flush=True)
+        except Exception as e:
+            print(json.dumps({"suite": "harness",
+                              "analysis_error": str(e)[:140]}),
+                  flush=True)
+
     from quda_tpu.obs import metrics as qmet
     if qmet.enabled():
         paths = qmet.stop()

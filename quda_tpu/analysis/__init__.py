@@ -1,0 +1,85 @@
+"""Unified static analysis over the package's own source (ISSUE 14).
+
+One AST parse, N registered passes, typed findings, mandatory-reason
+suppressions — the TPU-native analog of the reference enforcing its
+invariants statically (``check_params.h`` generating init/check/print
+for every param struct).  Surfaces:
+
+* ``python -m quda_tpu.analysis [--rules ...] [--tsv P] [--json P]`` —
+  CLI; exit 0 iff zero unsuppressed findings;
+* ``tests/test_analysis.py`` — one parametrized tier-1 test per rule;
+* the six legacy lint tests — thin wrappers over the migrated passes,
+  sharing this module's single parse;
+* ``bench_suite --artifacts-dir`` — ``analysis.tsv``/``analysis.json``
+  indexed into ``artifacts_manifest.json``, finding counts per rule on
+  the fleet report.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from .engine import (Finding, Result, RULES, render_json, render_tsv,
+                     run)
+from .index import index_for, package_index
+from .index import reset_package_index as _reset_index
+
+__all__ = ["Finding", "Result", "RULES", "run", "run_package",
+           "render_tsv", "render_json", "rule_names", "save_artifacts",
+           "emit_metrics", "index_for", "package_index",
+           "reset_package_index"]
+
+_PACKAGE_RESULT: Optional[Result] = None
+
+
+def run_package(refresh: bool = False) -> Result:
+    """The full-rule run over the cached package index, itself cached:
+    the parametrized per-rule tests and the six legacy wrappers all
+    share ONE parse and ONE pass execution per process."""
+    global _PACKAGE_RESULT
+    if _PACKAGE_RESULT is None or refresh:
+        _PACKAGE_RESULT = run()
+    return _PACKAGE_RESULT
+
+
+def reset_package_index():
+    """Drop BOTH caches — the parsed index and the full-run result —
+    so a process that edited sources on disk re-analyzes them (the two
+    caches are a matched pair; clearing one alone serves stale
+    findings)."""
+    global _PACKAGE_RESULT
+    _PACKAGE_RESULT = None
+    _reset_index()
+
+
+def rule_names() -> List[str]:
+    from .engine import _load_passes
+    _load_passes()
+    return sorted(RULES)
+
+
+def save_artifacts(result: Result, directory: str,
+                   tsv: str = "analysis.tsv",
+                   json_name: str = "analysis.json") -> dict:
+    """Write analysis.tsv / analysis.json under ``directory`` (the
+    bench_suite --artifacts-dir exporter); returns {name: path}."""
+    os.makedirs(directory, exist_ok=True)
+    tsv_path = os.path.join(directory, tsv)
+    json_path = os.path.join(directory, json_name)
+    with open(tsv_path, "w") as fh:
+        fh.write(render_tsv(result))
+    with open(json_path, "w") as fh:
+        fh.write(render_json(result))
+    return {tsv: tsv_path, json_name: json_path}
+
+
+def emit_metrics(result: Result):
+    """Mirror per-rule finding counts into the metrics registry (no-op
+    when metrics are off) — the fleet report's Static analysis line."""
+    from ..obs import metrics as omet
+    for name, cnt in result.counts().items():
+        omet.set_gauge("analysis_findings", cnt["unsuppressed"],
+                       rule=name, status="unsuppressed")
+        omet.set_gauge("analysis_findings", cnt["suppressed"],
+                       rule=name, status="suppressed")

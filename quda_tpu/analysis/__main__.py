@@ -1,0 +1,72 @@
+"""CLI: ``python -m quda_tpu.analysis``.
+
+Runs the registered passes over the package (or explicit ``--paths``)
+and prints every finding; exit status 0 iff zero UNSUPPRESSED findings
+remain — the tier-1 contract, callable standalone (pre-commit, CI
+without pytest, operator triage).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import RULES, render_json, render_tsv, rule_names, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m quda_tpu.analysis",
+        description="quda_tpu static analysis: one parse, N passes, "
+                    "suppressible typed findings (reason mandatory)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all); "
+                    "use --list to see them")
+    ap.add_argument("--paths", nargs="*", default=None,
+                    help="explicit files to analyze instead of the "
+                    "package (file-local checks only)")
+    ap.add_argument("--tsv", default=None, metavar="PATH",
+                    help="write findings as TSV")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write findings + per-rule counts as JSON")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered rules and exit")
+    ap.add_argument("--suppressed", action="store_true",
+                    help="also print suppressed findings")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in rule_names():
+            print(f"{name}: {RULES[name].doc}")
+        return 0
+
+    rules = ([r for r in args.rules.split(",") if r]
+             if args.rules else None)
+    result = run(rules=rules, paths=args.paths)
+
+    for f in result.findings:
+        if f.suppressed and not args.suppressed:
+            continue
+        print(f.render())
+    if args.tsv:
+        with open(args.tsv, "w") as fh:
+            fh.write(render_tsv(result))
+    if args.json:
+        with open(args.json, "w") as fh:
+            fh.write(render_json(result))
+
+    counts = result.counts()
+    n_bad = len(result.unsuppressed)
+    n_sup = len(result.findings) - n_bad
+    summary = ", ".join(
+        f"{name}={cnt['unsuppressed']}" for name, cnt in
+        sorted(counts.items()) if cnt["unsuppressed"])
+    print(f"# {result.n_modules} modules, {len(result.rules)} rules: "
+          f"{n_bad} unsuppressed finding(s)"
+          + (f" [{summary}]" if summary else "")
+          + (f", {n_sup} suppressed" if n_sup else ""))
+    return 1 if n_bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,190 @@
+"""Rule registry, findings, suppression semantics, and the runner.
+
+One engine, N passes (ISSUE 14 tentpole): every pass registers itself
+with :func:`rule` and receives the SHARED parsed index — the package is
+parsed once per process however many rules run (the six legacy lints
+each paid their own full walk).  Findings are typed, suppressible in
+source with a mandatory reason::
+
+    risky_line()  # quda-lint: disable=<rule>  reason=<why it is safe>
+
+and the run exits clean only when zero UNSUPPRESSED findings remain —
+the static analog of the reference's check_params.h generated
+init/check/print discipline: invariants enforced by tooling, not
+review.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Dict, Iterable, List, Optional
+
+from .index import Index, Mod, index_for, package_index
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str                  # repo-relative
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""           # the suppression's mandatory reason
+
+    def render(self) -> str:
+        tag = f"  [suppressed: {self.reason}]" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}{tag}"
+
+
+@dataclasses.dataclass
+class Rule:
+    name: str
+    doc: str
+    check_module: Optional[Callable[[Index, Mod], Iterable]] = None
+    check_package: Optional[Callable[[Index], Iterable]] = None
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, doc: str):
+    """Register a pass's per-module checker; attach a whole-package
+    checker afterwards via :func:`package_check`.  Checkers yield
+    ``(line, message)`` (per-module) or ``(rel, line, message)``
+    (package) tuples; the engine owns Finding construction and
+    suppression."""
+    def deco(fn):
+        RULES[name] = Rule(name=name, doc=doc, check_module=fn)
+        return fn
+    return deco
+
+
+def package_check(name: str):
+    def deco(fn):
+        RULES[name].check_package = fn
+        return fn
+    return deco
+
+
+@dataclasses.dataclass
+class Result:
+    findings: List[Finding]
+    rules: List[str]
+    n_modules: int
+
+    @property
+    def unsuppressed(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    def by_rule(self, name: str) -> List[Finding]:
+        return [f for f in self.findings if f.rule == name]
+
+    def counts(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {
+            r: {"unsuppressed": 0, "suppressed": 0} for r in self.rules}
+        for f in self.findings:
+            out.setdefault(f.rule, {"unsuppressed": 0, "suppressed": 0})[
+                "suppressed" if f.suppressed else "unsuppressed"] += 1
+        return out
+
+    @property
+    def ok(self) -> bool:
+        return not self.unsuppressed
+
+
+# suppression-hygiene is itself a pass: a disable without a reason, or
+# naming a rule the registry does not know, is the typoed-env-knob
+# failure mode (silently doing nothing) applied to the linter itself.
+SUPPRESSION_RULE = "suppression-hygiene"
+
+
+def _check_suppressions(index: Index, mod: Mod):
+    for sup in mod.bad_suppressions:
+        yield (sup.src_line,
+               f"suppression without a reason: disable="
+               f"{','.join(sorted(sup.rules))} — the reason is mandatory "
+               "(reason=<why this finding is intentional>)")
+    for sups in mod.suppressions.values():
+        for sup in sups:
+            for r in sorted(sup.rules):
+                if r not in RULES:
+                    yield (sup.src_line,
+                           f"suppression names unknown rule {r!r} "
+                           f"(known: {sorted(RULES)}) — a typoed "
+                           "disable silently suppresses nothing")
+
+
+def _register_builtin():
+    if SUPPRESSION_RULE not in RULES:
+        RULES[SUPPRESSION_RULE] = Rule(
+            SUPPRESSION_RULE,
+            "every quda-lint disable carries a reason and names a "
+            "registered rule",
+            check_module=_check_suppressions)
+
+
+def _load_passes():
+    """Import the pass modules (registration side effect), once."""
+    _register_builtin()
+    from . import rules_jax, rules_legacy, rules_locks  # noqa: F401
+
+
+def _mk_finding(index: Index, name: str, rel: str, line: int,
+                msg: str) -> Finding:
+    f = Finding(rule=name, path=rel, line=int(line), message=msg)
+    mod = index.get(rel)
+    if mod is not None and name != SUPPRESSION_RULE:
+        sup = mod.suppression_for(name, f.line)
+        if sup is not None:
+            f.suppressed, f.reason = True, sup.reason
+    return f
+
+
+def run(index: Optional[Index] = None, rules: Optional[List[str]] = None,
+        paths: Optional[List[str]] = None) -> Result:
+    """Run the selected rules (default: all) over ``index`` /
+    ``paths`` (default: the cached package index)."""
+    _load_passes()
+    if index is None:
+        index = index_for(paths) if paths else package_index()
+    selected = list(rules) if rules else sorted(RULES)
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise KeyError(f"unknown analysis rule(s) {unknown}; "
+                       f"known: {sorted(RULES)}")
+    findings: List[Finding] = []
+    for name in selected:
+        r = RULES[name]
+        if r.check_module is not None:
+            for mod in index.modules:
+                for line, msg in r.check_module(index, mod):
+                    findings.append(
+                        _mk_finding(index, name, mod.rel, line, msg))
+        if r.check_package is not None and index.is_package:
+            for rel, line, msg in r.check_package(index):
+                findings.append(_mk_finding(index, name, rel, line, msg))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Result(findings=findings, rules=selected,
+                  n_modules=len(index.modules))
+
+
+# -- artifact rendering (bench_suite --artifacts-dir consumers) -------------
+
+def render_tsv(result: Result) -> str:
+    rows = ["rule\tpath\tline\tsuppressed\tmessage"]
+    for f in result.findings:
+        msg = f.message.replace("\t", " ").replace("\n", " ")
+        rows.append(f"{f.rule}\t{f.path}\t{f.line}\t"
+                    f"{int(f.suppressed)}\t{msg}")
+    return "\n".join(rows) + "\n"
+
+
+def render_json(result: Result) -> str:
+    return json.dumps({
+        "rules": {name: dict(cnt, doc=RULES[name].doc)
+                  for name, cnt in result.counts().items()},
+        "n_modules": result.n_modules,
+        "ok": result.ok,
+        "findings": [dataclasses.asdict(f) for f in result.findings],
+    }, indent=1, sort_keys=True)

@@ -1,0 +1,243 @@
+"""Shared module index: every source file parsed ONCE for all passes.
+
+The six pre-round-17 ad-hoc lints each re-walked the package with their
+own ``os.walk`` + ``ast.parse`` loop — six full parses of ~100 files per
+tier-1 run, and none of the walkers shared import resolution or source
+spans.  This module is the single home for that machinery (the analog of
+the reference generating init/check/print once per param struct from one
+``check_params.h`` parse): a :class:`Mod` per file carrying the AST, a
+flat node list, a parent map, alias-resolved imports, and the per-line
+suppression table; an :class:`Index` over all of them; and a cached
+:func:`package_index` every pass (and every thin lint-test wrapper)
+shares.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# suppression syntax (reason MANDATORY — enforced by the
+# suppression-hygiene rule, engine.py):
+#   <statement>  # quda-lint: disable=<rule>[,<rule>...]  reason=<text>
+# A comment-only line targets the NEXT physical line instead of its own.
+_SUPPRESS_RE = re.compile(
+    r"#\s*quda-lint:\s*disable=([A-Za-z0-9_,-]+)"
+    r"(?:\s+reason=(.+?))?\s*$")
+
+
+class Suppression:
+    __slots__ = ("rules", "reason", "src_line", "target_line")
+
+    def __init__(self, rules, reason, src_line, target_line):
+        self.rules = frozenset(rules)
+        self.reason = (reason or "").strip()
+        self.src_line = src_line
+        self.target_line = target_line
+
+
+class Mod:
+    """One parsed source file + the derived tables every pass shares."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel                      # repo-relative, '/'-separated
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # flat node list + parent map: passes iterate/lookup instead of
+        # re-walking (ast.walk allocates a fresh BFS per call)
+        self.nodes: List[ast.AST] = list(ast.walk(self.tree))
+        self.parent: Dict[int, ast.AST] = {}
+        for node in self.nodes:
+            for child in ast.iter_child_nodes(node):
+                self.parent[id(child)] = node
+        # package parts of the CONTAINING package ("quda_tpu/obs/x.py"
+        # -> ("quda_tpu", "obs")) for relative-import resolution
+        parts = rel.split("/")
+        self.pkg_parts: Tuple[str, ...] = tuple(parts[:-1])
+        self.imports: Dict[str, str] = self._resolve_imports()
+        self.suppressions: Dict[int, List[Suppression]] = {}
+        self.bad_suppressions: List[Suppression] = []
+        self._scan_suppressions()
+
+    # -- imports ------------------------------------------------------------
+
+    def _resolve_imports(self) -> Dict[str, str]:
+        """alias -> fully dotted target ('qconf' ->
+        'quda_tpu.utils.config', 'perf_counter' -> 'time.perf_counter')."""
+        out: Dict[str, str] = {}
+        for node in self.nodes:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        out[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        out[head] = head
+            elif isinstance(node, ast.ImportFrom):
+                base = self._from_base(node)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    target = f"{base}.{a.name}" if base else a.name
+                    out[a.asname or a.name] = target
+        return out
+
+    def _from_base(self, node: ast.ImportFrom) -> str:
+        if not node.level:
+            return node.module or ""
+        # relative: strip (level - 1) packages off this module's package
+        keep = len(self.pkg_parts) - (node.level - 1)
+        parts = list(self.pkg_parts[:max(0, keep)])
+        if node.module:
+            parts += node.module.split(".")
+        return ".".join(parts)
+
+    # -- dotted-name resolution ---------------------------------------------
+
+    def dotted(self, node) -> Optional[str]:
+        """Fully-resolved dotted name of a Name/Attribute chain, alias
+        expansion applied to the base ('otr.event' ->
+        'quda_tpu.obs.trace.event'); None for non-name bases."""
+        chain: List[str] = []
+        while isinstance(node, ast.Attribute):
+            chain.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.imports.get(node.id, node.id)
+        return ".".join([base] + list(reversed(chain)))
+
+    def call_name(self, call: ast.Call) -> Optional[str]:
+        return self.dotted(call.func)
+
+    @staticmethod
+    def last_name(node) -> str:
+        """Terminal identifier of a call target (the legacy lints'
+        'attr or id' idiom)."""
+        return getattr(node, "attr", None) or getattr(node, "id", "")
+
+    # -- structural helpers -------------------------------------------------
+
+    def calls(self) -> Iterable[ast.Call]:
+        return (n for n in self.nodes if isinstance(n, ast.Call))
+
+    def functions(self) -> Iterable[ast.FunctionDef]:
+        return (n for n in self.nodes
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+
+    def enclosing_function(self, node) -> Optional[ast.FunctionDef]:
+        cur = self.parent.get(id(node))
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parent.get(id(cur))
+        return None
+
+    def ancestors(self, node) -> Iterable[ast.AST]:
+        cur = self.parent.get(id(node))
+        while cur is not None:
+            yield cur
+            cur = self.parent.get(id(cur))
+
+    def line_of(self, needle: str, default: int = 1) -> int:
+        """1-based line of the first occurrence of ``needle`` (anchor
+        for registry-shaped findings: schema names, knob names)."""
+        for i, line in enumerate(self.lines, 1):
+            if needle in line:
+                return i
+        return default
+
+    # -- suppressions -------------------------------------------------------
+
+    def _scan_suppressions(self):
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = [r for r in m.group(1).split(",") if r]
+            comment_only = line.strip().startswith("#")
+            target = i + 1 if comment_only else i
+            sup = Suppression(rules, m.group(2), i, target)
+            self.suppressions.setdefault(target, []).append(sup)
+            if not sup.reason:
+                self.bad_suppressions.append(sup)
+
+    def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
+        for sup in self.suppressions.get(line, ()):
+            if rule in sup.rules:
+                return sup
+        return None
+
+
+class Index:
+    """All modules of one analysis run (the package, or explicit
+    files)."""
+
+    def __init__(self, modules: List[Mod], root: str, is_package: bool):
+        self.modules = modules
+        self.root = root
+        self.is_package = is_package
+        self.by_rel: Dict[str, Mod] = {m.rel: m for m in modules}
+
+    def get(self, rel: str) -> Optional[Mod]:
+        return self.by_rel.get(rel)
+
+
+def _package_root() -> str:
+    import quda_tpu
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        quda_tpu.__file__)))
+
+
+def _load(path: str, root: str) -> Mod:
+    rel = os.path.relpath(os.path.abspath(path), root)
+    if rel.startswith(".."):
+        rel = os.path.abspath(path)
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    return Mod(os.path.abspath(path), rel.replace(os.sep, "/"), text)
+
+
+def build_package_index() -> Index:
+    """Parse the whole surface the legacy lints covered — the package
+    plus the repo-root bench harnesses — once."""
+    root = _package_root()
+    pkg = os.path.join(root, "quda_tpu")
+    paths = [os.path.join(root, f) for f in ("bench.py", "bench_suite.py")
+             if os.path.exists(os.path.join(root, f))]
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        paths += [os.path.join(dirpath, f) for f in sorted(filenames)
+                  if f.endswith(".py")]
+    return Index([_load(p, root) for p in sorted(paths)], root,
+                 is_package=True)
+
+
+_PACKAGE_INDEX: Optional[Index] = None
+
+
+def package_index() -> Index:
+    """The cached shared index (ONE parse per process for the engine,
+    every registered pass, and every thin lint-test wrapper)."""
+    global _PACKAGE_INDEX
+    if _PACKAGE_INDEX is None:
+        _PACKAGE_INDEX = build_package_index()
+    return _PACKAGE_INDEX
+
+
+def reset_package_index():
+    """Drop the cache (tests that edit sources on disk)."""
+    global _PACKAGE_INDEX
+    _PACKAGE_INDEX = None
+
+
+def index_for(paths: Iterable[str]) -> Index:
+    """An index over explicit files (fixture runs, CLI --paths).  Repo
+    pins (seam-coverage, API-guard checks) are skipped: only the
+    file-local halves of each pass apply."""
+    root = _package_root()
+    return Index([_load(p, root) for p in paths], root, is_package=False)

@@ -1,0 +1,290 @@
+"""Trace-safety and donation/aliasing passes — the jax-semantics rules.
+
+These encode this repo's hard-won review lessons as rails:
+
+* **trace-safety** — a ``config.get``/``flag``/``intval`` (or ``time.*``,
+  ``numpy.random``, ``os.environ``) call lexically inside a function
+  handed to ``jax.jit`` / ``lax.while_loop`` / ``lax.scan`` /
+  ``shard_map`` executes at TRACE time: its value is frozen into the
+  compiled executable, so a changed knob silently serves stale behavior
+  (or forces a recompile storm) — knobs must be read at operator
+  construction and closed over.  Knobs registered with
+  ``trace_safe=True`` in utils/config.py are exempt (policy lives in
+  the registry, not in this pass).
+* **donation** — a name passed in a donated argument position
+  (``donate_argnums``/``donate_argnames``) refers to a buffer the
+  runtime may alias into the output; reading it after the donating call
+  is use-after-free semantics on TPU (garbage under XLA, correct-looking
+  under CPU tests — the worst kind).  The ROADMAP item-2 double-buffer
+  headroom lands on top of this rail.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import rule
+
+# terminal names whose function-valued arguments trace (jax transform
+# entry points; bases are verified to resolve into jax below)
+_TRACE_ENTRIES = {"jit", "pjit", "while_loop", "scan", "fori_loop",
+                  "cond", "switch", "shard_map", "pmap", "pallas_call",
+                  "remat", "checkpoint", "custom_vjp", "custom_jvp"}
+_CONFIG_READS = {"get", "flag", "intval", "floatval", "strval"}
+
+
+def _trace_safe_knobs() -> set:
+    """Knob names the registry marks legal to read under trace."""
+    from ..utils import config as qconf
+    return {name for name, k in qconf.knobs().items()
+            if getattr(k, "trace_safe", False)}
+
+
+def _is_jax_entry(mod, call: ast.Call) -> bool:
+    dn = mod.call_name(call)
+    if dn is None:
+        return False
+    last = dn.rsplit(".", 1)[-1]
+    if last not in _TRACE_ENTRIES:
+        return False
+    head = dn.split(".", 1)[0]
+    # resolved through imports ('lax' -> 'jax.lax'); accept unresolved
+    # bare aliases only when they are the conventional jax short names
+    return head in ("jax", "lax", "jnp", "pl", "pltpu", "pjit", "jit",
+                    "shard_map", "pallas_call") or last == dn
+
+
+def _unwrap_partial(mod, node):
+    if isinstance(node, ast.Call):
+        dn = mod.call_name(node)
+        if dn and dn.rsplit(".", 1)[-1] == "partial" and node.args:
+            return node.args[0]
+    return node
+
+
+def _traced_roots(mod):
+    """(entry_label, function-node) for every function lexically handed
+    to a jax transform: lambda/Name arguments of entry calls, and
+    defs decorated with jit (bare or partial-applied)."""
+    funcs_by_name = {}
+    for f in mod.functions():
+        funcs_by_name.setdefault(f.name, []).append(f)
+    roots = []
+    for call in mod.calls():
+        if not _is_jax_entry(mod, call):
+            continue
+        label = mod.call_name(call).rsplit(".", 1)[-1]
+        cands = list(call.args) + [k.value for k in call.keywords]
+        for a in cands:
+            a = _unwrap_partial(mod, a)
+            if isinstance(a, ast.Lambda):
+                roots.append((label, a))
+            elif isinstance(a, ast.Name):
+                for f in funcs_by_name.get(a.id, ()):
+                    roots.append((label, f))
+    for f in mod.functions():
+        for d in f.decorator_list:
+            # @partial(jax.jit, ...) unwraps to jax.jit; a plain
+            # @jit(...) call-decorator resolves through its func
+            target = _unwrap_partial(mod, d)
+            if isinstance(target, ast.Call):
+                target = target.func
+            dn = mod.dotted(target)
+            if dn and dn.rsplit(".", 1)[-1] in ("jit", "pjit", "pmap"):
+                roots.append(("decorator", f))
+    return roots
+
+
+@rule("trace-safety",
+      "no host-state reads (config knobs, time.*, numpy.random, "
+      "os.environ) lexically inside functions traced by "
+      "jit/while_loop/scan/shard_map — knobs are read at operator "
+      "construction (trace_safe=True registry entries exempt)")
+def check_trace_safety(index, mod):
+    safe_knobs = _trace_safe_knobs()
+    seen = set()
+    for entry, fn in _traced_roots(mod):
+        for node in ast.walk(fn):
+            hazard = None
+            if isinstance(node, ast.Call):
+                dn = mod.call_name(node)
+                if dn is None:
+                    continue
+                base, _, last = dn.rpartition(".")
+                if last in _CONFIG_READS and base.endswith("config"):
+                    knob = (node.args[0].value
+                            if node.args
+                            and isinstance(node.args[0], ast.Constant)
+                            else None)
+                    if knob in safe_knobs:
+                        continue
+                    hazard = (f"config knob read {dn}({knob!r}) — the "
+                              "value freezes into the traced "
+                              "executable (stale-knob/recompile "
+                              "hazard); read it at operator "
+                              "construction or register the knob "
+                              "trace_safe=True")
+                elif dn == "time" or dn.startswith("time."):
+                    hazard = (f"host clock read {dn}() — traces to a "
+                              "constant, not a per-call timestamp")
+                elif dn.startswith("numpy.random") \
+                        or dn.startswith("random."):
+                    hazard = (f"host RNG call {dn}() — traces to a "
+                              "constant draw; use jax.random with a "
+                              "threaded key")
+                elif dn == "os.getenv" or dn.startswith("os.environ"):
+                    hazard = (f"environment read {dn}() under trace — "
+                              "same stale-value hazard as an "
+                              "unregistered knob read")
+            elif isinstance(node, ast.Attribute):
+                if mod.dotted(node) == "os.environ":
+                    hazard = ("os.environ access under trace — the "
+                              "read freezes at trace time")
+            if hazard and (node.lineno, hazard) not in seen:
+                seen.add((node.lineno, hazard))
+                yield (node.lineno,
+                       f"inside a {entry} body: {hazard}")
+
+
+# -- donation ---------------------------------------------------------------
+
+def _donating_jit_calls(mod):
+    """Call nodes constructing a donating jitted function: jit/pjit
+    with donate_argnums/donate_argnames keywords.  Returns
+    {call-node: (argnums tuple|None, argnames tuple|None)}."""
+    out = {}
+    for call in mod.calls():
+        dn = mod.call_name(call)
+        if dn is None or dn.rsplit(".", 1)[-1] not in ("jit", "pjit"):
+            continue
+        nums = names = None
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                nums = _int_tuple(kw.value)
+            elif kw.arg == "donate_argnames":
+                names = _str_tuple(kw.value)
+        if nums is not None or names is not None:
+            out[id(call)] = (call, nums, names)
+    return out
+
+
+def _int_tuple(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _str_tuple(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def _pos(node):
+    return (node.lineno, node.col_offset)
+
+
+def _scope_of(mod, node):
+    fn = mod.enclosing_function(node)
+    return fn if fn is not None else mod.tree
+
+
+def _donated_names(call: ast.Call, nums, names):
+    out = []
+    for i in (nums or ()):
+        if 0 <= i < len(call.args) \
+                and isinstance(call.args[i], ast.Name):
+            out.append(call.args[i].id)
+    for nm in (names or ()):
+        for kw in call.keywords:
+            if kw.arg == nm and isinstance(kw.value, ast.Name):
+                out.append(kw.value.id)
+    return out
+
+
+@rule("donation",
+      "a name passed in a donated argument position "
+      "(donate_argnums/donate_argnames) must not be read after the "
+      "donating call in the same scope — the buffer may be aliased "
+      "into the output (use-after-donation)")
+def check_donation(index, mod):
+    donors = _donating_jit_calls(mod)
+    if not donors:
+        return
+    # donating-callable bindings: g = jit(f, donate_argnums=...) binds
+    # g in its scope; every later g(...) in that scope donates
+    bindings = {}           # (scope-id, name) -> (nums, names)
+    for _, (call, nums, names) in donors.items():
+        parent = mod.parent.get(id(call))
+        if isinstance(parent, ast.Assign) and parent.value is call:
+            for t in parent.targets:
+                if isinstance(t, ast.Name):
+                    scope = _scope_of(mod, parent)
+                    bindings[(id(scope), t.id)] = (nums, names)
+    # donating CALL SITES: bound-name invocations + immediate
+    # jit(...)(x) invocations
+    sites = []              # (scope-node, call-node, donated-names)
+    for call in mod.calls():
+        if isinstance(call.func, ast.Name):
+            scope = _scope_of(mod, call)
+            # a donating callable bound at module level (the common
+            # layout) donates at call sites in ANY function scope
+            spec = bindings.get((id(scope), call.func.id)) \
+                or bindings.get((id(mod.tree), call.func.id))
+            if spec is not None:
+                donated = _donated_names(call, *spec)
+                if donated:
+                    sites.append((scope, call, donated))
+        elif isinstance(call.func, ast.Call) \
+                and id(call.func) in donors:
+            _, nums, names = donors[id(call.func)]
+            donated = _donated_names(call, nums, names)
+            if donated:
+                sites.append((_scope_of(mod, call), call, donated))
+    for scope, call, donated in sites:
+        # linear event scan over the scope: after the donating call,
+        # the first event per donated name decides (Store = rebound,
+        # fine — the x = g(x) double-buffer idiom; Load = finding).
+        call_end = (getattr(call, "end_lineno", call.lineno),
+                    getattr(call, "end_col_offset", call.col_offset))
+        events = []
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Name) and n.id in donated \
+                    and n is not call.func:
+                events.append((_pos(n), n))
+        # the assignment receiving the call's result rebinds its
+        # targets AFTER the call evaluates, whatever their column —
+        # including tuple-unpack targets (x, y = g(x, y), the
+        # multi-buffer rebind idiom)
+        parent = mod.parent.get(id(call))
+        rebound_by_assign = set()
+        if isinstance(parent, ast.Assign) and parent.value is call:
+            for t in parent.targets:
+                for tn in ast.walk(t):
+                    if isinstance(tn, ast.Name):
+                        rebound_by_assign.add(id(tn))
+        pending = set(donated)
+        for pos, n in sorted(events, key=lambda e: e[0]):
+            if pos <= call_end and id(n) not in rebound_by_assign:
+                continue
+            if n.id not in pending:
+                continue
+            if isinstance(n.ctx, ast.Store) or id(n) in rebound_by_assign:
+                pending.discard(n.id)
+            elif isinstance(n.ctx, ast.Load):
+                pending.discard(n.id)
+                yield (pos[0],
+                       f"{n.id!r} read after being donated at line "
+                       f"{call.lineno} — the donated buffer may be "
+                       "aliased into the output; rebind the result "
+                       "(x = g(x)) or drop the donation")

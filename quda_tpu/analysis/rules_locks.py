@@ -1,0 +1,288 @@
+"""Lock-discipline and off-path-purity passes — the concurrency rules.
+
+* **lock-discipline** — the PR 9 device-high-water race, generalized:
+  a module-level mutable container in ``obs/``/``serve/`` is shared
+  state (the monitor thread, the solve-service worker thread, and the
+  caller all run concurrently); any PUBLIC function mutating one must
+  do so under a ``with <lock>`` block.  Private (``_``-prefixed)
+  helpers are presumed called under their caller's lock, and
+  import-time initialisation is single-threaded — both exempt.
+* **off-path-purity** — the static twin of the raising-stub runtime
+  tests: every emission entry point of the observability modules
+  (``obs/trace``, ``obs/metrics``, ``obs/comms``, ``obs/flight``) must
+  follow the documented one-global-load gate (``s = _session`` /
+  ``if s is None: return``), and nothing outside those modules may
+  reach around the gate via ``<mod>._session`` — otherwise an
+  "off means off" knob stops meaning off.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import package_check, rule
+
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popitem", "popleft", "appendleft", "remove",
+             "discard", "clear"}
+_CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter"}
+
+
+def _module_containers(mod) -> set:
+    """Names bound at module level to mutable containers."""
+    out = set()
+    for node in mod.tree.body:
+        targets, value = (), None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = (node.target,), node.value
+        if value is None:
+            continue
+        is_container = isinstance(value, (ast.Dict, ast.List, ast.Set)) \
+            or (isinstance(value, ast.Call)
+                and mod.last_name(value.func) in _CONTAINER_CTORS)
+        if is_container:
+            out.update(t.id for t in targets if isinstance(t, ast.Name))
+    return out
+
+
+def _lockish(expr) -> bool:
+    """A with-item that names a lock (module _lock, self.lock, ...)."""
+    name = (getattr(expr, "attr", None) or getattr(expr, "id", "") or "")
+    return "lock" in name.lower()
+
+
+def _under_lock(mod, node) -> bool:
+    for anc in mod.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            if any(_lockish(item.context_expr) for item in anc.items):
+                return True
+    return False
+
+
+def _lock_scope(mod) -> bool:
+    parts = mod.rel.split("/")[:-1]
+    return "obs" in parts or "serve" in parts
+
+
+def _mutation_sites(mod, containers):
+    """(node, name, how) for each mutation of a module-level
+    container."""
+    for node in mod.nodes:
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in containers \
+                and node.func.attr in _MUTATORS:
+            yield node, node.func.value.id, f".{node.func.attr}()"
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in containers:
+                    yield node, t.value.id, "[...] assignment"
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in containers:
+                    yield node, t.value.id, "del [...]"
+
+
+@rule("lock-discipline",
+      "module-level mutable containers in obs/ and serve/ mutated by a "
+      "public function must be written under a `with <lock>` block "
+      "(the PR 9 high-water race class; `_`-helpers and import-time "
+      "init exempt)")
+def check_lock_discipline(index, mod):
+    if not _lock_scope(mod):
+        return
+    containers = _module_containers(mod)
+    if not containers:
+        return
+    for node, name, how in _mutation_sites(mod, containers):
+        # the exemption keys on the OUTERMOST enclosing function: a
+        # mutation inside a `_`-named closure nested in a public entry
+        # point still runs on the public path (the comms.scope _ctx
+        # shape) — only a top-level private helper is presumed called
+        # under its caller's lock
+        outer = None
+        fn = mod.enclosing_function(node)
+        cur = fn
+        while cur is not None:
+            outer = cur
+            cur = mod.enclosing_function(cur)
+        if outer is None or outer.name.startswith("_"):
+            continue
+        if _under_lock(mod, node):
+            continue
+        yield (node.lineno,
+               f"module-level container {name!r} mutated ({how}) in "
+               f"{fn.name}() outside any `with <lock>` block — "
+               "monitor/serve threads share this state; a lost update "
+               "here corrupts the fleet report")
+
+
+# -- off-path purity --------------------------------------------------------
+
+# the gated observability modules and their emission entry points (the
+# functions the raising-stub tests pin at runtime)
+_GATED = {
+    "quda_tpu/obs/trace.py": ("span", "event"),
+    "quda_tpu/obs/metrics.py": ("inc", "set_gauge", "observe",
+                                "record_execution"),
+    "quda_tpu/obs/comms.py": ("scope", "record_exchange",
+                              "record_replication", "attribute_solve"),
+    "quda_tpu/obs/flight.py": ("record",),
+}
+
+
+def _defines_session(mod) -> bool:
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            if any(isinstance(t, ast.Name) and t.id == "_session"
+                   for t in node.targets):
+                return True
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id == "_session":
+            return True
+    return False
+
+
+def _declares_global_session(fn) -> bool:
+    return any(isinstance(n, ast.Global) and "_session" in n.names
+               for n in ast.walk(fn))
+
+
+def _session_locals(fn) -> set:
+    """Local names assigned from ``_session`` (or ``<mod>._session``)."""
+    out = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name):
+            v = n.value
+            if (isinstance(v, ast.Name) and v.id == "_session") \
+                    or (isinstance(v, ast.Attribute)
+                        and v.attr == "_session"):
+                out.add(n.targets[0].id)
+    return out
+
+
+def _none_checked(fn, names) -> set:
+    """Which of ``names`` are None-compared somewhere in ``fn``."""
+    out = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Compare) \
+                and isinstance(n.left, ast.Name) \
+                and n.left.id in names \
+                and any(isinstance(c, ast.Constant) and c.value is None
+                        for c in n.comparators):
+            out.add(n.left.id)
+    return out
+
+
+def _session_functions(mod):
+    """Top-level functions and methods, innermost-def granularity."""
+    return mod.functions()
+
+
+@rule("off-path-purity",
+      "emission sites in session-gated modules follow the "
+      "one-global-load gate (s = _session; if s is None: return) and "
+      "nothing reaches around it via <mod>._session — the static twin "
+      "of the raising-stub 'off means off' tests")
+def check_off_path_purity(index, mod):
+    in_obs = mod.rel.startswith("quda_tpu/obs/")
+    if _defines_session(mod):
+        for fn in _session_functions(mod):
+            if _declares_global_session(fn):
+                continue          # lifecycle (start/stop) owns the global
+            # 1) direct use of the global: attribute/subscript/call on
+            #    the bare Name `_session` (compare-to-None reads and
+            #    plain boolean returns are the allowed predicates)
+            for n in ast.walk(fn):
+                target = None
+                if isinstance(n, ast.Attribute) \
+                        and isinstance(n.value, ast.Name) \
+                        and n.value.id == "_session":
+                    target = n
+                elif isinstance(n, ast.Subscript) \
+                        and isinstance(n.value, ast.Name) \
+                        and n.value.id == "_session":
+                    target = n
+                elif isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Name) \
+                        and n.func.id == "_session":
+                    target = n
+                if target is not None:
+                    yield (target.lineno,
+                           f"{fn.name}() uses the module global "
+                           "_session directly — load it into a local "
+                           "ONCE and None-check it (the one-global-"
+                           "load gate); a second read can observe a "
+                           "session stopped mid-call")
+            # 2) gate completeness: a local loaded from _session that
+            #    feeds real work must be None-checked in this function
+            locs = _session_locals(fn)
+            if not locs:
+                continue
+            checked = _none_checked(fn, locs)
+            unchecked = locs - checked
+            if unchecked and any(isinstance(n, ast.Call)
+                                 for n in ast.walk(fn)):
+                yield (fn.lineno,
+                       f"{fn.name}() loads {sorted(unchecked)} from "
+                       "_session but never None-checks it — the off "
+                       "path would raise AttributeError instead of "
+                       "being a no-op (gate incomplete)")
+    # 3) nothing outside the gated family reaches around the gate
+    if not in_obs:
+        for n in mod.nodes:
+            if isinstance(n, ast.Attribute) and n.attr == "_session" \
+                    and isinstance(n.value, ast.Name):
+                yield (n.lineno,
+                       "reaching into an observability module's "
+                       "_session from outside obs/ bypasses the "
+                       "one-global-load gate — call the module's "
+                       "public entry points instead")
+
+
+@package_check("off-path-purity")
+def check_purity_pins(index):
+    """The named emission entry points exist and read the gate — a
+    rename or a gate removal fails here even before the runtime
+    raising-stub tests run."""
+    for rel, funcs in _GATED.items():
+        mod = index.get(rel)
+        if mod is None:
+            yield (rel, 1, "gated observability module missing from "
+                           "the package index")
+            continue
+        # module-LEVEL functions only: _Registry.inc (a method) must
+        # not shadow the gated module function inc()
+        by_name = {f.name: f for f in mod.tree.body
+                   if isinstance(f, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        for name in funcs:
+            fn = by_name.get(name)
+            if fn is None:
+                yield (rel, 1,
+                       f"emission entry point {name}() not found — "
+                       "the raising-stub tests and every instrumented "
+                       "call site pin this name")
+                continue
+            reads = any(isinstance(n, ast.Name) and n.id == "_session"
+                        for n in ast.walk(fn)) \
+                or any(isinstance(n, ast.Attribute)
+                       and n.attr == "_session"
+                       for n in ast.walk(fn))
+            if not reads:
+                yield (rel, fn.lineno,
+                       f"emission entry point {name}() never reads "
+                       "_session — the one-global-load off gate is "
+                       "gone")

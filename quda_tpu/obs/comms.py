@@ -134,7 +134,9 @@ def stop():
     cache, which a later init/end cycle reuses without re-tracing."""
     global _session
     _session = None
-    _scopes.clear()
+    # the scope stack is trace-time LIFO state owned by the tracing
+    # thread's context nesting; end_quda teardown runs after tracing
+    _scopes.clear()  # quda-lint: disable=lock-discipline  reason=trace-time LIFO scope stack; teardown runs on the owning thread after tracing
 
 
 def reset():
@@ -158,12 +160,15 @@ def scope(site: str, policy: Optional[str] = None, mesh_axes=()):
 
     @contextlib.contextmanager
     def _ctx():
-        _scopes.append({"site": site, "policy": policy,
+        # the scope stack is per-trace LIFO state owned by the tracing
+        # thread's context nesting (the postmortem._scopes rationale);
+        # a lock cannot linearize cross-thread push/pop meaningfully
+        _scopes.append({"site": site, "policy": policy,  # quda-lint: disable=lock-discipline  reason=trace-time LIFO scope stack, push/pop ordering is the tracing thread's own nesting
                         "mesh_axes": tuple(mesh_axes)})
         try:
             yield
         finally:
-            _scopes.pop()
+            _scopes.pop()  # quda-lint: disable=lock-discipline  reason=trace-time LIFO scope stack, push/pop ordering is the tracing thread's own nesting
 
     return _ctx()
 

@@ -91,10 +91,14 @@ def nbytes_of(obj, _seen: Optional[set] = None, _depth: int = 0) -> int:
     return 0
 
 
-def _mirror_family(family: str):
+def _family_total_locked(family: str) -> int:
+    """Resident bytes of one family; caller holds ``_lock``."""
+    return sum(e["bytes"] for (f, _), e in _fields.items()
+               if f == family)
+
+
+def _mirror_family(family: str, total: int, high: int):
     from . import metrics as omet
-    total = family_bytes().get(family, 0)
-    high = _family_high.get(family, 0)
     omet.set_gauge("hbm_family_bytes", total, family=family)
     omet.set_gauge("hbm_family_high_water_bytes", high, family=family)
 
@@ -105,15 +109,17 @@ def track(family: str, name: str, obj) -> int:
     Re-tracking the same (family, name) replaces the entry — resident
     mutations (smearing, HMC updates) keep one row, not a leak."""
     nbytes = obj if isinstance(obj, int) else nbytes_of(obj)
-    _fields[(family, name)] = {"bytes": int(nbytes),
-                               "since": time.time()}
-    fam_total = family_bytes()[family]
-    if fam_total > _family_high.get(family, 0):
-        _family_high[family] = fam_total
+    with _lock:
+        _fields[(family, name)] = {"bytes": int(nbytes),
+                                   "since": time.time()}
+        fam_total = _family_total_locked(family)
+        if fam_total > _family_high.get(family, 0):
+            _family_high[family] = fam_total
+        high = _family_high.get(family, 0)
     from . import metrics as omet
     from . import trace as otr
     omet.set_gauge("hbm_field_bytes", nbytes, family=family, field=name)
-    _mirror_family(family)
+    _mirror_family(family, fam_total, high)
     otr.event("hbm_field_tracked", cat="memory", family=family,
               field=name, bytes=int(nbytes))
     return int(nbytes)
@@ -124,7 +130,8 @@ def release_family(family: str) -> int:
     families — clover terms, eig workspaces — whose arrays die with the
     call; family high-water is retained as the peak signal).  Returns
     the number of entries released."""
-    names = [n for (f, n) in list(_fields) if f == family]
+    with _lock:
+        names = [n for (f, n) in _fields if f == family]
     for n in names:
         release(family, n)
     return len(names)
@@ -133,13 +140,16 @@ def release_family(family: str) -> int:
 def release(family: str, name: str) -> bool:
     """Unregister a resident field (free/end_quda site); True iff it
     was tracked."""
-    entry = _fields.pop((family, name), None)
-    if entry is None:
-        return False
+    with _lock:
+        entry = _fields.pop((family, name), None)
+        if entry is None:
+            return False
+        fam_total = _family_total_locked(family)
+        high = _family_high.get(family, 0)
     from . import metrics as omet
     from . import trace as otr
     omet.set_gauge("hbm_field_bytes", 0, family=family, field=name)
-    _mirror_family(family)
+    _mirror_family(family, fam_total, high)
     otr.event("hbm_field_released", cat="memory", family=family,
               field=name, bytes=entry["bytes"])
     return True
@@ -147,24 +157,28 @@ def release(family: str, name: str) -> bool:
 
 def ledger() -> List[dict]:
     """Current ledger rows, largest first."""
-    return sorted(({"family": f, "field": n, "bytes": e["bytes"]}
-                   for (f, n), e in _fields.items()),
-                  key=lambda r: -r["bytes"])
+    with _lock:
+        rows = [{"family": f, "field": n, "bytes": e["bytes"]}
+                for (f, n), e in _fields.items()]
+    return sorted(rows, key=lambda r: -r["bytes"])
 
 
 def family_bytes() -> Dict[str, int]:
     out: Dict[str, int] = {}
-    for (family, _), e in _fields.items():
-        out[family] = out.get(family, 0) + e["bytes"]
+    with _lock:
+        for (family, _), e in _fields.items():
+            out[family] = out.get(family, 0) + e["bytes"]
     return out
 
 
 def high_water() -> Dict[str, int]:
-    return dict(_family_high)
+    with _lock:
+        return dict(_family_high)
 
 
 def device_high_water() -> Dict[str, int]:
-    return dict(_device_high)
+    with _lock:
+        return dict(_device_high)
 
 
 def device_snapshot() -> List[dict]:
@@ -218,8 +232,9 @@ def vmem_audit(knob: str, block_bytes: int, budget_bytes: int,
                bz: Optional[int] = None):
     """Record one ``_pick_bz`` decision: selected single-buffer working
     set vs the knob's budget (ops/wilson_pallas_packed.py call site)."""
-    _vmem_last[knob] = {"block_bytes": int(block_bytes),
-                        "budget_bytes": int(budget_bytes), "bz": bz}
+    with _lock:
+        _vmem_last[knob] = {"block_bytes": int(block_bytes),
+                            "budget_bytes": int(budget_bytes), "bz": bz}
     from . import metrics as omet
     omet.set_gauge("vmem_block_bytes", block_bytes, knob=knob)
     omet.set_gauge("vmem_budget_bytes", budget_bytes, knob=knob)
@@ -234,7 +249,8 @@ def audit_vmem_budgets() -> List[dict]:
     out = []
     for knob in VMEM_KNOBS:
         mb = float(qconf.get(knob, fresh=True))
-        last = _vmem_last.get(knob, {})
+        with _lock:
+            last = dict(_vmem_last.get(knob, {}))
         out.append({
             "knob": knob, "budget_mb": mb,
             "double_buffer_ok": mb <= SCOPED_VMEM_MB / 2,

@@ -58,6 +58,7 @@ import json
 import os
 import re
 import sys
+import threading
 import time
 from typing import List, Optional
 
@@ -68,6 +69,13 @@ _PRIORITY = {"gauge": 0, "source": 1, "fat": 2, "long": 3, "clover": 4}
 _bundles: List[dict] = []
 _suppressed = 0
 _seq = 0
+# captures can arrive from the solve-service worker thread and the
+# caller concurrently; the session bundle index must not lose entries
+# (the obs/memory lock discipline).  _inflight counts cap slots
+# reserved by captures still writing their bundle, so two concurrent
+# captures at len == cap-1 cannot both pass the cap check
+_inflight = 0
+_bundles_lock = threading.Lock()
 
 # Per-API-call scope stack (pushed by quda_api's _pm_api guard): gives
 # capture sites deep in the call tree the API name, the caller's
@@ -108,7 +116,8 @@ def bundle_root() -> str:
 def bundles() -> List[dict]:
     """Bundles written this session: [{'path', 'trigger', 'api',
     'wall'}] (fleet report + artifacts manifest consumers)."""
-    return list(_bundles)
+    with _bundles_lock:
+        return list(_bundles)
 
 
 def suppressed() -> int:
@@ -119,10 +128,15 @@ def reset_session():
     """Forget this session's bundle list (init/end_quda hook; the
     bundle DIRECTORIES persist on disk — only the in-process index
     resets)."""
-    global _suppressed
-    _bundles.clear()
-    _scopes.clear()
-    _suppressed = 0
+    global _suppressed, _inflight
+    with _bundles_lock:
+        _bundles.clear()
+        _suppressed = 0
+        _inflight = 0
+    # init/end teardown runs on the owning thread before/after any
+    # capture can be in flight; the scope stack is per-call LIFO state
+    # a lock cannot meaningfully serialize
+    _scopes.clear()  # quda-lint: disable=lock-discipline  reason=session teardown; no capture is in flight across init/end boundaries
 
 
 def current_scope() -> Optional[dict]:
@@ -136,13 +150,18 @@ def solve_scope(api: str, param=None, source=None,
     Entered by the ``_pm_api`` guard only when capture is enabled —
     the disabled path never builds the knob snapshot."""
     from ..utils import config as qconf
-    _scopes.append({"api": api, "param": param, "source": source,
+    # the scope stack is LIFO state tied to context-manager nesting on
+    # the calling thread; a lock cannot make cross-thread push/pop
+    # interleavings meaningful (concurrent API calls each need their
+    # own capture context — a thread-local stack is the round-18+
+    # upgrade if multi-threaded serving outgrows the single worker)
+    _scopes.append({"api": api, "param": param, "source": source,  # quda-lint: disable=lock-discipline  reason=per-call LIFO context stack, push/pop ordering is the calling thread's own nesting
                     "source_name": source_name, "captured": False,
                     "knobs_raw": qconf.snapshot_raw()})
     try:
         yield _scopes[-1]
     finally:
-        popped = _scopes.pop()
+        popped = _scopes.pop()  # quda-lint: disable=lock-discipline  reason=per-call LIFO context stack, push/pop ordering is the calling thread's own nesting
         # one failure, one bundle — across NESTED boundaries too: an
         # exception captured inside (e.g. invert_quda called from the
         # invert_multi_src_quda fallback loop) must not re-capture at
@@ -170,7 +189,7 @@ def capture(trigger: str, api: Optional[str] = None, param=None,
     and starve the next, distinct failure of its bundle."""
     if not enabled():
         return None
-    global _suppressed
+    global _suppressed, _inflight
     from ..utils import config as qconf
     from ..utils import logging as qlog
     from . import metrics as omet
@@ -183,8 +202,13 @@ def capture(trigger: str, api: Optional[str] = None, param=None,
     if param is None and scope is not None:
         param = scope["param"]
     cap = int(qconf.get("QUDA_TPU_POSTMORTEM_MAX_BUNDLES", fresh=True))
-    if len(_bundles) >= max(1, cap):
-        _suppressed += 1
+    with _bundles_lock:
+        over_cap = len(_bundles) + _inflight >= max(1, cap)
+        if over_cap:
+            _suppressed += 1
+        else:
+            _inflight += 1
+    if over_cap:
         if scope is not None:
             scope["captured"] = True
         omet.inc("postmortems_total", trigger="suppressed")
@@ -198,16 +222,22 @@ def capture(trigger: str, api: Optional[str] = None, param=None,
         path = _write_bundle(trigger, api, param, fields, exc, note,
                              scope)
     except AssertionError:
+        with _bundles_lock:
+            _inflight -= 1     # release the reserved cap slot
         raise                  # raising-stub pins must stay effective
     except Exception as e:     # noqa: BLE001 — never worsen a failure
+        with _bundles_lock:
+            _inflight -= 1
         qlog.warningq(
             f"postmortem capture failed ({type(e).__name__}: "
             f"{str(e)[:120]}); the original failure is unaffected")
         return None
     if scope is not None:
         scope["captured"] = True
-    _bundles.append({"path": path, "trigger": trigger, "api": api,
-                     "wall": time.time()})
+    with _bundles_lock:
+        _inflight -= 1         # reservation becomes the real entry
+        _bundles.append({"path": path, "trigger": trigger, "api": api,
+                         "wall": time.time()})
     omet.inc("postmortems_total", trigger=trigger)
     otr.event("postmortem_written", cat="postmortem", trigger=trigger,
               api=api, path=path)

@@ -175,6 +175,24 @@ def render(snap: Optional[dict] = None) -> str:
                          f"{_mb(v)}")
         lines.append("")
 
+    # -- static analysis (quda_tpu/analysis, when an engine run
+    #    mirrored its counts this session) --
+    sa = _by_name(snap, "gauges", "analysis_findings")
+    if sa:
+        lines.append("## Static analysis (quda_tpu/analysis, per rule)")
+        per_rule: dict = {}
+        for labels, v in sa:
+            per_rule.setdefault(labels.get("rule", "?"), {})[
+                labels.get("status", "?")] = v
+        for rname in sorted(per_rule):
+            c = per_rule[rname]
+            bad = c.get("unsuppressed", 0)
+            sup = c.get("suppressed", 0)
+            note = "CLEAN" if not bad else "FINDINGS — fix or suppress"
+            lines.append(f"  {rname:22s} unsuppressed {bad:g}, "
+                         f"suppressed {sup:g}  [{note}]")
+        lines.append("")
+
     # -- VMEM budget audit --
     lines.append("## Pallas VMEM budgets (single-buffer, vs "
                  f"{omem.SCOPED_VMEM_MB:g} MB scoped limit)")

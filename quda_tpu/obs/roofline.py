@@ -21,6 +21,7 @@ gate on platform before quoting them.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional
 
 # best demonstrated single-chip rates (PERF.md round 5 measurement)
@@ -175,6 +176,10 @@ def attribute(form: str, sites: int, applies: float, seconds: float,
 _rows: List[dict] = []
 _dropped = 0
 _MAX_ROWS = 10000
+# the solve-service worker thread and the calling thread both record
+# rows (the obs/memory lock discipline; a lost append is a silently
+# thinner roofline.tsv)
+_rows_lock = threading.Lock()
 
 
 def record(form: str, sites: int, applies: float, seconds: float,
@@ -186,25 +191,28 @@ def record(form: str, sites: int, applies: float, seconds: float,
     row = attribute(form, sites, applies, seconds, nrhs=nrhs,
                     flops_per_site=flops_per_site,
                     dslash_per_apply=dslash_per_apply, **extra)
-    if len(_rows) < _MAX_ROWS:
-        _rows.append(row)
-    else:
-        # no silent caps (PERF.md round-9 rule): count what the tsv
-        # will be missing so save() can mark the truncation
-        _dropped += 1
+    with _rows_lock:
+        if len(_rows) < _MAX_ROWS:
+            _rows.append(row)
+        else:
+            # no silent caps (PERF.md round-9 rule): count what the tsv
+            # will be missing so save() can mark the truncation
+            _dropped += 1
     from . import trace as otr
     otr.event("roofline", cat="roofline", **row)
     return row
 
 
 def rows() -> List[dict]:
-    return list(_rows)
+    with _rows_lock:
+        return list(_rows)
 
 
 def reset():
     global _dropped
-    _rows.clear()
-    _dropped = 0
+    with _rows_lock:
+        _rows.clear()
+        _dropped = 0
 
 
 def save(fname: str = "roofline.tsv",
@@ -221,7 +229,10 @@ def save(fname: str = "roofline.tsv",
     from ..utils import config as qconf
     path = path or qconf.get("QUDA_TPU_RESOURCE_PATH", fresh=True)
     ici_rows = ocomms.solve_rows()
-    if not path or not (_rows or ici_rows):
+    with _rows_lock:
+        hbm_rows = list(_rows)
+        dropped = _dropped
+    if not path or not (hbm_rows or ici_rows):
         return None
     os.makedirs(path, exist_ok=True)
     cols = ("form", "sites", "applies", "nrhs", "seconds", "gflops",
@@ -229,10 +240,10 @@ def save(fname: str = "roofline.tsv",
     out = os.path.join(path, fname)
     with open(out, "w") as fh:
         fh.write("\t".join(cols) + "\n")
-        for r in _rows:
+        for r in hbm_rows:
             fh.write("\t".join(str(r.get(c, "")) for c in cols) + "\n")
-        if _dropped:
-            fh.write(f"# TRUNCATED: {_dropped} rows past the "
+        if dropped:
+            fh.write(f"# TRUNCATED: {dropped} rows past the "
                      f"{_MAX_ROWS}-row cap were dropped\n")
         if ici_rows:
             fh.write(f"# ICI attribution (comms ledger; gbps = mesh-"

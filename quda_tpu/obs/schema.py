@@ -271,6 +271,14 @@ METRICS: dict[str, dict] = {
     "bench_rows_total": {
         "type": COUNTER,
         "help": "bench rows emitted, by suite"},
+    # static analysis (quda_tpu/analysis; bench_suite --artifacts-dir
+    # runs the engine and mirrors per-rule counts here for the fleet
+    # report's Static analysis section)
+    "analysis_findings": {
+        "type": GAUGE,
+        "help": "static-analysis findings at the last engine run, by "
+                "rule/status (unsuppressed findings fail tier-1 and "
+                "the CLI; suppressed ones carry a mandatory reason)"},
 }
 
 

@@ -40,14 +40,23 @@ class Knob:
     doc: str
     choices: tuple = ()
     reference: str = ""       # the reference knob this replaces
+    # Legal to read inside a traced function (jit/while_loop/scan/
+    # shard_map bodies)?  Almost never: a knob read under trace freezes
+    # into the compiled executable (stale-knob/recompile hazard), so
+    # knobs are read at OPERATOR CONSTRUCTION and closed over.  The
+    # static trace-safety pass (quda_tpu/analysis) reads its policy
+    # from this field — flipping it to True is a reviewed statement
+    # that trace-time freezing is the intended semantics for that knob.
+    trace_safe: bool = False
 
 
 _REGISTRY: dict[str, Knob] = {}
 
 
-def _register(name, kind, default, doc, choices=(), reference=""):
+def _register(name, kind, default, doc, choices=(), reference="",
+              trace_safe=False):
     _REGISTRY[name] = Knob(name, kind, default, doc, tuple(choices),
-                           reference)
+                           reference, bool(trace_safe))
 
 
 # -- logging / verbosity ----------------------------------------------------

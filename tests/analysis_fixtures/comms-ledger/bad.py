@@ -1,0 +1,8 @@
+"""Seeded violation: a lax.ppermute outside the single ledgered home
+(parallel/halo._permute_slice) — an unattributed ICI transfer."""
+
+from jax import lax
+
+
+def rogue_exchange(slab, perm):
+    return lax.ppermute(slab, "z", perm)          # finding

@@ -1,0 +1,7 @@
+"""Clean twin: transfers go through the ledgered exchange seam."""
+
+from quda_tpu.parallel.halo import exchange_boundaries
+
+
+def proper_exchange(field, mesh):
+    return exchange_boundaries(field, mesh)

@@ -1,0 +1,7 @@
+"""Suppressed twin: the out-of-home ppermute is reasoned."""
+
+from jax import lax
+
+
+def rogue_exchange(slab, perm):
+    return lax.ppermute(slab, "z", perm)  # quda-lint: disable=comms-ledger  reason=fixture pin: microbenchmark harness, bytes accounted by hand in its row

@@ -1,0 +1,24 @@
+"""Seeded violation: a donated buffer read after the donating call
+(use-after-donation — garbage on TPU, correct-looking on CPU)."""
+
+import jax
+
+
+def f(x):
+    return x * 2.0
+
+
+def run(x):
+    g = jax.jit(f, donate_argnums=(0,))
+    y = g(x)
+    return y + x          # finding: x was donated at the g(x) call
+
+
+# the common layout: the donating callable bound at MODULE level,
+# called from inside a function scope
+g2 = jax.jit(f, donate_argnums=(0,))
+
+
+def run_module_bound(x):
+    out = g2(x)
+    return out + x        # finding: x was donated at the g2(x) call

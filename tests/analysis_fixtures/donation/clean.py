@@ -1,0 +1,25 @@
+"""Clean twin: the double-buffer idiom — the result rebinds the
+donated name, nothing reads the dead buffer."""
+
+import jax
+
+
+def f(x):
+    return x * 2.0
+
+
+def run(x):
+    g = jax.jit(f, donate_argnums=(0,))
+    for _ in range(4):
+        x = g(x)          # rebind: the donated buffer is never re-read
+    return x
+
+
+def f2(x, y):
+    return y, x
+
+
+def run_tuple(x, y):
+    g = jax.jit(f2, donate_argnums=(0, 1))
+    x, y = g(x, y)        # tuple-unpack rebind: both names rebound
+    return x + y

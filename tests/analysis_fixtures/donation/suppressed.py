@@ -1,0 +1,14 @@
+"""Suppressed twin: the post-donation read is intentional (e.g. a test
+asserting the runtime did NOT alias on this backend)."""
+
+import jax
+
+
+def f(x):
+    return x * 2.0
+
+
+def run(x):
+    g = jax.jit(f, donate_argnums=(0,))
+    y = g(x)
+    return y + x  # quda-lint: disable=donation  reason=fixture pin: CPU backend never aliases, the read is the assertion

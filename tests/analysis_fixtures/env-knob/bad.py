@@ -1,0 +1,8 @@
+"""Seeded violation: a QUDA_TPU_* name the registry does not know — a
+typoed knob read silently never fires."""
+
+import os
+
+
+def read():
+    return os.environ.get("QUDA_TPU_TOTALLY_UNREGISTERED_KNOB")  # finding

@@ -1,0 +1,8 @@
+"""Clean twin: only registered knobs, read through the typed
+accessors."""
+
+from quda_tpu.utils import config as qconf
+
+
+def read():
+    return qconf.intval("QUDA_TPU_MAX_MULTI_RHS")

@@ -1,0 +1,8 @@
+"""Suppressed twin: the unregistered reference is reasoned (e.g. a
+doc mentioning a knob another tool owns)."""
+
+import os
+
+
+def read():
+    return os.environ.get("QUDA_TPU_TOTALLY_UNREGISTERED_KNOB")  # quda-lint: disable=env-knob  reason=fixture pin: name owned by an external harness, not this registry

@@ -1,0 +1,10 @@
+"""Seeded violation: a second bounded ring buffer outside
+obs/flight.py — a black box the postmortem bundles never snapshot."""
+
+import collections
+
+_events = collections.deque(maxlen=256)           # finding
+
+
+def note(event):
+    _events.append(event)

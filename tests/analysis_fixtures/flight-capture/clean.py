@@ -1,0 +1,7 @@
+"""Clean twin: events go to THE ring via the public tap."""
+
+from quda_tpu.obs import flight
+
+
+def note(event):
+    flight.record("fixture_event", cat="fixture", detail=event)

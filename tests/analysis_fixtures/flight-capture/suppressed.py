@@ -1,0 +1,9 @@
+"""Suppressed twin: the second ring is reasoned."""
+
+import collections
+
+_events = collections.deque(maxlen=256)  # quda-lint: disable=flight-capture  reason=fixture pin: host-only scratch history, contents mirrored into the flight ring by note()
+
+
+def note(event):
+    _events.append(event)

@@ -1,0 +1,24 @@
+"""Seeded violation: a public function mutating a module-level
+container with no lock (the PR 9 high-water race class)."""
+
+import threading
+
+_lock = threading.Lock()
+_cache = {}
+
+
+def put(key, value):
+    _cache[key] = value       # finding: unlocked shared-state write
+
+
+def forget(key):
+    _cache.pop(key, None)     # finding: unlocked mutator call
+
+
+def batch_put(items):
+    def _store(k, v):
+        _cache[k] = v         # finding: closure on the public path —
+        # a _-named nested helper inside a public entry point is NOT
+        # the private-top-level-helper exemption
+    for k, v in items:
+        _store(k, v)

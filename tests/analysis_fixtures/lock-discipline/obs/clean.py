@@ -1,0 +1,17 @@
+"""Clean twin: the mutation sits under the module lock; import-time
+initialisation and _private helpers are exempt by design."""
+
+import threading
+
+_lock = threading.Lock()
+_cache = {}
+_cache["seeded"] = True       # import-time init: exempt
+
+
+def put(key, value):
+    with _lock:
+        _cache[key] = value
+
+
+def _install(key, value):
+    _cache[key] = value       # _helper: presumed under the caller's lock

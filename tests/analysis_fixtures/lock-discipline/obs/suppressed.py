@@ -1,0 +1,10 @@
+"""Suppressed twin: the unlocked write is intentional and reasoned."""
+
+import threading
+
+_lock = threading.Lock()
+_cache = {}
+
+
+def put(key, value):
+    _cache[key] = value  # quda-lint: disable=lock-discipline  reason=fixture pin: single-threaded import-shim, no concurrent writers exist

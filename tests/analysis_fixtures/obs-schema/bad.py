@@ -1,0 +1,11 @@
+"""Seeded violations: a trace event and a metric emitted under names
+the canonical schema does not know — the silent-dashboard-break
+class."""
+
+from quda_tpu.obs import metrics as omet
+from quda_tpu.obs import trace as otr
+
+
+def emit():
+    otr.event("totally_unregistered_event", cat="fixture")   # finding
+    omet.inc("totally_unregistered_metric_total")            # finding

@@ -1,0 +1,9 @@
+"""Clean twin: schema-registered names only."""
+
+from quda_tpu.obs import metrics as omet
+from quda_tpu.obs import trace as otr
+
+
+def emit():
+    otr.event("compile", cat="metrics")
+    omet.inc("solves_total", api="fixture", family="f", status="ok")

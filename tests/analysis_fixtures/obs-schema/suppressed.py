@@ -1,0 +1,7 @@
+"""Suppressed twin: the off-schema name is reasoned."""
+
+from quda_tpu.obs import trace as otr
+
+
+def emit():
+    otr.event("totally_unregistered_event", cat="fixture")  # quda-lint: disable=obs-schema  reason=fixture pin: name scoped to an external consumer, never scraped

@@ -1,0 +1,14 @@
+"""Seeded violations: an emission function using the session global
+directly (double read — can observe a mid-call stop), and a gate
+loaded but never None-checked."""
+
+_session = None
+
+
+def record(name):
+    _session.events.append(name)      # finding: ungated direct use
+
+
+def observe(value):
+    s = _session
+    s.observe(value)                  # finding: local never None-checked

@@ -1,0 +1,22 @@
+"""Clean twin: the documented one-global-load gate — one read into a
+local, None-check, early return; lifecycle owns the global."""
+
+_session = None
+
+
+def record(name):
+    s = _session
+    if s is None:
+        return
+    s.events.append(name)
+
+
+def start():
+    global _session
+    if _session is None:
+        _session = object()
+    return _session
+
+
+def enabled():
+    return _session is not None

@@ -1,0 +1,8 @@
+"""Suppressed twin: the direct use is reasoned (e.g. an interactive
+debug helper that may legitimately crash when off)."""
+
+_session = None
+
+
+def record(name):
+    _session.events.append(name)  # quda-lint: disable=off-path-purity  reason=fixture pin: debug-only helper, crashing when off is the desired loud failure

@@ -1,0 +1,8 @@
+"""Seeded violation: a solver module threading a lax.while_loop with
+no breakdown sentinel — the NaN-spin-to-maxiter failure mode."""
+
+from jax import lax
+
+
+def solve(cond, body, carry):
+    return lax.while_loop(cond, body, carry)      # finding

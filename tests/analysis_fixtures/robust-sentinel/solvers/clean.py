@@ -1,0 +1,10 @@
+"""Clean twin: the sentinel threads the loop carry (make() gate)."""
+
+from jax import lax
+
+from quda_tpu.robust import sentinel
+
+
+def solve(cond, body, carry):
+    guard = sentinel.make("fixture")
+    return lax.while_loop(cond, body, (carry, guard))

@@ -1,0 +1,7 @@
+"""Suppressed twin: the unguarded loop is reasoned."""
+
+from jax import lax
+
+
+def solve(cond, body, carry):
+    return lax.while_loop(cond, body, carry)  # quda-lint: disable=robust-sentinel  reason=fixture pin: bounded fixed-trip helper loop, cannot spin past its trip count

@@ -1,0 +1,9 @@
+"""Seeded violation: a kernel-form literal in the roofline namespace
+with no KERNEL_MODELS traffic model — an unattributable kernel."""
+
+from quda_tpu.obs import roofline as orf
+
+
+def attribute(seconds):
+    form = "wilson_totally_unmodeled_form"        # finding
+    return orf.record(form, 16, 1.0, seconds)

@@ -1,0 +1,8 @@
+"""Clean twin: a modeled form from KERNEL_MODELS."""
+
+from quda_tpu.obs import roofline as orf
+
+
+def attribute(seconds):
+    form = "wilson_v2"
+    return orf.record(form, 16, 1.0, seconds)

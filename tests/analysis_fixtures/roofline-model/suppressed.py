@@ -1,0 +1,8 @@
+"""Suppressed twin: the unmodeled form literal is reasoned."""
+
+from quda_tpu.obs import roofline as orf
+
+
+def attribute(seconds):
+    form = "wilson_totally_unmodeled_form"  # quda-lint: disable=roofline-model  reason=fixture pin: prototype form, model lands with the first measured row
+    return orf.record(form, 16, 1.0, seconds)

@@ -1,0 +1,9 @@
+"""Seeded violations: a disable without the mandatory reason, and a
+disable naming a rule the registry does not know (it would silently
+suppress nothing)."""
+
+from jax import lax
+
+
+def rogue(slab, perm):
+    return lax.ppermute(slab, "z", perm)  # quda-lint: disable=comms-ledger

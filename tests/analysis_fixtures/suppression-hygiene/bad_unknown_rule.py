@@ -1,0 +1,7 @@
+"""Seeded violation: a disable naming an unregistered rule."""
+
+from jax import lax
+
+
+def rogue(slab, perm):
+    return lax.ppermute(slab, "z", perm)  # quda-lint: disable=comms-legder  reason=typo in the rule name means this suppresses nothing

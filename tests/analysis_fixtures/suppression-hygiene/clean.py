@@ -1,0 +1,7 @@
+"""Clean twin: a well-formed suppression — known rule, reason given."""
+
+from jax import lax
+
+
+def rogue(slab, perm):
+    return lax.ppermute(slab, "z", perm)  # quda-lint: disable=comms-ledger  reason=fixture pin: microbenchmark harness, bytes accounted by hand

@@ -1,0 +1,36 @@
+"""Seeded violation: config knob + host clock read inside a traced
+while_loop body (the stale-knob/recompile hazard class)."""
+
+import time
+
+from jax import lax
+
+from quda_tpu.utils import config as qconf
+
+
+def _cond(carry):
+    return carry[1] < 10
+
+
+def _body(carry):
+    k = qconf.intval("QUDA_TPU_CG_CHECK_EVERY")      # finding: knob read
+    t = time.perf_counter()                          # finding: host clock
+    return (carry[0] + k + t, carry[1] + 1)
+
+
+def run():
+    return lax.while_loop(_cond, _body, (0.0, 0))
+
+
+# the dominant jit idiom in the package: partial-applied decorator
+from functools import partial  # noqa: E402
+
+import jax  # noqa: E402
+
+
+@partial(jax.jit, static_argnums=0)
+def kernel(n, x):
+    if qconf.flag("QUDA_TPU_TRACE"):                 # finding: knob read
+        x = x + 1.0
+    return x * n
+

@@ -1,0 +1,18 @@
+"""Clean twin: the knob is read at construction and closed over — the
+package discipline the pass enforces."""
+
+from jax import lax
+
+from quda_tpu.utils import config as qconf
+
+
+def run():
+    k = qconf.intval("QUDA_TPU_CG_CHECK_EVERY")   # construction-time read
+
+    def _cond(carry):
+        return carry[1] < 10
+
+    def _body(carry):
+        return (carry[0] + k, carry[1] + 1)       # closed-over value
+
+    return lax.while_loop(_cond, _body, (0, 0))

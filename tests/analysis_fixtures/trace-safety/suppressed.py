@@ -1,0 +1,19 @@
+"""Suppressed twin: same trace-time knob read, under a reasoned
+disable (e.g. a fixture-pinned intentional freeze)."""
+
+from jax import lax
+
+from quda_tpu.utils import config as qconf
+
+
+def _cond(carry):
+    return carry[1] < 10
+
+
+def _body(carry):
+    k = qconf.intval("QUDA_TPU_CG_CHECK_EVERY")  # quda-lint: disable=trace-safety  reason=fixture pin: freezing the cadence into this trace is intended
+    return (carry[0] + k, carry[1] + 1)
+
+
+def run():
+    return lax.while_loop(_cond, _body, (0, 0))

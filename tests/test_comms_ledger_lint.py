@@ -1,9 +1,7 @@
 """Comms-ledger lint: every interconnect seam in the package must route
-through the ICI ledger (obs/comms.py) — the pattern of
-test_env_knob_lint.py for knobs and test_obs_schema_lint.py for
-telemetry names, applied to comms attribution.
+through the ICI ledger (obs/comms.py).
 
-Pinned invariants:
+Pinned invariants (unchanged since round 13):
 
 * ``lax.ppermute`` has exactly ONE home: ``parallel/halo._permute_slice``
   (every other call site would be an unattributed transfer);
@@ -18,129 +16,52 @@ Pinned invariants:
 * split-grid lane placement (``split_grid_solve``) records its gauge
   replication.
 
-New event/metric names ride the existing bidirectional schema lint
-(tests/test_obs_schema_lint.py harvests obs/comms.py like every other
-module); this file owns the seam-coverage half.
+Since round 17 the walker lives in the unified static-analysis engine
+(quda_tpu/analysis, rule ``comms-ledger``: single-home and policy-seam
+checks per call site, seam-coverage pins as a package check) over the
+shared single-parse index; the historical test names wrap it.  New
+event/metric names ride the obs-schema rule as before.
 """
 
-import ast
-import os
-
-import quda_tpu
-
-_PKG = os.path.dirname(os.path.abspath(quda_tpu.__file__))
+from quda_tpu import analysis
 
 
-def _parse(rel):
-    path = os.path.join(_PKG, rel)
-    with open(path, encoding="utf-8") as fh:
-        return ast.parse(fh.read())
-
-
-def _walk_package():
-    for dirpath, dirnames, filenames in os.walk(_PKG):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for f in filenames:
-            if f.endswith(".py"):
-                path = os.path.join(dirpath, f)
-                with open(path, encoding="utf-8") as fh:
-                    yield os.path.relpath(path, _PKG), ast.parse(fh.read())
-
-
-def _calls_in(node, names):
-    """Call nodes under ``node`` whose function name (attr or id) is in
-    ``names``."""
-    out = []
-    for n in ast.walk(node):
-        if isinstance(n, ast.Call):
-            fn = n.func
-            name = getattr(fn, "attr", None) or getattr(fn, "id", "")
-            if name in names:
-                out.append(n)
-    return out
-
-
-def _function(tree, name):
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and node.name == name:
-            return node
-    raise AssertionError(f"function {name} not found")
+def _bad(substr):
+    return [f for f in analysis.run_package().by_rule("comms-ledger")
+            if not f.suppressed and substr in f.message]
 
 
 def test_ppermute_single_home():
-    offenders = {}
-    for rel, tree in _walk_package():
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.FunctionDef):
-                continue
-            calls = _calls_in(node, {"ppermute"})
-            if calls and not (rel.endswith(os.path.join("parallel",
-                                                        "halo.py"))
-                              and node.name == "_permute_slice"):
-                offenders.setdefault(rel, []).append(node.name)
-    assert not offenders, (
-        f"lax.ppermute called outside parallel/halo._permute_slice: "
-        f"{offenders} — route the transfer through the comms-ledger "
-        "seam or it ships unattributed")
+    bad = _bad("ppermute")
+    assert not bad, (
+        "lax.ppermute called outside parallel/halo._permute_slice — "
+        "route the transfer through the comms-ledger seam or it ships "
+        "unattributed:\n  " + "\n  ".join(f.render() for f in bad))
 
 
 def test_primitive_seams_record_into_ledger():
-    missing = []
-    for rel, fname in (
-            (os.path.join("parallel", "halo.py"), "_permute_slice"),
-            (os.path.join("parallel", "pallas_halo.py"),
-             "slab_exchange_bidir"),
-            (os.path.join("parallel", "pallas_halo.py"),
-             "wilson_axis_fused_halo"),
-            (os.path.join("parallel", "pallas_halo.py"),
-             "wilson_zbwd_fused_halo")):
-        fn = _function(_parse(rel), fname)
-        if not _calls_in(fn, {"record_exchange"}):
-            missing.append(f"{rel}:{fname}")
-    assert not missing, (
-        f"exchange seams without a comms-ledger record: {missing}")
+    bad = _bad("exchange seam")
+    assert not bad, ("exchange seams without a comms-ledger record:\n  "
+                     + "\n  ".join(f.render() for f in bad))
 
 
 def test_sharded_wrappers_open_comms_scope():
-    """Every function that builds an exchange closure via _make_exchange
-    must open a comms scope (site/policy labels for the rows the
-    primitive seams record)."""
-    tree = _parse(os.path.join("parallel", "pallas_dslash.py"))
-    missing = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.FunctionDef):
-            continue
-        if node.name == "_make_exchange":
-            continue
-        if _calls_in(node, {"_make_exchange"}) \
-                and not _calls_in(node, {"scope"}):
-            missing.append(node.name)
-    assert not missing, (
-        f"sharded wrappers building an exchange without a comms scope: "
-        f"{missing}")
+    bad = _bad("comms scope")
+    assert not bad, (
+        "sharded wrappers building an exchange without a comms scope:"
+        "\n  " + "\n  ".join(f.render() for f in bad))
 
 
 def test_slab_exchange_called_only_through_policy_seam():
-    offenders = {}
-    for rel, tree in _walk_package():
-        if rel.endswith(os.path.join("parallel", "pallas_halo.py")):
-            continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.FunctionDef):
-                continue
-            if _calls_in(node, {"slab_exchange_bidir"}) \
-                    and not (rel.endswith(
-                        os.path.join("parallel", "pallas_dslash.py"))
-                        and node.name in ("_make_exchange", "exchange")):
-                offenders.setdefault(rel, []).append(node.name)
-    assert not offenders, (
-        f"slab_exchange_bidir called outside the _make_exchange policy "
-        f"seam: {offenders}")
+    bad = _bad("slab_exchange_bidir")
+    assert not bad, (
+        "slab_exchange_bidir called outside the _make_exchange policy "
+        "seam:\n  " + "\n  ".join(f.render() for f in bad))
 
 
 def test_split_grid_records_replication():
-    fn = _function(_parse(os.path.join("parallel", "split.py")),
-                   "split_grid_solve")
-    assert _calls_in(fn, {"record_replication"}), (
+    bad = _bad("replication")
+    assert not bad, (
         "split_grid_solve must record its gauge replication into the "
-        "comms ledger (lane placement is interconnect traffic)")
+        "comms ledger (lane placement is interconnect traffic):\n  "
+        + "\n  ".join(f.render() for f in bad))

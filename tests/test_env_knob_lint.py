@@ -1,51 +1,35 @@
 """Env-knob lint: every QUDA_TPU_* string referenced anywhere in the
 package must be REGISTERED in utils/config.py.
 
-check_environment() catches set-but-unregistered variables at runtime
-(user typos), but it cannot catch the dual failure mode: code that reads
-a knob which was never registered — config.get raises KeyError only when
-that code path actually executes, which for policy/bench knobs may be
-never in CI.  This grep-level lint closes the gap statically (the analog
-of keeping the reference's documented env list complete)."""
+Since round 17 the scan itself lives in the unified static-analysis
+engine (quda_tpu/analysis, rule ``env-knob``) — one shared parse for
+all lints instead of a private os.walk, findings with line numbers,
+and coverage extended to the repo-root bench harnesses.  This module
+keeps its historical test names as thin wrappers over the shared
+cached run, plus the runtime registry-hygiene half the engine's
+package check mirrors."""
 
-import os
-import re
-
-import quda_tpu
+from quda_tpu import analysis
 from quda_tpu.utils import config as qconf
-
-_KNOB_RE = re.compile(r"QUDA_TPU_[A-Z0-9_]*[A-Z0-9]")
-
-
-def _package_root():
-    return os.path.dirname(os.path.abspath(quda_tpu.__file__))
 
 
 def test_every_referenced_knob_is_registered():
-    registered = set(qconf.knobs())
-    unknown = {}
-    for dirpath, dirnames, filenames in os.walk(_package_root()):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fname in filenames:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path, encoding="utf-8") as fh:
-                text = fh.read()
-            for m in set(_KNOB_RE.findall(text)):
-                if m not in registered:
-                    unknown.setdefault(m, []).append(
-                        os.path.relpath(path, _package_root()))
-    assert not unknown, (
-        f"unregistered QUDA_TPU_* knobs referenced in quda_tpu/: "
-        f"{unknown} — register them in utils/config.py (type, default, "
-        "doc) or fix the typo; an unregistered knob read raises only "
-        "when its code path runs, and a typoed one silently never fires")
+    bad = [f for f in analysis.run_package().by_rule("env-knob")
+           if not f.suppressed]
+    assert not bad, (
+        "unregistered QUDA_TPU_* knobs referenced (register them in "
+        "utils/config.py — type, default, doc — or fix the typo; an "
+        "unregistered knob read raises only when its code path runs, "
+        "and a typoed one silently never fires):\n  "
+        + "\n  ".join(f.render() for f in bad))
 
 
 def test_registry_knobs_all_carry_docs():
     """Registration hygiene rides along: a knob without a doc string is
-    invisible in describe(), which defeats the registry's purpose."""
+    invisible in describe(), which defeats the registry's purpose —
+    and every knob carries the trace_safe policy bit the trace-safety
+    pass reads."""
     for name, knob in qconf.knobs().items():
         assert knob.doc and len(knob.doc) > 10, (
             f"{name} registered without a usable doc string")
+        assert isinstance(knob.trace_safe, bool), name

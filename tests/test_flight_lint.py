@@ -1,180 +1,77 @@
 """Flight/postmortem lint: every failure path feeds the capture hook,
-and the ring buffer has exactly one home — the pattern of
-test_comms_ledger_lint.py for comms seams, applied to failure capture.
+and the ring buffer has exactly one home.
 
-Pinned invariants:
+Pinned invariants (unchanged since round 13):
 
 * every ``except`` handler in ``robust/escalate.py`` calls the
-  postmortem capture hook (a construction failure that escalates
-  without a bundle is un-debuggable after the fact), and all three of
-  run_ladder's failure paths (construct error, exhausted-failed,
-  exhausted-degraded) call it;
+  postmortem capture hook, and all three of run_ladder's failure paths
+  (construct error, exhausted-failed, exhausted-degraded) call it —
+  including a capture inside every raising If block;
 * every inverting API entry point in ``interfaces/quda_api.py``
-  (invert_quda, invert_multishift_quda, invert_multi_src_quda,
-  eigensolve_quda, load_gauge_quda) carries the ``_pm_api`` boundary
-  guard, whose except-to-status site calls the capture hook;
+  carries the ``_pm_api`` boundary guard, whose except-to-status site
+  captures and re-raises (never swallows);
 * ``_solve_supervision``'s failure classifications (breakdown, verify
   mismatch) call capture, and ``load_gauge_quda``'s rejection site
   does too;
-* no second ring-buffer implementation appears outside
-  ``obs/flight.py`` (a bounded deque elsewhere would be an
-  unattributed black box the bundles never see).
+* no second ring-buffer implementation (bounded deque) appears outside
+  ``obs/flight.py``.
 
-New event/metric names (postmortem_written, flight_dropped,
-postmortems_total) ride the bidirectional schema lint
-(tests/test_obs_schema_lint.py); this file owns the coverage half.
+Since round 17 the walker lives in the unified static-analysis engine
+(quda_tpu/analysis, rule ``flight-capture``) over the shared
+single-parse index; the historical test names wrap it.
 """
 
-import ast
-import os
-
-import quda_tpu
-
-_PKG = os.path.dirname(os.path.abspath(quda_tpu.__file__))
-
-_CAPTURE_FUNCS = {"capture", "capture_exception", "_pm_capture"}
-
-# every API entry point the boundary guard must wrap
-_GUARDED_APIS = ("invert_quda", "invert_multishift_quda",
-                 "invert_multi_src_quda", "eigensolve_quda",
-                 "load_gauge_quda")
+from quda_tpu import analysis
 
 
-def _parse(rel):
-    path = os.path.join(_PKG, rel)
-    with open(path, encoding="utf-8") as fh:
-        return ast.parse(fh.read())
-
-
-def _walk_package():
-    for dirpath, dirnames, filenames in os.walk(_PKG):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for f in filenames:
-            if f.endswith(".py"):
-                path = os.path.join(dirpath, f)
-                with open(path, encoding="utf-8") as fh:
-                    yield (os.path.relpath(path, _PKG),
-                           ast.parse(fh.read()))
-
-
-def _calls_in(node, names):
-    out = []
-    for n in ast.walk(node):
-        if isinstance(n, ast.Call):
-            fn = n.func
-            name = getattr(fn, "attr", None) or getattr(fn, "id", "")
-            if name in names:
-                out.append(n)
-    return out
-
-
-def _function(tree, name):
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and node.name == name:
-            return node
-    raise AssertionError(f"function {name} not found")
+def _bad(substrs):
+    return [f for f in analysis.run_package().by_rule("flight-capture")
+            if not f.suppressed
+            and any(s in f.message for s in substrs)]
 
 
 def test_every_escalate_except_path_captures():
-    tree = _parse(os.path.join("robust", "escalate.py"))
-    missing = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) \
-                and not _calls_in(node, _CAPTURE_FUNCS):
-            missing.append(f"line {node.lineno}")
-    assert not missing, (
-        f"except handlers in robust/escalate.py without a postmortem "
-        f"capture call: {missing} — a failure that escalates without "
-        "a bundle is un-debuggable after the fact")
+    bad = [f for f in _bad(["except handler"])
+           if f.path.endswith("robust/escalate.py")]
+    assert not bad, (
+        "except handlers in robust/escalate.py without a postmortem "
+        "capture call — a failure that escalates without a bundle is "
+        "un-debuggable after the fact:\n  "
+        + "\n  ".join(f.render() for f in bad))
 
 
 def test_run_ladder_failure_paths_capture():
-    """All three run_ladder failure paths (construct error inside the
-    except, exhausted-failed before the re-raise, exhausted-degraded
-    best-effort) call the capture hook."""
-    fn = _function(_parse(os.path.join("robust", "escalate.py")),
-                   "run_ladder")
-    calls = _calls_in(fn, _CAPTURE_FUNCS)
-    assert len(calls) >= 3, (
-        f"run_ladder has {len(calls)} capture call(s); its three "
-        "failure paths (construct_error / ladder_exhausted:failed / "
-        "ladder_exhausted:degraded) must each call _pm_capture")
-    # the exhausted-FAILED path captures before re-raising: every If
-    # block in run_ladder that raises (the `if best is None` exit)
-    # must itself contain a capture call
-    for node in ast.walk(fn):
-        if isinstance(node, ast.If) \
-                and any(isinstance(n, ast.Raise) for b in node.body
-                        for n in ast.walk(b)):
-            assert any(_calls_in(b, _CAPTURE_FUNCS)
-                       for b in node.body), (
-                f"run_ladder raising block at line {node.lineno} does "
-                "not capture before the re-raise")
+    bad = _bad(["run_ladder"])
+    assert not bad, ("run_ladder failure-path capture coverage "
+                     "regressed:\n  "
+                     + "\n  ".join(f.render() for f in bad))
 
 
 def test_api_entry_points_carry_pm_guard():
-    tree = _parse(os.path.join("interfaces", "quda_api.py"))
-    missing = []
-    for api in _GUARDED_APIS:
-        fn = _function(tree, api)
-        deco_names = []
-        for d in fn.decorator_list:
-            f = d.func if isinstance(d, ast.Call) else d
-            deco_names.append(getattr(f, "attr", None)
-                              or getattr(f, "id", ""))
-        if "_pm_api" not in deco_names:
-            missing.append(api)
-    assert not missing, (
-        f"API entry points without the _pm_api postmortem boundary "
-        f"guard: {missing} — an uncaught exception crossing these "
-        "boundaries must capture a bundle before propagating")
+    bad = _bad(["_pm_api postmortem boundary guard",
+                "API entry point"])
+    assert not bad, (
+        "API entry points without the _pm_api postmortem boundary "
+        "guard — an uncaught exception crossing these boundaries must "
+        "capture a bundle before propagating:\n  "
+        + "\n  ".join(f.render() for f in bad))
 
 
 def test_pm_guard_except_site_captures():
-    """The guard's except-to-status site (the only place an API-crossing
-    exception is observed) calls the capture hook before re-raising."""
-    fn = _function(_parse(os.path.join("interfaces", "quda_api.py")),
-                   "_pm_api")
-    handlers = [n for n in ast.walk(fn)
-                if isinstance(n, ast.ExceptHandler)]
-    assert handlers, "_pm_api has no except handler"
-    for h in handlers:
-        assert _calls_in(h, _CAPTURE_FUNCS), (
-            f"_pm_api except handler at line {h.lineno} does not call "
-            "the capture hook")
-        assert any(isinstance(n, ast.Raise) for n in ast.walk(h)), (
-            "_pm_api except handler must re-raise (capture, never "
-            "swallow)")
+    bad = _bad(["_pm_api except handler", "_pm_api has no",
+                "_pm_api guard not found"])
+    assert not bad, "\n  ".join(f.render() for f in bad)
 
 
 def test_supervision_and_gauge_rejection_capture():
-    tree = _parse(os.path.join("interfaces", "quda_api.py"))
-    sup = _function(tree, "_solve_supervision")
-    assert len(_calls_in(sup, {"capture"})) >= 2, (
-        "_solve_supervision must capture on BOTH failure "
-        "classifications (breakdown + verify mismatch)")
-    lg = _function(tree, "load_gauge_quda")
-    assert _calls_in(lg, {"capture"}), (
-        "load_gauge_quda's rejection site must capture the rejected "
-        "gauge before raising")
+    bad = _bad(["_solve_supervision", "load_gauge_quda's rejection"])
+    assert not bad, "\n  ".join(f.render() for f in bad)
 
 
 def test_no_second_ring_buffer_outside_flight():
-    """A bounded deque anywhere else in the package would be a second
-    black-box implementation the postmortem bundles never snapshot."""
-    offenders = {}
-    for rel, tree in _walk_package():
-        if rel.endswith(os.path.join("obs", "flight.py")):
-            continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            name = getattr(fn, "attr", None) or getattr(fn, "id", "")
-            if name == "deque" and any(k.arg == "maxlen"
-                                       for k in node.keywords):
-                offenders.setdefault(rel, []).append(node.lineno)
-    assert not offenders, (
-        f"bounded deque (ring buffer) outside obs/flight.py: "
-        f"{offenders} — the flight recorder is the ONE ring; record "
-        "into it via obs.flight.record or the obs.trace.event tap")
+    bad = _bad(["bounded deque"])
+    assert not bad, (
+        "bounded deque (ring buffer) outside obs/flight.py — the "
+        "flight recorder is the ONE ring; record into it via "
+        "obs.flight.record or the obs.trace.event tap:\n  "
+        + "\n  ".join(f.render() for f in bad))

@@ -2,103 +2,58 @@
 anywhere in the package must appear in the canonical registry
 (quda_tpu/obs/schema.py), and the registry must carry no name nothing
 emits — dashboards and scrape configs key on names, and a renamed or
-ad-hoc one breaks them silently (the same AST-harvest discipline as
-test_env_knob_lint.py for knobs and test_roofline_lint.py for kernel
-forms).
+ad-hoc one breaks them silently.
 
-Harvested emission surfaces:
-
-* trace events — first string args of ``event(...)`` /
-  ``otr.event(...)`` / ``_obs_event(...)`` calls and of bench.py's
-  ``_mirror_row_event(...)`` wrapper;
-* metrics — first string args of ``inc(...)`` / ``set_gauge(...)`` /
-  ``observe(...)`` / ``_obs_metric(...)`` / ``_obs_gauge(...)`` calls.
-
-The metrics registry also validates names at RECORD time
+Since round 17 the AST harvest lives in the unified static-analysis
+engine (quda_tpu/analysis, rule ``obs-schema``: unknown-name findings
+per emission line, orphan findings anchored at the schema entry) —
+this module keeps its historical test names as thin wrappers over the
+shared single-parse run, plus the registry-object hygiene half.  The
+metrics registry also validates names at RECORD time
 (obs/metrics._Registry._check), so the dynamic half is covered even
-off-CI; this lint closes the path-never-executed gap statically.
-"""
+off-CI."""
 
-import ast
-import os
-
-import quda_tpu
+from quda_tpu import analysis
 from quda_tpu.obs import schema as osch
 
-_EVENT_FUNCS = {"event", "_obs_event", "_mirror_row_event"}
-_METRIC_FUNCS = {"inc", "set_gauge", "observe", "_obs_metric",
-                 "_obs_gauge"}
 
-
-def _paths():
-    pkg = os.path.dirname(os.path.abspath(quda_tpu.__file__))
-    root = os.path.dirname(pkg)
-    paths = [os.path.join(root, f) for f in ("bench.py", "bench_suite.py")
-             if os.path.exists(os.path.join(root, f))]
-    for dirpath, dirnames, filenames in os.walk(pkg):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        paths += [os.path.join(dirpath, f) for f in filenames
-                  if f.endswith(".py")]
-    return root, paths
-
-
-def _harvest(funcs):
-    """{name: [relpaths]} of first-string-arg calls to ``funcs``."""
-    root, paths = _paths()
-    out = {}
-    for path in paths:
-        with open(path, encoding="utf-8") as fh:
-            tree = ast.parse(fh.read())
-        rel = os.path.relpath(path, root)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            name = getattr(fn, "attr", None) or getattr(fn, "id", "")
-            if name in funcs and node.args:
-                a0 = node.args[0]
-                if isinstance(a0, ast.Constant) and isinstance(a0.value,
-                                                               str):
-                    out.setdefault(a0.value, []).append(rel)
-    return out
+def _findings(substr):
+    return [f for f in analysis.run_package().by_rule("obs-schema")
+            if not f.suppressed and substr in f.message]
 
 
 def test_every_emitted_trace_event_is_registered():
-    emitted = _harvest(_EVENT_FUNCS)
-    unknown = {n: ps for n, ps in emitted.items()
-               if n not in osch.TRACE_EVENTS}
-    assert not unknown, (
-        f"trace events emitted without a schema entry: {unknown} — "
-        "register them in quda_tpu/obs/schema.py TRACE_EVENTS (cat + "
-        "doc); an unregistered event name breaks dashboards silently")
+    bad = _findings("trace event")
+    assert not bad, (
+        "trace events emitted without a schema entry (register them "
+        "in quda_tpu/obs/schema.py TRACE_EVENTS — cat + doc; an "
+        "unregistered event name breaks dashboards silently):\n  "
+        + "\n  ".join(f.render() for f in bad))
 
 
 def test_no_registered_trace_event_is_orphaned():
-    emitted = set(_harvest(_EVENT_FUNCS))
-    orphans = set(osch.TRACE_EVENTS) - emitted
-    assert not orphans, (
-        f"TRACE_EVENTS entries nothing emits: {orphans} — schema rot; "
-        "delete them or restore the emission site")
+    bad = _findings("TRACE_EVENTS entry")
+    assert not bad, ("schema rot — delete the entry or restore the "
+                     "emission site:\n  "
+                     + "\n  ".join(f.render() for f in bad))
 
 
 def test_every_recorded_metric_is_registered():
-    emitted = _harvest(_METRIC_FUNCS)
-    unknown = {n: ps for n, ps in emitted.items()
-               if n not in osch.METRICS}
-    assert not unknown, (
-        f"metrics recorded without a schema entry: {unknown} — "
-        "register them in quda_tpu/obs/schema.py METRICS (type + help)")
+    bad = _findings("metric ")
+    assert not bad, (
+        "metrics recorded without a schema entry (register them in "
+        "quda_tpu/obs/schema.py METRICS — type + help):\n  "
+        + "\n  ".join(f.render() for f in bad))
 
 
 def test_no_registered_metric_is_orphaned():
     """Gauges the ledger mirrors internally count as emitted through
     their module-level set_gauge literals, so a truly orphaned name
     means dead schema."""
-    emitted = set(_harvest(_METRIC_FUNCS))
-    orphans = set(osch.METRICS) - emitted
-    assert not orphans, (
-        f"METRICS entries nothing records: {orphans} — schema rot; "
-        "delete them or restore the recording site")
+    bad = _findings("METRICS entry")
+    assert not bad, ("schema rot — delete the entry or restore the "
+                     "recording site:\n  "
+                     + "\n  ".join(f.render() for f in bad))
 
 
 def test_schema_entries_carry_docs():

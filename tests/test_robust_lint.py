@@ -1,83 +1,31 @@
-"""Robustness lints (static, AST-level — the test_roofline_lint.py /
-test_env_knob_lint.py pattern):
+"""Robustness lints:
 
-* solver-coverage lint: every solver module that threads a
-  ``lax.while_loop`` must register the breakdown sentinel
-  (import robust.sentinel AND call its make()/active() gate) — a new
-  solver shipping an unguarded compiled loop reintroduces the
-  NaN-spin-to-maxiter failure mode this round closed;
-* knob lint extension: the QUDA_TPU_ROBUST / QUDA_TPU_FAULT family is
-  registered with usable docs (the generic env-knob lint covers
-  references; this pins the registrations themselves so a rename can't
-  silently orphan the README's knob table).
+* solver-coverage (static, since round 17 the unified engine's
+  ``robust-sentinel`` rule over the shared single-parse index): every
+  solver module that threads a ``lax.while_loop`` must register the
+  breakdown sentinel (import robust.sentinel AND call its
+  make()/active() gate) — a new solver shipping an unguarded compiled
+  loop reintroduces the NaN-spin-to-maxiter failure mode;
+* knob lint extension (runtime registry half, kept here): the
+  QUDA_TPU_ROBUST / QUDA_TPU_FAULT family is registered with usable
+  docs, and every registered fault site appears in the QUDA_TPU_FAULT
+  doc — the knob table IS the fault-injection cookbook's source of
+  truth.
 """
 
-import ast
-import os
-
-import quda_tpu
+from quda_tpu import analysis
 from quda_tpu.utils import config as qconf
 
 
-def _solvers_dir():
-    return os.path.join(os.path.dirname(os.path.abspath(
-        quda_tpu.__file__)), "solvers")
-
-
-def _module_facts(path):
-    """(has_while_loop, sentinel_aliases, gated) for one module."""
-    with open(path, encoding="utf-8") as fh:
-        tree = ast.parse(fh.read())
-    has_loop = False
-    aliases = set()
-    gated = False
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            fn = node.func
-            if getattr(fn, "attr", None) == "while_loop":
-                has_loop = True
-            # sentinel gate: <alias>.make(...) or <alias>.active(...)
-            if (getattr(fn, "attr", None) in ("make", "active")
-                    and getattr(getattr(fn, "value", None), "id", None)
-                    in aliases):
-                gated = True
-        elif isinstance(node, ast.ImportFrom):
-            if (node.module or "").endswith("robust"):
-                for a in node.names:
-                    if a.name == "sentinel":
-                        aliases.add(a.asname or a.name)
-    # second pass for call-before-import source orders (ast.walk order
-    # is not source order for nested scopes)
-    if aliases and not gated:
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call):
-                fn = node.func
-                if (getattr(fn, "attr", None) in ("make", "active")
-                        and getattr(getattr(fn, "value", None), "id",
-                                    None) in aliases):
-                    gated = True
-    return has_loop, aliases, gated
-
-
 def test_every_while_loop_solver_registers_a_sentinel():
-    missing = {}
-    for fname in sorted(os.listdir(_solvers_dir())):
-        if not fname.endswith(".py") or fname == "__init__.py":
-            continue
-        path = os.path.join(_solvers_dir(), fname)
-        has_loop, aliases, gated = _module_facts(path)
-        if not has_loop:
-            continue
-        if not aliases:
-            missing[fname] = "no robust.sentinel import"
-        elif not gated:
-            missing[fname] = ("imports sentinel but never calls "
-                              "make()/active()")
-    assert not missing, (
-        f"solver modules threading a lax.while_loop without a "
-        f"breakdown sentinel: {missing} — thread robust.sentinel "
-        "through the loop carry (make() -> init/step/ok; None at "
-        "QUDA_TPU_ROBUST=off keeps the compiled solve bit-identical)")
+    bad = [f for f in analysis.run_package().by_rule("robust-sentinel")
+           if not f.suppressed]
+    assert not bad, (
+        "solver modules threading a lax.while_loop without a breakdown "
+        "sentinel — thread robust.sentinel through the loop carry "
+        "(make() -> init/step/ok; None at QUDA_TPU_ROBUST=off keeps "
+        "the compiled solve bit-identical):\n  "
+        + "\n  ".join(f.render() for f in bad))
 
 
 def test_robust_knobs_registered_with_docs():

@@ -1,7 +1,6 @@
 """Roofline-model lint: every kernel-form label the package can emit
 must have a KERNEL_MODELS entry in obs/roofline.py, so a new kernel
-cannot ship unattributable (the round-9 methodology rule made static —
-same pattern as test_env_knob_lint.py for env knobs).
+cannot ship unattributable (the round-9 methodology rule made static).
 
 Two emission surfaces are linted:
 
@@ -9,21 +8,21 @@ Two emission surfaces are linted:
   covering the full attribute lattice (wilson/staggered x kernel
   form/generation x reconstruct-12 x mesh x pallas-off), so every label
   the function can construct is checked, including the f-string
-  composites a grep would miss;
-* literal form strings recorded by the API routes and benches —
-  AST-harvested from (a) first string args of record()/attribute()/
-  model() calls, (b) string constants assigned to a ``form`` variable,
-  and (c) ``form="..."`` keyword arguments (the bench _emit idiom),
-  filtered to the roofline namespace prefixes.
+  composites a static harvest would miss.  This half executes package
+  code, so it stays here rather than in the engine;
+* literal form strings recorded by the API routes and benches — since
+  round 17 harvested by the unified static-analysis engine
+  (quda_tpu/analysis, rule ``roofline-model``; record()/attribute()/
+  model() first args, ``form`` assignments, and ``form=...`` keyword
+  literals, filtered to the roofline namespace) over the shared
+  single-parse index.
 """
 
-import ast
 import itertools
-import os
 
 import numpy as np
 
-import quda_tpu
+from quda_tpu import analysis
 from quda_tpu.interfaces.quda_api import _solve_form
 from quda_tpu.obs import roofline as orf
 
@@ -72,65 +71,25 @@ def test_solve_form_labels_have_models():
         "None bytes for an honest flops-only row)")
 
 
-_FORM_PREFIXES = ("wilson", "staggered", "generic", "mg_coarse")
-
-
-def _harvested_literals(path):
-    with open(path, encoding="utf-8") as fh:
-        tree = ast.parse(fh.read())
-    out = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            fn = node.func
-            name = getattr(fn, "attr", None) or getattr(fn, "id", "")
-            if name in ("record", "attribute", "model") and node.args:
-                a0 = node.args[0]
-                if (isinstance(a0, ast.Constant)
-                        and isinstance(a0.value, str)):
-                    out.add(a0.value)
-            for kw in node.keywords:
-                if (kw.arg == "form" and isinstance(kw.value, ast.Constant)
-                        and isinstance(kw.value.value, str)):
-                    out.add(kw.value.value)
-        elif isinstance(node, ast.Assign):
-            if any(getattr(t, "id", "") == "form"
-                   for t in node.targets):
-                for c in ast.walk(node.value):
-                    if (isinstance(c, ast.Constant)
-                            and isinstance(c.value, str)):
-                        out.add(c.value)
-    return {s for s in out
-            if any(s == p or s.startswith(p + "_")
-                   for p in _FORM_PREFIXES)}
-
-
 def test_recorded_form_literals_have_models():
-    pkg = os.path.dirname(os.path.abspath(quda_tpu.__file__))
-    root = os.path.dirname(pkg)
-    paths = [os.path.join(root, f) for f in ("bench.py", "bench_suite.py")
-             if os.path.exists(os.path.join(root, f))]
-    for dirpath, dirnames, filenames in os.walk(pkg):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        paths += [os.path.join(dirpath, f) for f in filenames
-                  if f.endswith(".py")]
-    missing = {}
-    for path in paths:
-        for lit in _harvested_literals(path):
-            if lit not in orf.KERNEL_MODELS:
-                missing.setdefault(lit, []).append(
-                    os.path.relpath(path, root))
-    assert not missing, (
-        f"form literals recorded without a KERNEL_MODELS entry: "
-        f"{missing}")
+    bad = [f for f in analysis.run_package().by_rule("roofline-model")
+           if not f.suppressed]
+    assert not bad, (
+        "form literals recorded without a KERNEL_MODELS entry:\n  "
+        + "\n  ".join(f.render() for f in bad))
 
 
 def test_mg_coarse_bench_literal_is_harvested_and_modeled():
     """The round-15 coarse-kernel bench row attributes through
-    form='mg_coarse_pallas' (a keyword literal): the harvest must see
-    it and the model must exist, so editing either side alone fails."""
-    pkg = os.path.dirname(os.path.abspath(quda_tpu.__file__))
-    bench = os.path.join(os.path.dirname(pkg), "bench_suite.py")
-    lits = _harvested_literals(bench)
+    form='mg_coarse_pallas' (a keyword literal): the engine's harvest
+    must see it and the model must exist, so editing either side alone
+    fails."""
+    from quda_tpu.analysis.rules_legacy import (_in_roofline_namespace,
+                                                _roofline_literals)
+    mod = analysis.package_index().get("bench_suite.py")
+    assert mod is not None
+    lits = {s for s, _ in _roofline_literals(mod)
+            if _in_roofline_namespace(s)}
     assert "mg_coarse_pallas" in lits
     assert "mg_coarse_pallas" in orf.KERNEL_MODELS
 
