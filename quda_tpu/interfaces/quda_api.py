@@ -675,13 +675,21 @@ def _invert_wilson_df64(b, param: InvertParam, d, sloppy_prec: str,
         be, bo = _split(b, param)
         rhs_df = op.prepare_df(be, bo)
 
-        if sloppy_prec == "quarter":
-            qlog.printq("df64 route has no int8 pair codec; sloppy "
-                        "storage runs at bf16 ('half')", qlog.SUMMARIZE)
+        # 'quarter' sloppy: int8 block-float LINKS under the df64
+        # reliable-update correction (the QUDA quarter-precision gauge
+        # bet — int8 mantissas + per-link f32 scales, decompressed at
+        # link load; spinor iterates stay bf16, there is no int8 pair
+        # codec).  The df64 precise side re-anchors the residual every
+        # reliable-update cycle, so the quantisation error never
+        # accumulates into the true residual (benched at 1e-10 —
+        # tests/test_blockfloat.py acceptance drill).
         store = jnp.bfloat16 if sloppy_prec in ("half", "quarter") \
             else jnp.float32
         sl = dpk.pairs(store, use_pallas=_pallas_enabled(on_tpu),
-                       pallas_interpret=_pallas_interpret(on_tpu))
+                       pallas_interpret=_pallas_interpret(on_tpu),
+                       precision_form=("int8"
+                                       if sloppy_prec == "quarter"
+                                       else None))
         codec = solvers.pair_inplace_codec(store)
     t_solve0 = time.perf_counter()
     with otr.phase("compute", "invert_quda"), \
@@ -851,6 +859,24 @@ def _solve_form(d) -> str:
         suffix = "_r12" if r12 else ""
         if getattr(op, "_mesh", None) is not None and v in (2, 3):
             return f"wilson_sharded_v{v}{suffix}"
+        # precision storage forms (PERF.md round 16) carry their own
+        # traffic models; the label is read off the authoritative
+        # operator attribute, with bf16 storage distinguished where the
+        # tile economics differ (full-tile fold / bz=Z admission exist
+        # BECAUSE of the bf16 (16,128) tile shape)
+        form = getattr(op, "_precision_form", None)
+        bf16 = (getattr(op, "store_dtype", None) is not None
+                and jnp.dtype(op.store_dtype) == jnp.dtype(jnp.bfloat16))
+        if form == "int8":
+            return "wilson_v2_int8"
+        if form == "r12f":
+            return "wilson_v2_r12f"
+        if form == "fold":
+            return f"wilson_v2{'_bf16' if bf16 else ''}_fold"
+        if form == "bzfull" and bf16:
+            return "wilson_v2_bf16_bzfull"
+        # f32 bzfull moves the same bytes as the baseline v2 block
+        # schedule — same model row, no separate label
         if v in (2, 3):
             return f"wilson_v{v}{suffix}"
     if "wilson" in name:
@@ -868,6 +894,9 @@ def _solve_form(d) -> str:
                 # policy-dependent O(surface) and lives in the trace
                 return f"staggered_sharded_{base}"
             if form == "fused":
+                pf = getattr(op, "_precision_form", "full")
+                if pf in ("r12", "fold"):
+                    return f"staggered_{base}_fused_{pf}"
                 return f"staggered_{base}_fused"
             if form == "v3":
                 return f"staggered_{base}_v3"

@@ -131,14 +131,16 @@ class DiracStaggeredPC(DiracPC):
               pallas_interpret: bool = False,
               pallas_version: int | None = None,
               form: str | None = None, mesh=None,
-              sharded_policy: str | None = None
+              sharded_policy: str | None = None,
+              precision_form: str | None = None
               ) -> "DiracStaggeredPCPairs":
         """Complex-free packed companion (f32 = the precise TPU solve
         path; bf16 = the sloppy operator); see DiracStaggeredPCPairs."""
         return DiracStaggeredPCPairs(self, store_dtype, use_pallas,
                                      pallas_interpret, pallas_version,
                                      form=form, mesh=mesh,
-                                     sharded_policy=sharded_policy)
+                                     sharded_policy=sharded_policy,
+                                     precision_form=precision_form)
 
 
 _STAG_FORM_NOTICED = False
@@ -162,7 +164,15 @@ def _notice_staggered_form(form: str, policy: str | None, source: str):
         qlog.SUMMARIZE)
 
 
+def _notice_precision_form(requested: str, served: str, why: str):
+    """One-time precision-form provenance (shared seen-set with the
+    Wilson family — same knob, same rule: no silent downgrades)."""
+    from .wilson import _notice_precision_form as _notice
+    _notice(requested, served, why)
+
+
 STAGGERED_FORMS = ("fused", "two_pass", "v3")
+STAGGERED_PRECISION_FORMS = ("full", "r12", "fold")
 
 
 class DiracStaggeredPCPairs:
@@ -211,7 +221,8 @@ class DiracStaggeredPCPairs:
                  use_pallas: bool = False, pallas_interpret: bool = False,
                  pallas_version: int | None = None,
                  form: str | None = None, mesh=None,
-                 sharded_policy: str | None = None):
+                 sharded_policy: str | None = None,
+                 precision_form: str | None = None):
         from ..ops import staggered_packed as spk
         from ..ops.wilson_packed import to_packed_pairs
         from ..utils import config as qconf
@@ -317,6 +328,45 @@ class DiracStaggeredPCPairs:
         # generation number): gather forms report 2, scatter 3
         self._pallas_version = 3 if form == "v3" else 2
 
+        # -- precision storage form (PERF.md round 16), fused kernel
+        # only: 'r12' compresses the NAIK hop set (long links are
+        # ±SU(3) after KS-phase folding — two stored rows + in-kernel
+        # third-row recon, with a streamed sign plane re-applying the
+        # folded phase; fat links are smeared SUMS, never unitary,
+        # never reconstructable), 'fold' interleaves re/im into
+        # sublane rows so bf16 (16,128) tiles fill exactly.  The two
+        # are ALTERNATIVE raced forms, not composable (fold keeps full
+        # R=3 rows — ops/staggered_pallas._fold_links_r3).
+        pform = precision_form
+        if pform is None:
+            pform = str(qconf.get("QUDA_TPU_PRECISION_FORM",
+                                  fresh=True))
+        self._long_sign = None
+        pform = self._downgrade_precision_form(pform or "full")
+        if pform == "auto":
+            from ..utils import tune as qtune
+            if pallas_interpret or not qtune.tuning_enabled():
+                _notice_precision_form(
+                    "auto", "full",
+                    "staggered auto default (no chip race: interpret "
+                    "mode or tuning disabled)")
+                pform = "full"
+            else:
+                pform = self._race_precision_form()
+        self._precision_form = pform
+        if pform == "r12":
+            from ..ops import su3
+            rs = [su3.to_recon12_signed(g) for g in self.long_eo_pp]
+            self.long_eo_pp = tuple(q for q, _ in rs)
+            self._long_sign = tuple(s for _, s in rs)
+        elif pform == "fold":
+            from ..ops import wilson_pallas_packed as wpp
+            self.fat_eo_pp = tuple(wpp.to_fold(g)
+                                   for g in self.fat_eo_pp)
+            if self.long_eo_pp is not None:
+                self.long_eo_pp = tuple(wpp.to_fold(g)
+                                        for g in self.long_eo_pp)
+
         # gather forms keep resident pre-shifted backward links (the
         # scatter/fused forms read the opposite-parity links as-is)
         if use_pallas and mesh is None and form == "two_pass":
@@ -362,6 +412,89 @@ class DiracStaggeredPCPairs:
             spl.backward_links_eo(self.long_eo_pp[1 - p], self.dims,
                                   p, 3) for p in (0, 1))
             if self.long_eo_pp is not None else None)
+
+    def _downgrade_precision_form(self, pform: str) -> str:
+        """Clamp a requested precision form to what the staggered path
+        serves: the fused single-chip kernel speaks full/r12/fold; the
+        Wilson-only forms (r12f, bzfull, int8) and every non-fused
+        route downgrade with a one-time notice."""
+        choices = ("auto",) + STAGGERED_PRECISION_FORMS
+        wilson_only = ("r12f", "bzfull", "int8")
+        if pform in wilson_only:
+            _notice_precision_form(
+                pform, "full",
+                "wilson-only precision form on the staggered family")
+            return "full"
+        if pform not in choices:
+            raise ValueError(
+                f"staggered precision form {pform!r} not in "
+                f"{choices} (QUDA_TPU_PRECISION_FORM)")
+        if not (self.use_pallas and self._mesh is None
+                and self._pallas_form == "fused"):
+            if pform != "full":
+                _notice_precision_form(
+                    pform, "full",
+                    "mesh/two-pass/v3/XLA staggered routes serve "
+                    "full storage only")
+            return "full"
+        if pform == "r12" and self.long_eo_pp is None:
+            _notice_precision_form(
+                "r12", "full",
+                "r12 compresses the Naik links; fat-only has none")
+            return "full"
+        return pform
+
+    def _race_precision_form(self) -> str:
+        """QUDA_TPU_PRECISION_FORM=auto on the fused staggered kernel:
+        race full vs r12 (improved only) vs fold on concrete operands
+        via utils.tune and cache per (volume, improved, dtype).
+        Candidate storages are transient; the winner's resident storage
+        is rebuilt by __init__."""
+        from ..ops import staggered_pallas as spl
+        from ..ops import su3
+        from ..ops import wilson_pallas_packed as wpp
+        from ..utils import tune as qtune
+        p = self.matpc
+        itp = self._pallas_interpret
+        improved = self.long_eo_pp is not None
+        fat, lng = self.fat_eo_pp, self.long_eo_pp
+        cands = {
+            "full": lambda psi: spl.dslash_staggered_eo_pallas_fused(
+                fat[p], fat[1 - p], psi, self.dims, p,
+                long_here_pl=lng[p] if improved else None,
+                long_there_pl=lng[1 - p] if improved else None,
+                interpret=itp),
+        }
+        if improved:
+            l12 = [su3.to_recon12_signed(g) for g in lng]
+            cands["r12"] = lambda psi: spl.dslash_staggered_eo_pallas_fused(
+                fat[p], fat[1 - p], psi, self.dims, p,
+                long_here_pl=l12[p][0], long_there_pl=l12[1 - p][0],
+                long_sign_here_pl=l12[p][1],
+                long_sign_there_pl=l12[1 - p][1], interpret=itp)
+        fat_f = tuple(wpp.to_fold(g) for g in fat)
+        lng_f = (tuple(wpp.to_fold(g) for g in lng) if improved
+                 else None)
+        cands["fold"] = lambda psi: wpp.from_fold(
+            spl.dslash_staggered_eo_pallas_fused_fold(
+                fat_f[p], fat_f[1 - p], wpp.to_fold(psi), self.dims, p,
+                long_here_f=lng_f[p] if improved else None,
+                long_there_f=lng_f[1 - p] if improved else None,
+                interpret=itp))
+        T, Z, _, _ = self.dims
+        yxh = self.fat_eo_pp[0].shape[-1]
+        psi0 = jnp.zeros((3, 2, T, Z, yxh), self.store_dtype)
+        aux = (f"fused|{'fat_naik' if improved else 'fat'}|"
+               f"{jnp.dtype(self.store_dtype).name}")
+        warm = qtune.cached_param("staggered_eo_precision_form",
+                                  self.dims, aux=aux)
+        won = qtune.tune("staggered_eo_precision_form", self.dims,
+                         cands, (psi0,), aux=aux)
+        _notice_precision_form(
+            "auto", won,
+            "warm cache (chip-keyed tunecache)" if warm is not None
+            else "raced (QUDA_TPU_PRECISION_FORM=auto)")
+        return won
 
     # -- form race (utils.tune at operator construction) ----------------
     def _form_candidates(self):
@@ -530,11 +663,29 @@ class DiracStaggeredPCPairs:
                 fn = self._sharded_d_to(p, out_dtype)
                 return fn(*self._sharded_args(p), psi_pp)
             if self._pallas_form == "fused":
+                if getattr(self, "_precision_form", "full") == "fold":
+                    from ..ops import wilson_pallas_packed as wpp
+                    out = spl.dslash_staggered_eo_pallas_fused_fold(
+                        self.fat_eo_pp[p], self.fat_eo_pp[1 - p],
+                        wpp.to_fold(psi_pp), self.dims, p,
+                        long_here_f=(self.long_eo_pp[p]
+                                     if self.long_eo_pp is not None
+                                     else None),
+                        long_there_f=(self.long_eo_pp[1 - p]
+                                      if self.long_eo_pp is not None
+                                      else None),
+                        interpret=self._pallas_interpret,
+                        out_dtype=out_dtype)
+                    return wpp.from_fold(out)
+                sg = getattr(self, "_long_sign", None)
                 return spl.dslash_staggered_eo_pallas_fused(
                     self.fat_eo_pp[p], self.fat_eo_pp[1 - p], psi_pp,
                     self.dims, p,
                     long_here_pl=self.long_eo_pp[p],
                     long_there_pl=self.long_eo_pp[1 - p],
+                    long_sign_here_pl=sg[p] if sg is not None else None,
+                    long_sign_there_pl=(sg[1 - p] if sg is not None
+                                        else None),
                     interpret=self._pallas_interpret,
                     out_dtype=out_dtype)
             if self._pallas_form == "v3":
@@ -566,7 +717,10 @@ class DiracStaggeredPCPairs:
         round-7 Wilson move on the second headline family); everything
         else falls back to the vmapped single-RHS stencil."""
         out_dtype = out_dtype or self.store_dtype
-        if self.use_pallas and self._mesh is None:
+        if (self.use_pallas and self._mesh is None
+                and getattr(self, "_precision_form", "full") == "full"):
+            # the gather MRHS kernel streams full R=3 fat/long tiles;
+            # r12/fold storage vmaps the single-RHS fused form instead
             from ..ops import staggered_pallas as spl
             self._ensure_bw()
             p = target_parity

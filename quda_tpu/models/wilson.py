@@ -242,6 +242,23 @@ def _notice_sharded_policy(version: int, policy: str, src: str,
         "QUDA_TPU_SHARDED_POLICY", qlog.SUMMARIZE)
 
 
+_PRECISION_NOTICED: set = set()
+
+
+def _notice_precision_form(requested: str, served: str, why: str):
+    """One-time notice per (requested, served) pair naming the precision
+    storage form actually in effect (utils/config.py fail-fast model: a
+    downgrade or race outcome must never take effect without a trace)."""
+    key = (requested, served)
+    if key in _PRECISION_NOTICED:
+        return
+    _PRECISION_NOTICED.add(key)
+    from ..utils import logging as qlog
+    qlog.printq(
+        f"precision form: requested '{requested}', serving '{served}' "
+        f"({why}); pin via QUDA_TPU_PRECISION_FORM", qlog.SUMMARIZE)
+
+
 class _PackedHopMixin:
     """The packed eo Wilson hop on pair arrays, shared by every
     packed-layout pair operator (Wilson, clover, twisted, Möbius hops):
@@ -253,13 +270,22 @@ class _PackedHopMixin:
     def _setup_hop(self, geom, gauge_eo_packed, store_dtype,
                    use_pallas, pallas_interpret, pallas_version=None,
                    tb_sign: bool = True, mesh=None,
-                   sharded_policy: str | None = None):
+                   sharded_policy: str | None = None,
+                   precision_form: str | None = None):
         """gauge_eo_packed: (even, odd) complex packed (4,3,3,T,Z,Y*Xh)
         links (wilson_packed.pack_gauge_eo output).  ``tb_sign``: whether
         the links carry a folded antiperiodic-t phase (drives the
         reconstruct-12 row-2 sign; see wilson_pallas_packed).
         ``sharded_policy`` pins the mesh halo policy programmatically
-        (else QUDA_TPU_SHARDED_POLICY decides; 'auto' races)."""
+        (else QUDA_TPU_SHARDED_POLICY decides; 'auto' races).
+        ``precision_form`` pins the link storage/kernel form (else
+        QUDA_TPU_PRECISION_FORM; '' = legacy resolution via
+        QUDA_TPU_RECONSTRUCT): full | r12 (resident 12-real links) |
+        r12f (r12 + copy-free scatter backward, no resident backward
+        links) | fold (re/im interleaved full-tile rows) | bzfull
+        (full-Z block admission) | int8 (block-float links, in-kernel
+        decompress) | auto (raced via utils.tune; int8 never races —
+        it changes numerics)."""
         from ..ops import wilson_packed as wpk
         if use_pallas:
             # pallas-construction fault seam (robust/faultinject.py):
@@ -293,27 +319,82 @@ class _PackedHopMixin:
             raise ValueError(f"pallas_version must be 2 or 3, got "
                              f"{pallas_version}")
         self._pallas_version = pallas_version
-        # in-kernel gauge compression (QUDA reconstruct-12 analog), both
-        # kernel generations and the sharded path (round 8 lifted the
-        # v3-only and recon-18-only restrictions): resident link arrays
-        # shrink 288 -> 192 B/site
-        if (use_pallas and str(qconf.get("QUDA_TPU_RECONSTRUCT",
-                                         fresh=True)) == "12"):
+        # -- precision storage form (PERF.md round 16) ------------------
+        # explicit kwarg > QUDA_TPU_PRECISION_FORM > legacy resolution
+        # (QUDA_TPU_RECONSTRUCT=12 -> r12, else full); 'auto' races the
+        # numerics-preserving forms via utils.tune.  int8 is NEVER part
+        # of a race: block-float links change the operator's floats.
+        legacy_r12 = str(qconf.get("QUDA_TPU_RECONSTRUCT",
+                                   fresh=True)) == "12"
+        form = precision_form
+        if form is None:
+            form = str(qconf.get("QUDA_TPU_PRECISION_FORM", fresh=True))
+        requested = form or ("r12" if legacy_r12 else "full")
+        form = self._downgrade_precision_form(requested, use_pallas,
+                                              mesh, legacy_r12)
+        self._block_z = None
+        if form == "auto":
+            form = self._race_precision_form(store_dtype)
+        self._precision_form = form
+        if use_pallas:
             from ..ops import wilson_pallas_packed as wpp
-            self.gauge_eo_pp = tuple(wpp.to_recon12(g)
-                                     for g in self.gauge_eo_pp)
-        # v2 pallas path only: resident pre-shifted backward links (the
-        # v3 scatter-form kernel reads the unshifted opposite-parity
-        # links directly — no resident copy).  Computed on the GLOBAL
-        # arrays: under a mesh the shifts then already carry the
+            # in-kernel gauge compression (QUDA reconstruct-12 analog),
+            # both kernel generations and the sharded path: resident
+            # link arrays shrink 288 -> 192 B/site.  r12f shares the
+            # R=2 storage; its scatter backward reads the unshifted
+            # opposite-parity links, so no backward copy exists.
+            if form in ("r12", "r12f"):
+                self.gauge_eo_pp = tuple(wpp.to_recon12(g)
+                                         for g in self.gauge_eo_pp)
+            elif form == "int8":
+                # block-float resident links: int8 mantissas + one f32
+                # scale per (direction, site), decompressed in-kernel
+                from ..ops import blockfloat as qbf
+                qs = [qbf.to_int8_links(g.astype(jnp.float32))
+                      for g in self.gauge_eo_pp]
+                self._gauge_q = tuple(q for q, _ in qs)
+                self._gauge_s = tuple(s for _, s in qs)
+                self.gauge_eo_pp = None
+            elif form == "bzfull":
+                # full-Z block admission (dtype-aware; single-buffered
+                # under the scoped window when the knob budget rejects
+                # double buffering) — raises when even that cannot fit,
+                # surfacing through the pallas_build escalation seam
+                Zd = self.dims[1]
+                self._block_z = wpp._pick_bz(
+                    Zd, gauge_eo_packed[0].shape[-1], store_dtype,
+                    planes=288, min_bz=Zd, allow_bzfull=True)
+        elif form == "int8":
+            # XLA stencil: decompress at setup via the codec round-trip
+            # — IDENTICAL floats to the in-kernel decompression, so the
+            # two routes build the same operator (bit-match tests rely
+            # on this)
+            from ..ops import blockfloat as qbf
+            self.gauge_eo_pp = tuple(
+                qbf.from_int8_links(*qbf.to_int8_links(
+                    g.astype(jnp.float32)))
+                for g in self.gauge_eo_pp)
+        # v2-family gather forms: resident pre-shifted backward links
+        # (the v3/r12f scatter kernels read the unshifted opposite-
+        # parity links directly — no resident copy).  Computed on the
+        # GLOBAL arrays: under a mesh the shifts then already carry the
         # cross-shard links, so the sharded exterior exchanges only psi
         # slabs (parallel/pallas_dslash.dslash_eo_pallas_sharded).
-        if use_pallas and pallas_version == 2:
+        if use_pallas and form not in ("r12f", "int8") and (
+                pallas_version == 2 or form in ("fold", "bzfull")):
             from ..ops import wilson_pallas_packed as wpp
             self._u_bw = tuple(
                 wpp.backward_gauge_eo(self.gauge_eo_pp[1 - p],
                                       tuple(self.dims), p)
                 for p in (0, 1))
+        if use_pallas and form == "fold":
+            # re/im-into-sublane fold: (…,2,T,Z,YX) -> (…,T,2Z,YX) so
+            # bf16 (16,128) tiles fill exactly; z shifts become row
+            # shifts by 2 (wilson_pallas_packed.to_fold)
+            from ..ops import wilson_pallas_packed as wpp
+            self.gauge_eo_pp = tuple(wpp.to_fold(g)
+                                     for g in self.gauge_eo_pp)
+            self._u_bw = tuple(wpp.to_fold(g) for g in self._u_bw)
         # multi-chip: run the sharded eo pallas policy under shard_map;
         # the resident links move onto the mesh once here
         self._mesh = mesh
@@ -345,6 +426,93 @@ class _PackedHopMixin:
                                        self._sharded_policy, "pinned",
                                        ici_bytes=self._ici_model_bytes())
 
+    def _downgrade_precision_form(self, form: str, use_pallas: bool,
+                                  mesh, legacy_r12: bool) -> str:
+        """Clamp a requested precision form to what the selected path
+        can serve — every downgrade leaves a one-time notice (nothing
+        takes effect silently).  The sharded mesh kernels speak full and
+        r12 only; the XLA stencil has no in-kernel decompression (int8
+        decompresses at setup instead; r12 storage stays full)."""
+        choices = ("auto", "full", "bzfull", "fold", "r12", "r12f",
+                   "int8")
+        if form not in choices:
+            raise ValueError(
+                f"precision form {form!r} not in {choices} "
+                "(QUDA_TPU_PRECISION_FORM)")
+        if mesh is not None:
+            served = {"auto": "r12" if legacy_r12 else "full",
+                      "r12f": "r12", "int8": "r12", "fold": "full",
+                      "bzfull": "full"}.get(form, form)
+            if served != form:
+                _notice_precision_form(
+                    form, served, "mesh-sharded kernels serve full/r12")
+            return served
+        if not use_pallas:
+            served = ("int8" if form == "int8" else "full")
+            if served != form and form not in ("full", "r12"):
+                # r12 -> full on XLA is the silent legacy behavior (the
+                # stencil has no R=2); pallas-only forms get a notice
+                _notice_precision_form(
+                    form, served, "XLA stencil path (no pallas kernels)")
+            return served
+        return form
+
+    def _race_precision_form(self, store_dtype) -> str:
+        """QUDA_TPU_PRECISION_FORM=auto: race the numerics-preserving
+        forms on concrete operands via utils.tune (QUDA's tune.cpp rule
+        — forms are timed, never assumed) and cache the winner in the
+        chip-keyed tunecache.  Candidate storages are built transiently
+        from the resident full links and dropped after the race; the
+        winner's storage is rebuilt by _setup_hop.  int8 never races —
+        block-float links change the operator's numerics, so they must
+        be an explicit opt-in."""
+        from ..ops import wilson_pallas_packed as wpp
+        from ..utils import tune as qtune
+        dims = tuple(self.dims)
+        T, Z, _, _ = dims
+        YXh = self.gauge_eo_pp[0].shape[-1]
+        itp, tb = self._pallas_interpret, self._tb_sign
+        g = self.gauge_eo_pp
+        ubw = tuple(wpp.backward_gauge_eo(g[1 - p], dims, p)
+                    for p in (0, 1))
+        g12 = tuple(wpp.to_recon12(x) for x in g)
+        ubw12 = tuple(wpp.to_recon12(x) for x in ubw)
+        gf = tuple(wpp.to_fold(x) for x in g)
+        ubwf = tuple(wpp.to_fold(x) for x in ubw)
+        cands = {
+            "full": lambda p: wpp.dslash_eo_pallas_packed(
+                g[0], ubw[0], p, dims, 0, interpret=itp, tb_sign=tb),
+            "r12": lambda p: wpp.dslash_eo_pallas_packed(
+                g12[0], ubw12[0], p, dims, 0, interpret=itp,
+                tb_sign=tb),
+            "r12f": lambda p: wpp.dslash_eo_pallas_packed_r12f(
+                g12[0], g12[1], p, dims, 0, interpret=itp, tb_sign=tb),
+            "fold": lambda p: wpp.from_fold(
+                wpp.dslash_eo_pallas_packed_fold(
+                    gf[0], ubwf[0], wpp.to_fold(p), dims, 0,
+                    interpret=itp, tb_sign=tb)),
+        }
+        try:
+            bzf = wpp._pick_bz(Z, YXh, store_dtype, planes=288,
+                               min_bz=Z, allow_bzfull=True)
+            cands["bzfull"] = lambda p: wpp.dslash_eo_pallas_packed(
+                g[0], ubw[0], p, dims, 0, interpret=itp, block_z=bzf,
+                tb_sign=tb)
+        except ValueError:
+            pass  # full-Z block busts even the scoped window: not a form
+        psi0 = jnp.zeros((4, 3, 2, T, Z, YXh), store_dtype)
+        aux = (f"v{self._pallas_version}|"
+               f"{jnp.dtype(store_dtype).name}")
+        warm = qtune.cached_param("wilson_eo_precision_form", dims,
+                                  aux=aux)
+        won = qtune.tune("wilson_eo_precision_form", dims, cands,
+                         (psi0,), aux=aux)
+        _notice_precision_form(
+            "auto", won,
+            "warm cache (chip-keyed tunecache)" if warm is not None
+            else "raced (QUDA_TPU_PRECISION_FORM=auto)")
+        return won
+
     def _d_to(self, psi_pp, target_parity, out_dtype):
         from ..ops import wilson_packed as wpk
         if self.use_pallas:
@@ -356,6 +524,31 @@ class _PackedHopMixin:
                               self._u_bw[target_parity], psi_pp)
                 return fn(self.gauge_eo_pp[target_parity],
                           self.gauge_eo_pp[1 - target_parity], psi_pp)
+            form = getattr(self, "_precision_form", None)
+            if form == "r12f":
+                return wpp.dslash_eo_pallas_packed_r12f(
+                    self.gauge_eo_pp[target_parity],
+                    self.gauge_eo_pp[1 - target_parity], psi_pp,
+                    tuple(self.dims), target_parity,
+                    interpret=self._pallas_interpret,
+                    out_dtype=out_dtype, tb_sign=self._tb_sign)
+            if form == "fold":
+                out = wpp.dslash_eo_pallas_packed_fold(
+                    self.gauge_eo_pp[target_parity],
+                    self._u_bw[target_parity], wpp.to_fold(psi_pp),
+                    tuple(self.dims), target_parity,
+                    interpret=self._pallas_interpret,
+                    out_dtype=out_dtype, tb_sign=self._tb_sign)
+                return wpp.from_fold(out)
+            if form == "int8":
+                return wpp.dslash_eo_pallas_packed_int8(
+                    self._gauge_q[target_parity],
+                    self._gauge_s[target_parity],
+                    self._gauge_q[1 - target_parity],
+                    self._gauge_s[1 - target_parity], psi_pp,
+                    tuple(self.dims), target_parity,
+                    interpret=self._pallas_interpret,
+                    out_dtype=out_dtype)
             if self._pallas_version == 3:
                 return wpp.dslash_eo_pallas_packed_v3(
                     self.gauge_eo_pp[target_parity],
@@ -367,6 +560,7 @@ class _PackedHopMixin:
                 self.gauge_eo_pp[target_parity],
                 self._u_bw[target_parity], psi_pp, tuple(self.dims),
                 target_parity, interpret=self._pallas_interpret,
+                block_z=getattr(self, "_block_z", None),
                 out_dtype=out_dtype, tb_sign=self._tb_sign)
         return wpk.dslash_eo_packed_pairs(self.gauge_eo_pp, psi_pp,
                                           self.dims, target_parity,
@@ -375,16 +569,33 @@ class _PackedHopMixin:
     def _d_to_mrhs(self, psi_b, target_parity, out_dtype):
         """Batched packed eo hop: psi_b (N,4,3,2,T,Z,Y*Xh).  The v2
         pallas path routes the MRHS kernel (one gauge-tile fetch per
-        (t, z-block), N spinor tiles streamed through it); everything
-        else falls back to the vmapped single-RHS stencil."""
-        if (self.use_pallas and getattr(self, "_mesh", None) is None
-                and self._pallas_version == 2):
+        (t, z-block), N spinor tiles streamed through it); r12f and
+        fold route their own MRHS kernels; everything else (int8, v3,
+        mesh, XLA) falls back to the vmapped single-RHS stencil."""
+        if self.use_pallas and getattr(self, "_mesh", None) is None:
             from ..ops import wilson_pallas_packed as wpp
-            return wpp.dslash_eo_pallas_packed_mrhs(
-                self.gauge_eo_pp[target_parity],
-                self._u_bw[target_parity], psi_b, tuple(self.dims),
-                target_parity, interpret=self._pallas_interpret,
-                out_dtype=out_dtype, tb_sign=self._tb_sign)
+            form = getattr(self, "_precision_form", None)
+            if form == "r12f":
+                return wpp.dslash_eo_pallas_packed_r12f_mrhs(
+                    self.gauge_eo_pp[target_parity],
+                    self.gauge_eo_pp[1 - target_parity], psi_b,
+                    tuple(self.dims), target_parity,
+                    interpret=self._pallas_interpret,
+                    out_dtype=out_dtype, tb_sign=self._tb_sign)
+            if form == "fold":
+                out = wpp.dslash_eo_pallas_packed_fold_mrhs(
+                    self.gauge_eo_pp[target_parity],
+                    self._u_bw[target_parity], wpp.to_fold(psi_b),
+                    tuple(self.dims), target_parity,
+                    interpret=self._pallas_interpret,
+                    out_dtype=out_dtype, tb_sign=self._tb_sign)
+                return wpp.from_fold(out)
+            if form != "int8" and self._pallas_version == 2:
+                return wpp.dslash_eo_pallas_packed_mrhs(
+                    self.gauge_eo_pp[target_parity],
+                    self._u_bw[target_parity], psi_b, tuple(self.dims),
+                    target_parity, interpret=self._pallas_interpret,
+                    out_dtype=out_dtype, tb_sign=self._tb_sign)
         return jax.vmap(
             lambda p: self._d_to(p, target_parity, out_dtype))(psi_b)
 
@@ -638,7 +849,8 @@ class DiracWilsonPCPacked:
               pallas_interpret: bool = False,
               pallas_version: int | None = None,
               mesh=None,
-              sharded_policy: str | None = None
+              sharded_policy: str | None = None,
+              precision_form: str | None = None
               ) -> "DiracWilsonPCPackedSloppy":
         """Pair-storage companion at an arbitrary storage dtype.
 
@@ -661,7 +873,8 @@ class DiracWilsonPCPacked:
         return DiracWilsonPCPackedSloppy(self, store_dtype, use_pallas,
                                          pallas_interpret, pallas_version,
                                          mesh=mesh,
-                                         sharded_policy=sharded_policy)
+                                         sharded_policy=sharded_policy,
+                                         precision_form=precision_form)
 
     def codec(self, precise_dtype, store_dtype=None):
         """StorageCodec matching this operator's sloppy representation
@@ -682,11 +895,13 @@ class DiracWilsonPCPackedSloppy(_PackedHopMixin, _PairSloppyBase):
     def __init__(self, dpk: "DiracWilsonPCPacked", store_dtype=jnp.bfloat16,
                  use_pallas: bool = False, pallas_interpret: bool = False,
                  pallas_version: int | None = None, mesh=None,
-                 sharded_policy: str | None = None):
+                 sharded_policy: str | None = None,
+                 precision_form: str | None = None):
         self._setup_hop(dpk.geom, dpk.gauge_eo_p, store_dtype,
                         use_pallas, pallas_interpret, pallas_version,
                         tb_sign=getattr(dpk._dpc, "antiperiodic_t", True),
-                        mesh=mesh, sharded_policy=sharded_policy)
+                        mesh=mesh, sharded_policy=sharded_policy,
+                        precision_form=precision_form)
         self.kappa = float(dpk.kappa)
         self.matpc = dpk.matpc
 

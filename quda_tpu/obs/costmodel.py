@@ -190,6 +190,24 @@ _FOOTPRINTS: Dict[str, dict] = {
                       "floor": lambda n: _G12 + 2 * _PSI},
     "wilson_mrhs": {"family": "wilson",
                     "floor": lambda n: 2 * _G / n + 2 * _PSI},
+    # precision storage forms (PERF.md round 16).  Floors are the
+    # distinct operand bytes of one invocation AT THE FORM'S STORAGE
+    # dtype — the bf16 rows halve the f32 basis, the int8 row charges
+    # 1-byte mantissas + the f32 scale planes (4 dirs x 4 B = 16/site
+    # per array).  r12f/int8 read here+there link arrays (no resident
+    # backward copy); fold keeps the v2 operand set in folded layout.
+    "wilson_v2_r12f": {"family": "wilson",
+                       "floor": lambda n: 2 * _G12 + 2 * _PSI},
+    "wilson_v2_fold": {"family": "wilson",
+                       "floor": lambda n: 2 * _G + 2 * _PSI},
+    "wilson_v2_bf16_fold": {"family": "wilson",
+                            "floor": lambda n: (2 * _G + 2 * _PSI) / 2},
+    "wilson_v2_bf16_bzfull": {"family": "wilson",
+                              "floor": lambda n:
+                              (2 * _G + 2 * _PSI) / 2},
+    "wilson_v2_int8": {"family": "wilson",
+                       "floor": lambda n: 2 * (_G / 4 + 16.0)
+                       + 2 * _PSI},
     "wilson_sharded_v2": {"alias": "wilson_v2"},
     "wilson_sharded_v2_r12": {"alias": "wilson_v2_r12"},
     "wilson_sharded_v3": {"alias": "wilson_v3"},
@@ -204,6 +222,16 @@ _FOOTPRINTS: Dict[str, dict] = {
                               "floor": lambda n: 2 * _G + 2 * _SPSI},
     "staggered_fat_naik_fused": {"family": "staggered_fat_naik",
                                  "floor": lambda n: 2 * _G + 2 * _SPSI},
+    # fused precision forms: non-eo operand basis like the fused row
+    # (fat + long link arrays + psi + out).  r12 swaps the long array
+    # for its R=2 storage + the streamed f32 sign plane (16 B/site);
+    # fold is a layout change at unchanged byte count
+    "staggered_fat_naik_fused_r12": {
+        "family": "staggered_fat_naik",
+        "floor": lambda n: _G + _G12 + 16.0 + 2 * _SPSI},
+    "staggered_fat_naik_fused_fold": {
+        "family": "staggered_fat_naik",
+        "floor": lambda n: 2 * _G + 2 * _SPSI},
     "staggered_mrhs": {"family": "staggered_fat_naik",
                        "floor": lambda n: 4 * _G / n + 2 * _SPSI},
     "staggered_fat_mrhs": {"family": "staggered_fat",

@@ -229,12 +229,19 @@ def sample(phase: str = "") -> List[dict]:
 # -- VMEM budget audit ------------------------------------------------------
 
 def vmem_audit(knob: str, block_bytes: int, budget_bytes: int,
-               bz: Optional[int] = None):
+               bz: Optional[int] = None, single_buffered: bool = False):
     """Record one ``_pick_bz`` decision: selected single-buffer working
-    set vs the knob's budget (ops/wilson_pallas_packed.py call site)."""
+    set vs the knob's budget (ops/wilson_pallas_packed.py call sites).
+    ``block_bytes`` is the PADDED tile working set — sublane rows at the
+    dtype's tile height (8 f32 / 16 bf16 / 32 int8), lanes padded to
+    128 — so the audit charges what the block really occupies.
+    ``single_buffered`` marks a full-block admission that only fits the
+    scoped window once (the bf16/int8 bz=Z fallback): Mosaic cannot
+    double-buffer it, so the pipeline serialises."""
     with _lock:
         _vmem_last[knob] = {"block_bytes": int(block_bytes),
-                            "budget_bytes": int(budget_bytes), "bz": bz}
+                            "budget_bytes": int(budget_bytes), "bz": bz,
+                            "single_buffered": bool(single_buffered)}
     from . import metrics as omet
     omet.set_gauge("vmem_block_bytes", block_bytes, knob=knob)
     omet.set_gauge("vmem_budget_bytes", budget_bytes, knob=knob)
@@ -256,5 +263,6 @@ def audit_vmem_budgets() -> List[dict]:
             "double_buffer_ok": mb <= SCOPED_VMEM_MB / 2,
             "last_block_bytes": last.get("block_bytes"),
             "last_bz": last.get("bz"),
+            "last_single_buffered": last.get("single_buffered", False),
         })
     return out

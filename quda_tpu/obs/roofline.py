@@ -47,6 +47,33 @@ KERNEL_MODELS: Dict[str, dict] = {
     # MRHS v2: psi 480 + out 96 + gauge 576/N per RHS (nrhs-dependent)
     "wilson_mrhs": {"flops_per_site": 1320,
                     "bytes_per_site": lambda nrhs: 576.0 + 576.0 / nrhs},
+    # precision storage forms (PERF.md round 16).  r12f = r12 storage
+    # + copy-free scatter backward on the gather psi path: gauge reads
+    # are g_here 192 + g_there xyz 144 + g_t plane 48 = 384 — exactly
+    # the r12 forward+backward-copy 2x192, so traffic EQUALS wilson_v2
+    # _r12; the win is residency (no 192 B/site backward array), not
+    # bandwidth.  684 B/site remains wilson_v3_r12's number.
+    "wilson_v2_r12f": {"flops_per_site": 1320, "bytes_per_site": 960},
+    # fold: re/im interleaved into sublane rows — same logical bytes as
+    # v2 at f32 (the fold changes tile SHAPE, not byte count)...
+    "wilson_v2_fold": {"flops_per_site": 1320, "bytes_per_site": 1152},
+    # ...but at bf16 storage the fold makes every (16,128) tile FULL
+    # (no half-empty sublane pads), so the moved bytes finally match
+    # the logical 2-byte element count: 1152/2
+    "wilson_v2_bf16_fold": {"flops_per_site": 1320,
+                            "bytes_per_site": 576},
+    # bf16 bz=Z full-block admission: same logical bf16 bytes; the row
+    # exists because the block schedule (one z-block, single-buffered
+    # when the budget rejects double buffering) is a distinct kernel
+    # configuration whose measured point must not silently drift into
+    # the blocked-bf16 attribution
+    "wilson_v2_bf16_bzfull": {"flops_per_site": 1320,
+                              "bytes_per_site": 576},
+    # int8 block-float links (r12f-style here+there reads, no resident
+    # backward copy): mantissas 4 dirs x 9 complex x 2 x 1 B = 72 for
+    # EACH of the here/there arrays + one f32 scale per (dir, site) x2
+    # arrays = 2x16 + psi 5x96 + out 96 -> 72+72+16+16+480+96 = 752
+    "wilson_v2_int8": {"flops_per_site": 1320, "bytes_per_site": 752},
     # sharded v2 interior (halo transport excluded from the model: it is
     # policy-dependent and O(surface); the trace carries the policy);
     # r12 variants mirror the single-chip subtraction
@@ -79,6 +106,20 @@ KERNEL_MODELS: Dict[str, dict] = {
     # (z boundary rows are O(1/bz)).  1.75x less traffic than two-pass
     "staggered_fat_naik_fused": {"flops_per_site": 1146,
                                  "bytes_per_site": 864},
+    # fused + Naik-link recon-12 (PERF.md round 16): the LONG links are
+    # ±SU(3) after KS-phase folding, so only that hop set compresses
+    # (fat links are smeared sums — not unitary, no reconstruction):
+    # long fwd 288 -> 192 (-96), long t-plane 72 -> 48 (-24), plus the
+    # streamed f32 sign plane 4x4 B = 16 and its t-plane 4:
+    # 864 - 96 - 24 + 16 + 4 = 764
+    "staggered_fat_naik_fused_r12": {"flops_per_site": 1146,
+                                     "bytes_per_site": 764},
+    # fused + re/im sublane fold: full R=3 rows, same logical bytes —
+    # the row exists for the bf16 full-tile A/B (tile shape, not byte
+    # count, is what changes; measured points must not alias the
+    # unfolded fused attribution)
+    "staggered_fat_naik_fused_fold": {"flops_per_site": 1146,
+                                      "bytes_per_site": 864},
     # MRHS staggered (gather two-pass body, links amortized over N):
     # improved = 2 passes x (psi 120 + out 24) + sum 72 + 1152/N links;
     # fat-only = one pass, no sum

@@ -60,6 +60,32 @@ def from_int8(f: Int8Field, dtype=jnp.complex64) -> jnp.ndarray:
     return (d[..., 0] + 1j * d[..., 1]).astype(dtype)
 
 
+def to_int8_links(gauge_pl: jnp.ndarray,
+                  eps: float = 1e-30) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Packed pair links (4, 3, 3, 2, T, Z, YX) f32 -> int8 block-float
+    resident storage: q (same shape, int8 mantissas) + scale
+    (4, T, Z, YX) f32, one scale per (direction, site) (max-abs over the
+    link's 18 reals — QUDA's quarter-precision gauge block, one norm
+    per link matrix).  The scale plane streams alongside the mantissas
+    and is multiplied back at link load (in-kernel, or via
+    ``from_int8_links`` for the XLA path); both routes see IDENTICAL
+    decompressed floats, so the pallas and stencil operators built from
+    one (q, scale) pair bit-match."""
+    g = gauge_pl.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(g), axis=(1, 2, 3))          # (4, T, Z, YX)
+    scale = jnp.maximum(amax, eps) / 127.0
+    q = jnp.clip(jnp.round(g / scale[:, None, None, None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def from_int8_links(q: jnp.ndarray, scale: jnp.ndarray,
+                    dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of ``to_int8_links``: decompressed packed pair links
+    (4, 3, 3, 2, T, Z, YX)."""
+    return (q.astype(jnp.float32) * scale[:, None, None, None]).astype(dtype)
+
+
 def compression_ratio(x: jnp.ndarray, codec: str,
                       dof_per_site: int = 12) -> float:
     """Bytes(original complex) / bytes(compressed), including the per-site

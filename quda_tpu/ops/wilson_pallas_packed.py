@@ -310,7 +310,8 @@ def _make_kernel(X: int, bz: int, eo: tuple | None = None,
 
 def _pick_bz(Z: int, YX: int, dtype=jnp.float32, planes: int = 288,
              min_bz: int = 1,
-             vmem_knob: str = "QUDA_TPU_PALLAS_VMEM_MB") -> int:
+             vmem_knob: str = "QUDA_TPU_PALLAS_VMEM_MB",
+             allow_bzfull: bool = False) -> int:
     """Divisor of Z maximising sublane-tile utilisation within the VMEM
     budget.
 
@@ -338,9 +339,20 @@ def _pick_bz(Z: int, YX: int, dtype=jnp.float32, planes: int = 288,
     passes its per-kernel override (QUDA_TPU_PALLAS_VMEM_MB_STAGGERED),
     whose raised default admits the fused fat+Naik working set.
 
+    ``allow_bzfull=True`` adds a LAST-RESORT full-block candidate: when
+    no divisor fits the double-buffered knob budget, bz=Z is admitted if
+    its working set fits the whole scoped-VMEM window SINGLE-buffered
+    (Mosaic cannot double-buffer a block it can only hold once — the
+    pipeline serialises, trading overlap for tile utilisation).  Callers
+    that race forms (the bf16 full-tile path) opt in; the default keeps
+    the long-standing fits-or-raises contract.
+
     Raises when even BZ=1 does not fit — callers fall back to the XLA
     packed path."""
-    sub = 16 if jnp.dtype(dtype).itemsize < 4 else 8
+    # sublane tile rows by itemsize: (8,128) f32, (16,128) bf16,
+    # (32,128) int8 — the audit must charge the PADDED tile, not the
+    # logical rows (a bf16 bz=24 block really holds 32 sublanes)
+    sub = {4: 8, 2: 16, 1: 32}[jnp.dtype(dtype).itemsize]
     nbytes = jnp.dtype(dtype).itemsize
     yx_pad = -(-YX // 128) * 128
     from ..utils import config as qconf
@@ -353,6 +365,14 @@ def _pick_bz(Z: int, YX: int, dtype=jnp.float32, planes: int = 288,
         bz_pad = -(-bz // sub) * sub
         if planes * bz_pad * yx_pad * nbytes <= budget:
             fitting.append((bz / bz_pad, bz, bz_pad))
+    single_buffered = False
+    if not fitting and allow_bzfull:
+        from ..obs import memory as omem
+        scoped = int(omem.SCOPED_VMEM_MB * 2 ** 20)
+        bz_pad = -(-Z // sub) * sub
+        if planes * bz_pad * yx_pad * nbytes <= scoped:
+            fitting.append((Z / bz_pad, Z, bz_pad))
+            single_buffered = True
     if not fitting:
         min_ws = planes * sub * yx_pad * nbytes / 2 ** 20
         hint = ("" if min_bz <= 1 else
@@ -369,7 +389,7 @@ def _pick_bz(Z: int, YX: int, dtype=jnp.float32, planes: int = 288,
         # + the fleet report's VMEM section (no-op when metrics off)
         from ..obs import memory as omem
         omem.vmem_audit(vmem_knob, planes * bz_pad * yx_pad * nbytes,
-                        budget, bz=bz)
+                        budget, bz=bz, single_buffered=single_buffered)
     except Exception:
         pass
     return bz
@@ -660,29 +680,23 @@ def _recon_acc(acc, uh, table):
         acc[3][c] = _cadd(acc[3][c], _cscale(t["d3"], uh[t["k3"]][c]))
 
 
-def _link_getter(ref, mu, row2_sign=None):
-    """Accessor (a, b) -> (re, im) link element from a packed gauge ref.
-
-    Dispatches on the ref's ROW extent: 3 = full 18-real storage; 2 =
-    reconstruct-12 (QUDA QUDA_RECONSTRUCT_12, gauge_field_order.h
-    Reconstruct<12>): rows 0-1 stored, row 2 = conj(row0 x row1) built
+def _recon12_wrap(stored, nrow: int, row2_sign=None):
+    """Wrap a stored-element accessor (a, b) -> (re, im) with the
+    reconstruct-12 row build (QUDA QUDA_RECONSTRUCT_12,
+    gauge_field_order.h Reconstruct<12>): for ``nrow == 3`` the accessor
+    passes through; for ``nrow == 2`` row 2 = conj(row0 x row1) is built
     on demand and memoised at trace time (each needed column computed
-    once per direction-use).
+    once per direction-use).  The SINGLE home for the recon algebra —
+    the full-link, folded-layout, and staggered accessors all wrap
+    through here, so every storage variant reconstructs with identical
+    float ops.
 
     ``row2_sign``: the t-boundary wrinkle — links are stored with the
     antiperiodic phase FOLDED IN, and for V = -U the cross product gives
     +u2 (the two -1s cancel), so the reconstructed row of a t-link on
-    the boundary plane must be re-negated.  Pass a (scalar) +-1 factor.
+    the boundary plane must be re-negated.  Pass a scalar (or
+    broadcastable plane of) +-1 factors.
     """
-    nrow = ref.shape[1]
-
-    def stored(a, b):
-        # full-link blocks are (4,R,3,2,1,bz,YX); boundary-ROW gauge
-        # inputs carry one extra singleton z axis (see psi_at)
-        pad = (0,) * (len(ref.shape) - 7)
-        return (ref[(mu, a, b, 0, 0) + pad].astype(F32),
-                ref[(mu, a, b, 1, 0) + pad].astype(F32))
-
     if nrow == 3:
         return stored
 
@@ -702,6 +716,22 @@ def _link_getter(ref, mu, row2_sign=None):
         return cache[b]
 
     return get
+
+
+def _link_getter(ref, mu, row2_sign=None):
+    """Accessor (a, b) -> (re, im) link element from a packed gauge ref.
+
+    Dispatches on the ref's ROW extent via ``_recon12_wrap``: 3 = full
+    18-real storage; 2 = in-kernel reconstruct-12."""
+
+    def stored(a, b):
+        # full-link blocks are (4,R,3,2,1,bz,YX); boundary-ROW gauge
+        # inputs carry one extra singleton z axis (see psi_at)
+        pad = (0,) * (len(ref.shape) - 7)
+        return (ref[(mu, a, b, 0, 0) + pad].astype(F32),
+                ref[(mu, a, b, 1, 0) + pad].astype(F32))
+
+    return _recon12_wrap(stored, ref.shape[1], row2_sign)
 
 
 def _make_kernel_v3(X: int, bz: int, eo: tuple | None = None,
@@ -1077,3 +1107,812 @@ def dslash_eo_pallas_packed_v3(u_here_pl: jnp.ndarray,
         interpret=interpret,
     )(psi_pl, psi_pl, psi_pl, rows_zp, rows_zm, u_here_pl, u_there_pl,
       u_there_pl, g_rows_zm)
+
+
+# -- folded re/im storage: full bf16 sublane tiles --------------------------
+#
+# Round 5 measured bf16 storage LOSING 5x to f32 (1103 vs 5673 GFLOPS)
+# for a layout reason, not a hardware one: no divisor of Z=24 fills a
+# (16,128) bf16 sublane tile, so every bf16 block ran at 50% load
+# utilisation.  The fold stores the re/im PAIR on the sublane axis —
+# (..., 2, T, Z, YX) becomes (..., T, 2Z, YX) with row 2k = re(z=k) and
+# row 2k+1 = im(z=k) — so a bz'=16 block holds 8 complete z-sites and
+# fills the bf16 tile exactly; z-shifts become row-shifts by 2.  The
+# kernel unfolds each tile into (re, im) f32 planes at load
+# (x.reshape(n, 2, YX) -> [:, 0] / [:, 1]: a sublane DEINTERLEAVE, not
+# a strided gather) and re-interleaves at the output write, so the hop
+# algebra between load and store is the v2 kernel's, float op for
+# float op — fold-vs-v2 at equal storage dtype is bitwise identical.
+
+
+def to_fold(pp: jnp.ndarray) -> jnp.ndarray:
+    """Pair layout (..., 2, T, Z, YX) -> folded (..., T, 2Z, YX): the
+    re/im axis interleaved into the sublane (z) axis, row 2k = re of
+    z=k, row 2k+1 = im.  Works for spinor pairs (4,3,2,T,Z,YX) and
+    packed links (4,R,3,2,T,Z,YX) alike (the axis -4 is the pair axis
+    in both)."""
+    *lead, two, T, Z, YX = pp.shape
+    if two != 2:
+        raise ValueError(f"axis -4 must be the re/im pair axis, got {two}")
+    m = jnp.moveaxis(pp, -4, -2)            # (..., T, Z, 2, YX)
+    return m.reshape(*lead, T, 2 * Z, YX)
+
+
+def from_fold(fp: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of ``to_fold``: (..., T, 2Z, YX) -> (..., 2, T, Z, YX)."""
+    *lead, T, Z2, YX = fp.shape
+    m = fp.reshape(*lead, T, Z2 // 2, 2, YX)
+    return jnp.moveaxis(m, -2, -4)
+
+
+def _unfold_tile(x):
+    """(2n, YX) interleaved tile -> (re, im) f32 planes of (n, YX) via a
+    sublane deinterleave (reshape + unit-index, no strided slicing)."""
+    n2, yx = x.shape
+    r = x.reshape(n2 // 2, 2, yx)
+    return (r[:, 0].astype(F32), r[:, 1].astype(F32))
+
+
+def _fold_tile(re, im, dtype):
+    """(re, im) (n, YX) planes -> one interleaved (2n, YX) tile."""
+    return jnp.stack([re, im], axis=1).reshape(
+        2 * re.shape[0], re.shape[1]).astype(dtype)
+
+
+def _fold_link_getter(ref, mu, row2_sign=None):
+    """_link_getter for folded gauge blocks (4, R, 3, 1, bz2, YX):
+    unfold each stored element, reconstruct row 2 in f32 if R == 2."""
+
+    def stored(a, b):
+        return _unfold_tile(ref[mu, a, b, 0])
+
+    return _recon12_wrap(stored, ref.shape[1], row2_sign)
+
+
+def _make_kernel_fold(X: int, bz2: int, eo: tuple | None = None,
+                      T: int | None = None, tb_sign: bool = True):
+    """v2 hop kernel on FOLDED tiles.  Ref shapes (bz2 = 2 * bz z-sites):
+      psi refs:   (4, 3, 1, bz2, YX) x5 (c, t+1, t-1, z+1, z-1)
+      g_c / g_m:  (4, R, 3, 1, bz2, YX) (forward / pre-shifted backward)
+    Accessors unfold to (re, im) f32 planes of (bz, YX); between load
+    and store the body is _make_kernel's, so same-storage results are
+    bitwise identical to the v2 kernel."""
+    from jax.experimental import pallas as pl
+
+    bz = bz2 // 2
+
+    def kernel(psi_c, psi_tp, psi_tm, psi_zp, psi_zm, g_c, g_m, out_ref):
+        shape = (bz, psi_c.shape[-1])
+        if eo is not None:
+            parity, Xh = eo
+            t_id = pl.program_id(0)
+            zb_id = pl.program_id(1)
+            z = (jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+                 + zb_id * bz)
+            y = jax.lax.broadcasted_iota(jnp.int32, shape, 1) // Xh
+            mask_r0 = ((t_id + z + y + parity) % 2) == 0
+
+        def shift_x(v, sign):
+            if eo is None:
+                return _shift_xy(v, 0, sign, X)
+            return _shift_x_eo(v, sign, eo[1], mask_r0)
+
+        def psi_at(ref, s, c):
+            return _unfold_tile(ref[s, c, 0])
+
+        def psi_row(ref, s, c, rows):
+            re, im = _unfold_tile(ref[s, c, 0])
+            return (re[rows], im[rows])
+
+        if g_c.shape[1] == 2 and tb_sign:
+            t_idx = pl.program_id(0)
+            s_t_fwd = jnp.where(t_idx == T - 1, -1.0, 1.0).astype(F32)
+            s_t_bwd = jnp.where(t_idx == 0, -1.0, 1.0).astype(F32)
+        else:
+            s_t_fwd = s_t_bwd = None
+
+        acc = [[(jnp.zeros(shape, F32), jnp.zeros(shape, F32))
+                for _ in range(3)] for _ in range(4)]
+
+        def project(get_psi, table):
+            t = table
+            return [[_cadd(get_psi(a, c),
+                           _cscale(t[f"c{a}"], get_psi(t[f"j{a}"], c)))
+                     for c in range(3)] for a in (0, 1)]
+
+        def color_acc(h, get_link, table, adjoint):
+            t = table
+            uh = [[None] * 3 for _ in range(2)]
+            for s in range(2):
+                for a in range(3):
+                    term = None
+                    for b in range(3):
+                        m = (_cmul_conj(get_link(b, a), h[s][b]) if adjoint
+                             else _cmul(get_link(a, b), h[s][b]))
+                        term = m if term is None else _cadd(term, m)
+                    uh[s][a] = term
+            for c in range(3):
+                acc[0][c] = _cadd(acc[0][c], uh[0][c])
+                acc[1][c] = _cadd(acc[1][c], uh[1][c])
+                acc[2][c] = _cadd(acc[2][c],
+                                  _cscale(t["d2"], uh[t["k2"]][c]))
+                acc[3][c] = _cadd(acc[3][c],
+                                  _cscale(t["d3"], uh[t["k3"]][c]))
+
+        for mu in (0, 1):
+            for sign, adjoint, gref in ((+1, False, g_c), (-1, True, g_m)):
+                t = TABLES[(mu, sign)]
+                h = project(lambda s, c: psi_at(psi_c, s, c), t)
+                if mu == 0:
+                    h = [[shift_x(h[a][c], sign) for c in range(3)]
+                         for a in (0, 1)]
+                else:
+                    h = [[_shift_xy(h[a][c], 1, sign,
+                                    X if eo is None else eo[1])
+                          for c in range(3)] for a in (0, 1)]
+                color_acc(h, _fold_link_getter(gref, mu), t, adjoint)
+        for sign, adjoint, gref, nb in ((+1, False, g_c, psi_zp),
+                                        (-1, True, g_m, psi_zm)):
+            t = TABLES[(2, sign)]
+            rows = slice(0, 1) if sign > 0 else slice(-1, None)
+            h = project(lambda s, c: psi_at(psi_c, s, c), t)
+            h_row = project(lambda s, c: psi_row(nb, s, c, rows), t)
+            h = [[_shift_z(h[a][c], h_row[a][c], sign) for c in range(3)]
+                 for a in (0, 1)]
+            color_acc(h, _fold_link_getter(gref, 2), t, adjoint)
+        for sign, adjoint, gref, nb, r2s in (
+                (+1, False, g_c, psi_tp, s_t_fwd),
+                (-1, True, g_m, psi_tm, s_t_bwd)):
+            t = TABLES[(3, sign)]
+            h = project(lambda s, c, nb=nb: psi_at(nb, s, c), t)
+            color_acc(h, _fold_link_getter(gref, 3, r2s), t, adjoint)
+
+        odt = out_ref.dtype
+        for s in range(4):
+            for c in range(3):
+                out_ref[s, c, 0] = _fold_tile(acc[s][c][0], acc[s][c][1],
+                                              odt)
+
+    return kernel
+
+
+def _fold_planes(R: int) -> int:
+    # 5 psi tiles (12 folded planes each) + 2 gauge tiles (4*R*3 each)
+    # + out (12), in (bz2, YX) planes
+    return 60 + 2 * 4 * R * 3 + 12
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "target_parity",
+                                             "interpret", "block_z2",
+                                             "out_dtype", "tb_sign"))
+def dslash_eo_pallas_packed_fold(u_here_f: jnp.ndarray,
+                                 u_bw_f: jnp.ndarray,
+                                 psi_f: jnp.ndarray, dims,
+                                 target_parity: int,
+                                 interpret: bool = False,
+                                 block_z2: int | None = None,
+                                 out_dtype=None,
+                                 tb_sign: bool = True) -> jnp.ndarray:
+    """Checkerboarded Wilson hop on FOLDED half-lattice arrays (see
+    ``to_fold``): u_here_f/u_bw_f (4,R,3,T,2Z,Y*Xh) forward /
+    pre-shifted backward links, psi_f (4,3,T,2Z,Y*Xh) parity-(1-p)
+    spinor.  Returns the folded layout.  Same-storage results bit-match
+    ``dslash_eo_pallas_packed``; at bf16 the folded blocks fill (16,128)
+    sublane tiles exactly (bz2=16 = 8 z-sites) instead of half-filling
+    them at bz=8."""
+    from jax.experimental import pallas as pl
+
+    T, Z, Y, X = dims
+    Xh = X // 2
+    R = u_here_f.shape[1]
+    _, _, _, Z2, YXh = psi_f.shape
+    bz2 = block_z2 if block_z2 is not None else _pick_bz(
+        Z2, YXh, psi_f.dtype, planes=_fold_planes(R), min_bz=2,
+        allow_bzfull=True)
+    if Z2 % bz2 != 0 or bz2 % 2 != 0:
+        raise ValueError(f"block_z2={bz2} must be even and divide 2Z={Z2}")
+    nzb = Z2 // bz2
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (4, 3, 1, bz2, YXh),
+            lambda t, zb, dt=dt, dz=dz: (0, 0, (t + dt) % T,
+                                         (zb + dz) % nzb, 0))
+
+    gauge_spec = pl.BlockSpec(
+        (4, R, 3, 1, bz2, YXh), lambda t, zb: (0, 0, 0, t, zb, 0))
+
+    kernel = _make_kernel_fold(X, bz2, eo=(target_parity, Xh), T=T,
+                               tb_sign=tb_sign)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(T, nzb),
+        in_specs=[psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
+                  psi_spec(0, +1), psi_spec(0, -1), gauge_spec,
+                  gauge_spec],
+        out_specs=pl.BlockSpec((4, 3, 1, bz2, YXh),
+                               lambda t, zb: (0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_f.shape,
+                                       out_dtype or psi_f.dtype),
+        interpret=interpret,
+    )(psi_f, psi_f, psi_f, psi_f, psi_f, u_here_f, u_bw_f)
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "target_parity",
+                                             "interpret", "block_z2",
+                                             "out_dtype", "tb_sign"))
+def dslash_eo_pallas_packed_fold_mrhs(u_here_f: jnp.ndarray,
+                                      u_bw_f: jnp.ndarray,
+                                      psi_f: jnp.ndarray, dims,
+                                      target_parity: int,
+                                      interpret: bool = False,
+                                      block_z2: int | None = None,
+                                      out_dtype=None,
+                                      tb_sign: bool = True) -> jnp.ndarray:
+    """Multi-RHS folded checkerboarded hop: psi_f (N,4,3,T,2Z,Y*Xh);
+    gauge tiles fetched once per (t, z-block) and shared by all N RHS
+    (RHS-innermost grid, as dslash_eo_pallas_packed_mrhs)."""
+    from jax.experimental import pallas as pl
+
+    T, Z, Y, X = dims
+    Xh = X // 2
+    R = u_here_f.shape[1]
+    N = psi_f.shape[0]
+    _, _, _, _, Z2, YXh = psi_f.shape
+    bz2 = block_z2 if block_z2 is not None else _pick_bz(
+        Z2, YXh, psi_f.dtype, planes=_fold_planes(R), min_bz=2,
+        allow_bzfull=True)
+    if Z2 % bz2 != 0 or bz2 % 2 != 0:
+        raise ValueError(f"block_z2={bz2} must be even and divide 2Z={Z2}")
+    nzb = Z2 // bz2
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (1, 4, 3, 1, bz2, YXh),
+            lambda t, zb, n, dt=dt, dz=dz: (n, 0, 0, (t + dt) % T,
+                                            (zb + dz) % nzb, 0))
+
+    gauge_spec = pl.BlockSpec(
+        (4, R, 3, 1, bz2, YXh), lambda t, zb, n: (0, 0, 0, t, zb, 0))
+
+    kernel = _mrhs_wrap(_make_kernel_fold(X, bz2,
+                                          eo=(target_parity, Xh), T=T,
+                                          tb_sign=tb_sign))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(T, nzb, N),
+        in_specs=[psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
+                  psi_spec(0, +1), psi_spec(0, -1), gauge_spec,
+                  gauge_spec],
+        out_specs=pl.BlockSpec((1, 4, 3, 1, bz2, YXh),
+                               lambda t, zb, n: (n, 0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_f.shape,
+                                       out_dtype or psi_f.dtype),
+        interpret=interpret,
+    )(psi_f, psi_f, psi_f, psi_f, psi_f, u_here_f, u_bw_f)
+
+
+# -- r12f: v2 gather pipeline, copy-free reconstruct-12 links ---------------
+#
+# The resident v2 reconstruct-12 path still materialises a PRE-SHIFTED
+# backward link copy (backward_gauge_eo) — half the gauge HBM footprint
+# again, and the array the sharded gauge-residency budget feels most.
+# r12f keeps the v2 GATHER psi pipeline (whole z-neighbour tiles — the
+# form that won on chip; PERF.md round 5) but takes the v3 kernels'
+# copy-free backward structure: backward x/y/z multiply the UNSHIFTED
+# opposite-parity links pointwise and shift the product (scatter form —
+# recon commutes with the shift, so reconstructing the local rows is
+# bitwise identical to reconstructing pre-shifted rows), backward-t
+# reads the U_t plane at t-1 via its index map.  HBM traffic equals
+# wilson_v2_r12 (960 B/site: the backward links cost the same bytes
+# read directly or via a copy) — what disappears is the resident copy
+# itself and its backward_gauge_eo precompute.
+
+
+def _make_kernel_r12f(X: int, bz: int, eo: tuple, T: int | None = None,
+                      tb_sign: bool = True):
+    """Copy-free v2-gather kernel over one (t, z-block) tile (eo only —
+    the solver hot path).  Ref shapes:
+      psi_c/tp/tm/zp/zm: (4, 3, 2, 1, bz, YX)   whole tiles (v2 gather)
+      g_c:               (4, R, 3, 2, 1, bz, YX) forward links (parity p)
+      g_there_xyz:       (3, R, 3, 2, 1, bz, YX) opposite-parity links
+      g_t_tm:            (1, R, 3, 2, 1, bz, YX) U_t plane at t-1
+      g_z_zm:            (1, R, 3, 2, 1, 1, YX)  U_z row at z-1
+    """
+    from jax.experimental import pallas as pl
+
+    def kernel(*refs):
+        (psi_c, psi_tp, psi_tm, psi_zp, psi_zm,
+         g_c, g_there_xyz, g_t_tm, g_z_zm, out_ref) = refs
+        parity, Xh = eo
+        t_id = pl.program_id(0)
+        zb_id = pl.program_id(1)
+        shape = psi_c.shape[-2:]
+        z = jax.lax.broadcasted_iota(jnp.int32, shape, 0) + zb_id * bz
+        y = jax.lax.broadcasted_iota(jnp.int32, shape, 1) // Xh
+        mask_r0 = ((t_id + z + y + parity) % 2) == 0
+
+        def shift_x(v, sign):
+            return _shift_x_eo(v, sign, Xh, mask_r0)
+
+        def psi_at(ref, s, c):
+            return (ref[s, c, 0, 0].astype(F32),
+                    ref[s, c, 1, 0].astype(F32))
+
+        def psi_row(ref, s, c, rows):
+            return (ref[s, c, 0, 0][rows].astype(F32),
+                    ref[s, c, 1, 0][rows].astype(F32))
+
+        if g_c.shape[1] == 2 and tb_sign:
+            t_idx = pl.program_id(0)
+            s_fwd = jnp.where(t_idx == T - 1, -1.0, 1.0).astype(F32)
+            s_bwd = jnp.where(t_idx == 0, -1.0, 1.0).astype(F32)
+        else:
+            s_fwd = s_bwd = None
+
+        acc = [[(jnp.zeros(shape, F32), jnp.zeros(shape, F32))
+                for _ in range(3)] for _ in range(4)]
+
+        # x, y: forward = project center, shift h, multiply U(x);
+        # backward = multiply U^dag(x) pointwise, shift the product
+        for mu in (0, 1):
+            tf = TABLES[(mu, +1)]
+            h = _project(lambda s, c: psi_at(psi_c, s, c), tf)
+            if mu == 0:
+                h = [[shift_x(h[a][c], +1) for c in range(3)]
+                     for a in (0, 1)]
+            else:
+                h = [[_shift_xy(h[a][c], 1, +1, Xh)
+                      for c in range(3)] for a in (0, 1)]
+            _recon_acc(acc, _color_mul(h, _link_getter(g_c, mu), False),
+                       tf)
+
+            tb = TABLES[(mu, -1)]
+            h = _project(lambda s, c: psi_at(psi_c, s, c), tb)
+            uh = _color_mul(h, _link_getter(g_there_xyz, mu), True)
+            if mu == 0:
+                uh = [[shift_x(uh[a][c], -1) for c in range(3)]
+                      for a in (0, 1)]
+            else:
+                uh = [[_shift_xy(uh[a][c], 1, -1, Xh)
+                       for c in range(3)] for a in (0, 1)]
+            _recon_acc(acc, uh, tb)
+
+        # z forward: splice the projected first row of the z+1 tile
+        tf = TABLES[(2, +1)]
+        h = _project(lambda s, c: psi_at(psi_c, s, c), tf)
+        h_row = _project(lambda s, c: psi_row(psi_zp, s, c, slice(0, 1)),
+                         tf)
+        h = [[_shift_z(h[a][c], h_row[a][c], +1) for c in range(3)]
+             for a in (0, 1)]
+        _recon_acc(acc, _color_mul(h, _link_getter(g_c, 2), False), tf)
+
+        # z backward: local product shifted down; the incoming row is
+        # the z-1 product from the z-1 tile's LAST row and the U_z row
+        tb = TABLES[(2, -1)]
+        h = _project(lambda s, c: psi_at(psi_c, s, c), tb)
+        uh = _color_mul(h, _link_getter(g_there_xyz, 2), True)
+        h_b = _project(lambda s, c: psi_row(psi_zm, s, c,
+                                            slice(-1, None)), tb)
+        uh_b = _color_mul(h_b, _link_getter(g_z_zm, 0), True)
+        uh = [[_shift_z(uh[a][c], uh_b[a][c], -1) for c in range(3)]
+              for a in (0, 1)]
+        _recon_acc(acc, uh, tb)
+
+        # t forward / backward: whole neighbour planes, no shift
+        tf = TABLES[(3, +1)]
+        h = _project(lambda s, c: psi_at(psi_tp, s, c), tf)
+        _recon_acc(acc, _color_mul(h, _link_getter(g_c, 3, s_fwd),
+                                   False), tf)
+        tb = TABLES[(3, -1)]
+        h = _project(lambda s, c: psi_at(psi_tm, s, c), tb)
+        _recon_acc(acc, _color_mul(h, _link_getter(g_t_tm, 0, s_bwd),
+                                   True), tb)
+
+        odt = out_ref.dtype
+        for s in range(4):
+            for c in range(3):
+                out_ref[s, c, 0, 0] = acc[s][c][0].astype(odt)
+                out_ref[s, c, 1, 0] = acc[s][c][1].astype(odt)
+
+    return kernel
+
+
+def _r12f_gz_rows(u_there_pl, R, T, nzb, bz, YXh):
+    """Pre-gathered U_z boundary rows at z-1 (the previous block's last
+    row of the mu=2 plane), shaped (1,R,3,2,T,nzb,1,YXh) so the block
+    extent 1 legally equals the array extent (see _make_kernel_v3)."""
+    g_r = u_there_pl[2:3].reshape(1, R, 3, 2, T, nzb, bz, YXh)
+    return jnp.roll(g_r[:, :, :, :, :, :, bz - 1, :], 1,
+                    axis=5)[:, :, :, :, :, :, None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "target_parity",
+                                             "interpret", "block_z",
+                                             "out_dtype", "tb_sign"))
+def dslash_eo_pallas_packed_r12f(u_here_pl: jnp.ndarray,
+                                 u_there_pl: jnp.ndarray,
+                                 psi_pl: jnp.ndarray, dims,
+                                 target_parity: int,
+                                 interpret: bool = False,
+                                 block_z: int | None = None,
+                                 out_dtype=None,
+                                 tb_sign: bool = True) -> jnp.ndarray:
+    """Checkerboarded Wilson hop, r12f form: the v2 gather pipeline with
+    NO resident backward-gauge copy.  u_here_pl (4,R,3,2,T,Z,Y*Xh)
+    forward links at target parity; u_there_pl the OPPOSITE-parity links
+    (unshifted — scatter-form backward hops shift the product).  R = 2
+    selects in-kernel reconstruct-12; results bit-match the resident
+    v2 r12 path (recon commutes with the site shift)."""
+    from jax.experimental import pallas as pl
+
+    T, Z, Y, X = dims
+    Xh = X // 2
+    R = u_here_pl.shape[1]
+    _, _, _, _, _, YXh = psi_pl.shape
+    # 5 psi tiles (120 planes) + g_c (4R*6) + g_there_xyz (3R*6) +
+    # g_t plane (R*6) + out (24)
+    bz = block_z if block_z is not None else _pick_bz(
+        Z, YXh, psi_pl.dtype, planes=144 + 48 * R)
+    if Z % bz != 0:
+        raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    nzb = Z // bz
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (4, 3, 2, 1, bz, YXh),
+            lambda t, zb, dt=dt, dz=dz: (0, 0, 0, (t + dt) % T,
+                                         (zb + dz) % nzb, 0))
+
+    g_here_spec = pl.BlockSpec(
+        (4, R, 3, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+    g_there_xyz_spec = pl.BlockSpec(
+        (3, R, 3, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+    g_t_spec = pl.BlockSpec(
+        (1, R, 3, 2, 1, bz, YXh),
+        lambda t, zb: (3, 0, 0, 0, (t - 1) % T, zb, 0))
+    g_z_spec = pl.BlockSpec(
+        (1, R, 3, 2, 1, 1, 1, YXh),
+        lambda t, zb: (0, 0, 0, 0, t, zb, 0, 0))
+
+    g_rows_zm = _r12f_gz_rows(u_there_pl, R, T, nzb, bz, YXh)
+    kernel = _make_kernel_r12f(X, bz, eo=(target_parity, Xh), T=T,
+                               tb_sign=tb_sign)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(T, nzb),
+        in_specs=[psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
+                  psi_spec(0, +1), psi_spec(0, -1),
+                  g_here_spec, g_there_xyz_spec, g_t_spec, g_z_spec],
+        out_specs=pl.BlockSpec((4, 3, 2, 1, bz, YXh),
+                               lambda t, zb: (0, 0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape,
+                                       out_dtype or psi_pl.dtype),
+        interpret=interpret,
+    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, u_here_pl, u_there_pl,
+      u_there_pl, g_rows_zm)
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "target_parity",
+                                             "interpret", "block_z",
+                                             "out_dtype", "tb_sign"))
+def dslash_eo_pallas_packed_r12f_mrhs(u_here_pl: jnp.ndarray,
+                                      u_there_pl: jnp.ndarray,
+                                      psi_pl: jnp.ndarray, dims,
+                                      target_parity: int,
+                                      interpret: bool = False,
+                                      block_z: int | None = None,
+                                      out_dtype=None,
+                                      tb_sign: bool = True) -> jnp.ndarray:
+    """Multi-RHS r12f hop: psi_pl (N,4,3,2,T,Z,Y*Xh); link tiles
+    fetched once per (t, z-block) and shared by all N RHS."""
+    from jax.experimental import pallas as pl
+
+    T, Z, Y, X = dims
+    Xh = X // 2
+    R = u_here_pl.shape[1]
+    N = psi_pl.shape[0]
+    YXh = psi_pl.shape[-1]
+    bz = block_z if block_z is not None else _pick_bz(
+        Z, YXh, psi_pl.dtype, planes=144 + 48 * R)
+    if Z % bz != 0:
+        raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    nzb = Z // bz
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (1, 4, 3, 2, 1, bz, YXh),
+            lambda t, zb, n, dt=dt, dz=dz: (n, 0, 0, 0, (t + dt) % T,
+                                            (zb + dz) % nzb, 0))
+
+    g_here_spec = pl.BlockSpec(
+        (4, R, 3, 2, 1, bz, YXh),
+        lambda t, zb, n: (0, 0, 0, 0, t, zb, 0))
+    g_there_xyz_spec = pl.BlockSpec(
+        (3, R, 3, 2, 1, bz, YXh),
+        lambda t, zb, n: (0, 0, 0, 0, t, zb, 0))
+    g_t_spec = pl.BlockSpec(
+        (1, R, 3, 2, 1, bz, YXh),
+        lambda t, zb, n: (3, 0, 0, 0, (t - 1) % T, zb, 0))
+    g_z_spec = pl.BlockSpec(
+        (1, R, 3, 2, 1, 1, 1, YXh),
+        lambda t, zb, n: (0, 0, 0, 0, t, zb, 0, 0))
+
+    g_rows_zm = _r12f_gz_rows(u_there_pl, R, T, nzb, bz, YXh)
+    kernel = _mrhs_wrap(_make_kernel_r12f(X, bz,
+                                          eo=(target_parity, Xh), T=T,
+                                          tb_sign=tb_sign))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(T, nzb, N),
+        in_specs=[psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
+                  psi_spec(0, +1), psi_spec(0, -1),
+                  g_here_spec, g_there_xyz_spec, g_t_spec, g_z_spec],
+        out_specs=pl.BlockSpec((1, 4, 3, 2, 1, bz, YXh),
+                               lambda t, zb, n: (n, 0, 0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape,
+                                       out_dtype or psi_pl.dtype),
+        interpret=interpret,
+    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, u_here_pl, u_there_pl,
+      u_there_pl, g_rows_zm)
+
+
+# -- int8 block-float resident links ----------------------------------------
+#
+# QUDA's quarter precision: links live in HBM as int8 mantissas with one
+# f32 scale per (direction, site) (ops/blockfloat.to_int8_links) and are
+# decompressed IN-KERNEL — q.astype(f32) * scale — so the link stream
+# shrinks 288 -> 72+16 B/site.  Full 3-row storage (no recon on top:
+# reconstructing from quantised rows would compound the quantisation
+# error into the derived row).  Structure is the r12f kernel's (copy-
+# free scatter backward), with each link ref paired to its scale-plane
+# ref.  int8 sublane tiles are (32,128): the working set accounts f32
+# planes at 8-row pads and int8 planes at 32-row pads separately
+# (_pick_bz_int8), falling back to a single-buffered full block like
+# the bf16 path when double-buffering cannot fit.
+
+
+def _int8_link_getter(qref, sref, mu):
+    """(a, b) -> (re, im) f32 link planes from an int8 mantissa ref and
+    its f32 per-(direction, site) scale-plane ref."""
+    pad_q = (0,) * (len(qref.shape) - 7)
+    pad_s = (0,) * (len(sref.shape) - 4)
+    s = sref[(mu, 0) + pad_s].astype(F32)
+
+    def get(a, b):
+        return (qref[(mu, a, b, 0, 0) + pad_q].astype(F32) * s,
+                qref[(mu, a, b, 1, 0) + pad_q].astype(F32) * s)
+
+    return get
+
+
+def _make_kernel_int8(X: int, bz: int, eo: tuple):
+    """int8-links kernel over one (t, z-block) tile (eo only).  Ref
+    shapes (q = int8 mantissas, s = f32 scales):
+      psi_c/tp/tm/zp/zm: (4, 3, 2, 1, bz, YX)  whole tiles (v2 gather)
+      q_c / s_c:         (4, 3, 3, 2, 1, bz, YX) / (4, 1, bz, YX)
+      q_there / s_there: (3, 3, 3, 2, 1, bz, YX) / (3, 1, bz, YX)
+      q_t_tm / s_t_tm:   (1, 3, 3, 2, 1, bz, YX) / (1, 1, bz, YX)
+      q_z_zm / s_z_zm:   (1, 3, 3, 2, 1, 1, 1, YX) / (1, 1, 1, 1, YX)
+    Decompression happens at link load; backward hops shift the product
+    AFTER the scale multiply, so each site's links use its own scale.
+    t-boundary signs need no special casing: the folded phase lives in
+    the stored rows (sign survives quantisation exactly)."""
+    from jax.experimental import pallas as pl
+
+    def kernel(*refs):
+        (psi_c, psi_tp, psi_tm, psi_zp, psi_zm,
+         q_c, s_c, q_there, s_there, q_t_tm, s_t_tm, q_z_zm, s_z_zm,
+         out_ref) = refs
+        parity, Xh = eo
+        t_id = pl.program_id(0)
+        zb_id = pl.program_id(1)
+        shape = psi_c.shape[-2:]
+        z = jax.lax.broadcasted_iota(jnp.int32, shape, 0) + zb_id * bz
+        y = jax.lax.broadcasted_iota(jnp.int32, shape, 1) // Xh
+        mask_r0 = ((t_id + z + y + parity) % 2) == 0
+
+        def shift_x(v, sign):
+            return _shift_x_eo(v, sign, Xh, mask_r0)
+
+        def psi_at(ref, s, c):
+            return (ref[s, c, 0, 0].astype(F32),
+                    ref[s, c, 1, 0].astype(F32))
+
+        def psi_row(ref, s, c, rows):
+            return (ref[s, c, 0, 0][rows].astype(F32),
+                    ref[s, c, 1, 0][rows].astype(F32))
+
+        acc = [[(jnp.zeros(shape, F32), jnp.zeros(shape, F32))
+                for _ in range(3)] for _ in range(4)]
+
+        for mu in (0, 1):
+            tf = TABLES[(mu, +1)]
+            h = _project(lambda s, c: psi_at(psi_c, s, c), tf)
+            if mu == 0:
+                h = [[shift_x(h[a][c], +1) for c in range(3)]
+                     for a in (0, 1)]
+            else:
+                h = [[_shift_xy(h[a][c], 1, +1, Xh)
+                      for c in range(3)] for a in (0, 1)]
+            _recon_acc(acc, _color_mul(h, _int8_link_getter(q_c, s_c, mu),
+                                       False), tf)
+
+            tb = TABLES[(mu, -1)]
+            h = _project(lambda s, c: psi_at(psi_c, s, c), tb)
+            uh = _color_mul(h, _int8_link_getter(q_there, s_there, mu),
+                            True)
+            if mu == 0:
+                uh = [[shift_x(uh[a][c], -1) for c in range(3)]
+                      for a in (0, 1)]
+            else:
+                uh = [[_shift_xy(uh[a][c], 1, -1, Xh)
+                       for c in range(3)] for a in (0, 1)]
+            _recon_acc(acc, uh, tb)
+
+        tf = TABLES[(2, +1)]
+        h = _project(lambda s, c: psi_at(psi_c, s, c), tf)
+        h_row = _project(lambda s, c: psi_row(psi_zp, s, c, slice(0, 1)),
+                         tf)
+        h = [[_shift_z(h[a][c], h_row[a][c], +1) for c in range(3)]
+             for a in (0, 1)]
+        _recon_acc(acc, _color_mul(h, _int8_link_getter(q_c, s_c, 2),
+                                   False), tf)
+
+        tb = TABLES[(2, -1)]
+        h = _project(lambda s, c: psi_at(psi_c, s, c), tb)
+        uh = _color_mul(h, _int8_link_getter(q_there, s_there, 2), True)
+        h_b = _project(lambda s, c: psi_row(psi_zm, s, c,
+                                            slice(-1, None)), tb)
+        uh_b = _color_mul(h_b, _int8_link_getter(q_z_zm, s_z_zm, 0), True)
+        uh = [[_shift_z(uh[a][c], uh_b[a][c], -1) for c in range(3)]
+              for a in (0, 1)]
+        _recon_acc(acc, uh, tb)
+
+        tf = TABLES[(3, +1)]
+        h = _project(lambda s, c: psi_at(psi_tp, s, c), tf)
+        _recon_acc(acc, _color_mul(h, _int8_link_getter(q_c, s_c, 3),
+                                   False), tf)
+        tb = TABLES[(3, -1)]
+        h = _project(lambda s, c: psi_at(psi_tm, s, c), tb)
+        _recon_acc(acc, _color_mul(h, _int8_link_getter(q_t_tm, s_t_tm, 0),
+                                   True), tb)
+
+        odt = out_ref.dtype
+        for s in range(4):
+            for c in range(3):
+                out_ref[s, c, 0, 0] = acc[s][c][0].astype(odt)
+                out_ref[s, c, 1, 0] = acc[s][c][1].astype(odt)
+
+    return kernel
+
+
+def _pick_bz_int8(Z: int, YX: int,
+                  vmem_knob: str = "QUDA_TPU_PALLAS_VMEM_MB") -> int:
+    """z-block pick for the int8-links kernel: MIXED dtype accounting.
+    f32 planes (5 psi + out = 144, + 8 scale planes) pad to 8 sublane
+    rows; int8 planes (q_c 72 + q_there 54 + q_t 18 = 144) pad to 32 —
+    an int8 bz=8 block really occupies a quarter-full (32,128) tile, so
+    candidates are ranked by int8-tile utilisation.  Falls back to a
+    single-buffered bz=Z block under the scoped-VMEM window when
+    double-buffering cannot fit (the bf16 full-tile admission rule)."""
+    f32_planes, int8_planes, scale_planes = 144, 144, 8
+    yx_pad = -(-YX // 128) * 128
+    from ..utils import config as qconf
+    budget = int(float(qconf.get(vmem_knob, fresh=True)) * 2 ** 20)
+
+    def working_set(bz):
+        pad8 = -(-bz // 8) * 8
+        pad32 = -(-bz // 32) * 32
+        return ((f32_planes + scale_planes) * pad8 * yx_pad * 4
+                + int8_planes * pad32 * yx_pad)
+
+    fitting = []
+    for bz in sorted({d for d in range(1, Z + 1) if Z % d == 0}):
+        if bz % 8 != 0 and bz != Z:
+            continue
+        if working_set(bz) <= budget:
+            fitting.append((bz / (-(-bz // 32) * 32), bz))
+    single_buffered = False
+    if not fitting:
+        from ..obs import memory as omem
+        if working_set(Z) <= int(omem.SCOPED_VMEM_MB * 2 ** 20):
+            fitting.append((Z / (-(-Z // 32) * 32), Z))
+            single_buffered = True
+    if not fitting:
+        raise ValueError(
+            f"no z-block of Z={Z} fits the VMEM budget at YX={YX} for "
+            "the int8-links kernel; fall back to the XLA decompress "
+            "path for this operator")
+    _, bz = max(fitting)
+    try:
+        from ..obs import memory as omem
+        omem.vmem_audit(vmem_knob, working_set(bz), budget, bz=bz,
+                        single_buffered=single_buffered)
+    except Exception:
+        pass
+    return bz
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "target_parity",
+                                             "interpret", "block_z",
+                                             "out_dtype"))
+def dslash_eo_pallas_packed_int8(q_here, s_here, q_there, s_there,
+                                 psi_pl: jnp.ndarray, dims,
+                                 target_parity: int,
+                                 interpret: bool = False,
+                                 block_z: int | None = None,
+                                 out_dtype=None) -> jnp.ndarray:
+    """Checkerboarded Wilson hop with int8 block-float resident links.
+
+    q_here/q_there: (4,3,3,2,T,Z,Y*Xh) int8 mantissas at the target /
+    opposite parity; s_here/s_there: (4,T,Z,Y*Xh) f32 per-(direction,
+    site) scales (see ops/blockfloat.to_int8_links); psi_pl:
+    (4,3,2,T,Z,Y*Xh) parity-(1-p) spinor.  Matches the XLA operator
+    built from from_int8_links(q, s) exactly (same decompressed floats,
+    same hop algebra)."""
+    from jax.experimental import pallas as pl
+
+    T, Z, Y, X = dims
+    Xh = X // 2
+    _, _, _, _, _, YXh = psi_pl.shape
+    bz = block_z if block_z is not None else _pick_bz_int8(Z, YXh)
+    if Z % bz != 0:
+        raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    nzb = Z // bz
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (4, 3, 2, 1, bz, YXh),
+            lambda t, zb, dt=dt, dz=dz: (0, 0, 0, (t + dt) % T,
+                                         (zb + dz) % nzb, 0))
+
+    q_here_spec = pl.BlockSpec(
+        (4, 3, 3, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+    s_here_spec = pl.BlockSpec(
+        (4, 1, bz, YXh), lambda t, zb: (0, t, zb, 0))
+    q_there_spec = pl.BlockSpec(
+        (3, 3, 3, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+    s_there_spec = pl.BlockSpec(
+        (3, 1, bz, YXh), lambda t, zb: (0, t, zb, 0))
+    q_t_spec = pl.BlockSpec(
+        (1, 3, 3, 2, 1, bz, YXh),
+        lambda t, zb: (3, 0, 0, 0, (t - 1) % T, zb, 0))
+    s_t_spec = pl.BlockSpec(
+        (1, 1, bz, YXh), lambda t, zb: (3, (t - 1) % T, zb, 0))
+    q_z_spec = pl.BlockSpec(
+        (1, 3, 3, 2, 1, 1, 1, YXh),
+        lambda t, zb: (0, 0, 0, 0, t, zb, 0, 0))
+    s_z_spec = pl.BlockSpec(
+        (1, 1, 1, 1, YXh), lambda t, zb: (0, t, zb, 0, 0))
+
+    # pre-gathered z-1 boundary rows of the opposite-parity U_z mantissa
+    # and scale planes (block extent 1 == array extent; see v3)
+    q_r = q_there[2:3].reshape(1, 3, 3, 2, T, nzb, bz, YXh)
+    q_rows_zm = jnp.roll(q_r[:, :, :, :, :, :, bz - 1, :], 1,
+                         axis=5)[:, :, :, :, :, :, None, :]
+    s_r = s_there[2:3].reshape(1, T, nzb, bz, YXh)
+    s_rows_zm = jnp.roll(s_r[:, :, :, bz - 1, :], 1,
+                         axis=2)[:, :, :, None, :]
+
+    kernel = _make_kernel_int8(X, bz, eo=(target_parity, Xh))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(T, nzb),
+        in_specs=[psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
+                  psi_spec(0, +1), psi_spec(0, -1),
+                  q_here_spec, s_here_spec, q_there_spec, s_there_spec,
+                  q_t_spec, s_t_spec, q_z_spec, s_z_spec],
+        out_specs=pl.BlockSpec((4, 3, 2, 1, bz, YXh),
+                               lambda t, zb: (0, 0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape,
+                                       out_dtype or psi_pl.dtype),
+        interpret=interpret,
+    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl,
+      q_here, s_here, q_there, s_there,
+      q_there, s_there, q_rows_zm, s_rows_zm)

@@ -5,7 +5,12 @@ QUDA threads sloppy/precise operator pairs through every solver
 and lib/inv_cg_quda.cpp).  The TPU precision ladder differs from CUDA's
 {double,single,half,quarter}: the compute dtypes are
 {float64 (CPU only), float32/complex64, bfloat16-pair} — see
-utils/precision.py.  Two strategies are provided:
+utils/precision.py.  'quarter' drops the LINKS (not the iterates) to
+int8 block-float storage — ops/blockfloat.to_int8_links resident gauge,
+decompressed at link load inside the kernel, served under the df64
+reliable update (interfaces/quda_api._invert_wilson_df64 +
+models/wilson precision_form="int8"); spinor iterates stay bf16 pairs,
+so the codecs below are unchanged.  Two strategies are provided:
 
 * ``cg_reliable``: QUDA-style in-loop reliable updates — iterate entirely in
   the sloppy precision inside one lax.while_loop; when the sloppy residual

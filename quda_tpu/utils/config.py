@@ -151,6 +151,31 @@ _register("QUDA_TPU_RECONSTRUCT", "choice", "18",
           ("18", "12"),
           reference="QUDA_RECONSTRUCT / gauge_field_order.h "
                     "Reconstruct<12>")
+_register("QUDA_TPU_PRECISION_FORM", "choice", "",
+          "link storage / precision form for the packed pallas Wilson "
+          "operator (PERF.md round 16): 'full' = resident 18-real "
+          "links; 'r12' = two rows + in-kernel third-row recon "
+          "(192 B/site, both kernel generations and the sharded path); "
+          "'r12f' = r12 storage + scatter backward (no resident "
+          "backward-link copy — the v3 trick on the v2 gather psi "
+          "path); 'fold' = re/im interleaved into sublanes "
+          "((...,2,T,Z,YX) -> (...,T,2Z,YX)) so bf16 (16,128) tiles "
+          "fill exactly; 'bzfull' = full-Z block admission (single-"
+          "buffered under the 16 MB scoped window when the budget knob "
+          "rejects double buffering); 'int8' = block-float resident "
+          "links (int8 mantissas + one f32 scale per direction/site, "
+          "decompressed in-kernel) — changes the operator's floats, so "
+          "it must be served under the df64 reliable-update correction "
+          "for deep tolerances; 'auto' = race the numerics-preserving "
+          "forms via utils.tune (int8 NEVER races); '' = legacy "
+          "resolution via QUDA_TPU_RECONSTRUCT.  Read at operator "
+          "construction only (storage layout is baked into the "
+          "resident arrays), hence NOT trace-safe",
+          ("", "auto", "full", "bzfull", "fold", "r12", "r12f", "int8"),
+          reference="QUDA_RECONSTRUCT x QUDA_PRECISION link-storage "
+                    "matrix (gauge_field_order.h Reconstruct<12> + "
+                    "quarter-precision block-float norm arrays)",
+          trace_safe=False)
 _register("QUDA_TPU_PALLAS_VERSION", "int", 2,
           "pallas kernel generation: 2 = gather kernels with "
           "pre-shifted backward links, 3 = scatter-form backward hops "

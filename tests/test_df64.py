@@ -135,6 +135,7 @@ def test_df64_operator_adjointness(rng):
 
 # -- deep-tolerance solve ----------------------------------------------------
 
+@pytest.mark.slow        # ~5 min XLA CPU compile of the df64 CG loop
 def test_cg_df64_reaches_1e10(rng):
     """CG with df64 reliable updates to true_res <= 1e-10, verified by
     recomputing the FULL-lattice residual of (hi + lo) under the exact
@@ -174,6 +175,7 @@ def test_cg_df64_reaches_1e10(rng):
     assert np.sqrt(r2 / b2) < 1e-10
 
 
+@pytest.mark.slow        # ~5 min XLA CPU compile of the df64 CG loop
 def test_invert_quda_df64_route(rng, monkeypatch):
     """API route: single-precision invert at tol 1e-10 engages the df64
     path automatically and certifies the full true residual."""

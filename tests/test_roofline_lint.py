@@ -42,6 +42,19 @@ def _wilson_ops():
                                         (None, object())):
         yield _mk("DiracWilsonPCPackedPairs", use_pallas=True,
                   _pallas_version=v, gauge_eo_pp=g, _mesh=mesh)
+    # precision storage forms (round 16): every (_precision_form,
+    # store_dtype) pair the operator can serve single-chip must label
+    # to a modeled row (int8 has gauge_eo_pp=None — the label path
+    # must not trip on the missing link array)
+    import jax.numpy as jnp
+    for pform, store in itertools.product(
+            ("full", "r12", "r12f", "fold", "bzfull", "int8"),
+            (jnp.float32, jnp.bfloat16)):
+        g = None if pform == "int8" else (
+            g12[0:1] if pform in ("r12", "r12f") else g18)
+        yield _mk("DiracWilsonPCPackedPairs", use_pallas=True,
+                  _pallas_version=2, gauge_eo_pp=g, _mesh=None,
+                  _precision_form=pform, store_dtype=store)
     yield _mk("DiracWilsonPCPackedPairs", use_pallas=False)
 
 
@@ -55,6 +68,12 @@ def _staggered_ops():
                   _pallas_form=form,
                   long_eo_pp=(object(),) if improved else None,
                   _mesh=mesh)
+    # fused precision storage forms (round 16): improved only, single
+    # chip only (models/staggered.py downgrades everything else)
+    for pform in ("full", "r12", "fold"):
+        yield _mk("DiracStaggeredPCPairs", use_pallas=True,
+                  _pallas_form="fused", long_eo_pp=(object(),),
+                  _mesh=None, _precision_form=pform)
     yield _mk("DiracStaggeredPCPairs", use_pallas=False,
               long_eo_pp=None)
 
