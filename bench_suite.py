@@ -1257,6 +1257,66 @@ def main(argv):
                 sharded_case("wilson_eo_sharded_v3_fused_halo_24", "v3",
                              "fused_halo")
 
+                # A/B 3 (round 18): mesh SHAPE at fixed (v2, facefix)
+                # kernel+transport — 1D vs 2D vs 3D decomposition of the
+                # same lattice, each row carrying the analytic per-axis
+                # ICI bytes (wilson_eo_halo_model's "axes" split) so
+                # --compare --dry trends where the halo budget moves as
+                # lattice axes join the device mesh.  Shapes re-use the
+                # operand fields above via cross-mesh device_put (n_x=1
+                # everywhere, so the fused y*xh axis needs no block
+                # relayout).
+                shape_cands = [
+                    s for s in ((2, 1, 1, 1), (2, 2, 1, 1),
+                                (2, 2, 2, 1), (2, 2, 2, 2))
+                    if int(np.prod(s)) <= n_dev
+                    and all(Lsh % n == 0 and (Lsh // n) % 2 == 0
+                            for n in s[:3])
+                    and (Lsh // 2) % s[3] == 0]
+                for shape_m in shape_cands:
+                    nd_m = int(np.prod(shape_m))
+                    name_m = ("wilson_eo_sharded_v2_mesh"
+                              + "x".join(str(v) for v in shape_m)
+                              + "_24")
+                    try:
+                        mesh_m = make_lattice_mesh(
+                            grid=shape_m, n_src=1,
+                            devices=jax.devices()[:nd_m])
+                        pspec_m = P(None, None, None, "t", "z",
+                                    ("y", "x"))
+                        gspec_m = P(None, None, None, None, "t", "z",
+                                    ("y", "x"))
+                        put = lambda a, sp: jax.device_put(
+                            a, NamedSharding(mesh_m, sp))
+                        uh_m = put(uh, gspec_m)
+                        ub_m = put(u_bw, gspec_m)
+                        psi_m = put(psi_sh, pspec_m)
+                        fn_m = qcompat.shard_map(
+                            lambda a, b, p: dslash_eo_pallas_sharded(
+                                a, b, p, dims_sh, 0, mesh_m,
+                                policy="xla_facefix"),
+                            mesh=mesh_m,
+                            in_specs=(gspec_m, gspec_m, pspec_m),
+                            out_specs=pspec_m)
+                        model_m = qcomms.wilson_eo_halo_model(
+                            dims_sh, shape_m)
+                        secs = _bench_op(lambda a, b, p: fn_m(a, b, p),
+                                         psi_m, consts=(uh_m, ub_m),
+                                         n1=4, n2=40)
+                        _emit("sharded", name_m, secs, fl_sh, bts_sh,
+                              platform, (Lsh,) * 4, banner=banner,
+                              mesh=list(shape_m), form="v2",
+                              policy="xla_facefix", devices=nd_m,
+                              ici_gb=round(model_m["total"] / 1e9, 6),
+                              ici_gb_axes={
+                                  a: round(b * nd_m / 1e9, 6)
+                                  for a, b in
+                                  model_m["axes"].items()})
+                    except Exception as e:
+                        print(json.dumps({
+                            "suite": "sharded", "name": name_m,
+                            "error": str(e)[:140]}), flush=True)
+
     if "gauge" in suites and suite_guard("gauge"):
         # complex-free gauge/HMC sector (pair representation — the only
         # form the axon TPU executes; gauge/pair tests pin it against the

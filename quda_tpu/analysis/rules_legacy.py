@@ -195,6 +195,26 @@ _COMMS_SEAMS = (
     ("quda_tpu/parallel/pallas_halo.py", "wilson_zbwd_fused_halo"),
 )
 
+# round 18: the y/x exchange seams.  The strided x column exchange
+# (_eo_x_psi_sources) and the two column-face fixes that consume it are
+# INTERNAL to parallel/pallas_dslash — every transfer they stage rides
+# the exchange() callable built by _make_exchange inside a comms scope,
+# which is what labels their ledger rows with (site, policy, axis).
+# Calling them from anywhere else bypasses that attribution.
+_YX_SEAM_FNS = frozenset({"_eo_x_psi_sources", "_wilson_eo_fix_x",
+                          "_stag_eo_fix_x"})
+
+# (function, required callee) wiring pinned inside pallas_dslash: the x
+# column exchange must route through the policy seam, both column-face
+# fixes must source their halos from it, and the per-axis face plan
+# must exist so every new axis seam enumerates through ONE place
+_YX_SEAM_WIRING = (
+    ("_eo_x_psi_sources", "exchange"),
+    ("_wilson_eo_fix_x", "_eo_x_psi_sources"),
+    ("_stag_eo_fix_x", "_eo_x_psi_sources"),
+    ("_axis_plan", "_FaceIO"),
+)
+
 
 @rule("comms-ledger",
       "ppermute has ONE home (parallel/halo._permute_slice), "
@@ -225,6 +245,12 @@ def check_comms_ledger(index, mod):
                 yield (call.lineno,
                        f"slab_exchange_bidir called in {fn.name}() "
                        "outside the _make_exchange policy seam")
+            if name in _YX_SEAM_FNS and not is_dslash:
+                yield (call.lineno,
+                       f"{name}() (y/x exchange seam) called in "
+                       f"{fn.name}() outside parallel/pallas_dslash — "
+                       "the comms scope that labels its ledger rows "
+                       "with (site, policy, axis) is bypassed")
         if is_dslash and fn.name != "_make_exchange" \
                 and _calls_in(mod, fn, {"_make_exchange"}) \
                 and not _calls_in(mod, fn, {"scope"}):
@@ -250,6 +276,22 @@ def check_comms_seams(index):
             yield (rel, fn.lineno,
                    f"exchange seam {fname}() records nothing into the "
                    "comms ledger (record_exchange missing)")
+    rel = "quda_tpu/parallel/pallas_dslash.py"
+    mod = index.get(rel)
+    if mod is None:
+        yield (rel, 1, "y/x exchange-seam module missing from the "
+                       "package index")
+    else:
+        for fname, callee in _YX_SEAM_WIRING:
+            fn = _function(mod, fname)
+            if fn is None:
+                yield (rel, 1, f"y/x exchange seam {fname}() not found "
+                               "— the comms ledger pins this name")
+            elif not _calls_in(mod, fn, {callee}):
+                yield (rel, fn.lineno,
+                       f"y/x exchange seam {fname}() does not route "
+                       f"through {callee}() — its transfer ships "
+                       "outside the ledgered policy seam")
     rel = "quda_tpu/parallel/split.py"
     mod = index.get(rel)
     fn = _function(mod, "split_grid_solve") if mod else None

@@ -284,12 +284,16 @@ class DiracStaggeredPCPairs:
         if mesh is not None and getattr(mesh, "size", 2) == 1:
             mesh = None
         self._mesh = mesh
+        self._mesh_yx = None
         if mesh is not None:
             if not use_pallas:
                 raise ValueError(
                     "mesh-sharded staggered pair operators need "
                     "use_pallas=True (the XLA pair stencil shards via "
                     "GSPMD instead)")
+            ms = dict(mesh.shape)
+            yx_mesh = (int(ms.get("y", 1)) > 1
+                       or int(ms.get("x", 1)) > 1)
             if form in ("auto", "fused"):
                 # sharded exteriors exist for the gather and scatter
                 # two-pass forms; fused-under-mesh is future work, and
@@ -300,10 +304,24 @@ class DiracStaggeredPCPairs:
                     "two_pass", None,
                     f"mesh pins two_pass (requested {form})")
                 form = "two_pass"
+            elif form == "v3" and yx_mesh:
+                # the scatter exterior shards t/z only: y/x-partitioned
+                # meshes pin the gather two-pass form
+                _notice_staggered_form(
+                    "two_pass", None,
+                    "v3 scatter exterior shards t/z only; y/x mesh "
+                    "pins two_pass")
+                form = "two_pass"
             self._sharded_policy = (
                 sharded_policy
                 or str(qconf.get("QUDA_TPU_SHARDED_POLICY", fresh=True))
                 or "auto")
+            from ..parallel.pallas_dslash import (
+                SHARDED_POLICIES, notice_legacy_single_policy)
+            if self._sharded_policy in SHARDED_POLICIES:
+                # bare single-value form: maps onto every partitioned
+                # axis, with a one-time deprecation-style notice
+                notice_legacy_single_policy(self._sharded_policy)
         elif use_pallas and form == "auto":
             from ..utils import tune as qtune
             default = "fused" if improved else "two_pass"
@@ -378,9 +396,28 @@ class DiracStaggeredPCPairs:
         if mesh is not None:
             if form == "two_pass":
                 self._ensure_bw()
+            # y/x-partitioned meshes: re-order the trailing fused Y·Xh
+            # axis into the block-contiguous layout ONCE, after the
+            # backward pre-shift (which needs the natural global
+            # order), so the ("y","x") PartitionSpec hands every shard
+            # whole local rows at the LOCAL row width
+            from ..parallel.pallas_dslash import _mesh_counts
+            _, _, n_y, n_x = _mesh_counts(mesh)
+            self._mesh_yx = (n_y, n_x)
+            if n_x > 1:
+                from ..parallel import mesh as qmesh
+                _, _, Y, X = self.dims
+                rl = lambda gs: (tuple(
+                    qmesh.fuse_block_layout(g, n_y, n_x, Y, X // 2)
+                    for g in gs) if gs is not None else None)
+                self.fat_eo_pp = rl(self.fat_eo_pp)
+                self.long_eo_pp = rl(self.long_eo_pp)
+                self._fat_bw = rl(self._fat_bw)
+                self._long_bw = rl(self._long_bw)
             from jax.sharding import NamedSharding, PartitionSpec as P
             gspec = NamedSharding(
-                mesh, P(None, None, None, None, "t", "z", None))
+                mesh,
+                P(None, None, None, None, "t", "z", ("y", "x")))
             put = lambda gs: (tuple(jax.device_put(g, gspec)
                                     for g in gs)
                               if gs is not None else None)
@@ -394,7 +431,13 @@ class DiracStaggeredPCPairs:
                 # candidates is impossible)
                 self._resolve_sharded_policy(self.matpc, None)
             else:
-                _notice_staggered_form(form, self._sharded_policy,
+                from ..parallel.pallas_dslash import (
+                    _policy_label, resolve_axis_policies)
+                pols = resolve_axis_policies(self._sharded_policy)
+                self._sharded_policy = pols
+                live = [a for a, n in zip(("t", "z", "y", "x"),
+                                          _mesh_counts(mesh)) if n > 1]
+                _notice_staggered_form(form, _policy_label(pols, live),
                                        "pinned")
 
     def _ensure_bw(self):
@@ -553,17 +596,18 @@ class DiracStaggeredPCPairs:
             "staggered_eo_form", self.dims, cands, (psi0,), aux=aux)
 
     # -- sharded dispatch (the QUDA_TPU_SHARDED_POLICY seam) ------------
-    def _build_sharded_fn(self, target_parity, out_dtype, policy: str):
+    def _build_sharded_fn(self, target_parity, out_dtype, policy):
         """jitted shard_map of the sharded staggered eo policy for one
-        (parity, out_dtype, halo policy) configuration."""
+        (parity, out_dtype, halo policy) configuration; ``policy`` is
+        anything resolve_axis_policies accepts."""
         from jax.sharding import PartitionSpec as P
 
         from ..parallel import compat
         from ..parallel.pallas_dslash import (
             dslash_staggered_eo_pallas_sharded,
             dslash_staggered_eo_pallas_sharded_v3)
-        pspec = P(None, None, "t", "z", None)
-        gspec = P(None, None, None, None, "t", "z", None)
+        pspec = P(None, None, "t", "z", ("y", "x"))
+        gspec = P(None, None, None, None, "t", "z", ("y", "x"))
         improved = self.long_eo_pp is not None
         odt = out_dtype or self.store_dtype
 
@@ -603,45 +647,67 @@ class DiracStaggeredPCPairs:
                   else self.long_eo_pp[1 - p])
         return (self.fat_eo_pp[p], second, self.long_eo_pp[p], fourth)
 
-    def _resolve_sharded_policy(self, target_parity, out_dtype) -> str:
-        """'auto' races every registered halo policy on REAL
-        shard-resident operands via utils.tune and caches the winner per
-        (volume, mesh, form) — the Wilson policy engine covering
-        staggered through the same seam."""
+    def _resolve_sharded_policy(self, target_parity, out_dtype):
+        """'auto' races every PARTITIONED mesh axis independently on
+        REAL shard-resident operands via utils.tune, greedily (each
+        axis race pins its winner before the next races) and caches
+        per (volume, mesh, form, axis) — the Wilson per-axis policy
+        engine covering staggered through the same seam."""
+        from ..parallel.pallas_dslash import (AXIS_NAMES,
+                                              FUSED_HALO_AXES,
+                                              SHARDED_POLICIES,
+                                              _mesh_counts,
+                                              _policy_label,
+                                              resolve_axis_policies)
         pol = self._sharded_policy
         if pol != "auto":
-            return pol
+            return resolve_axis_policies(pol)
         won = getattr(self, "_sharded_policy_winner", None)
         if won is not None:
             return won
-        from ..parallel.pallas_dslash import SHARDED_POLICIES
         from ..utils import tune as qtune
-        cands = {p: self._build_sharded_fn(target_parity, out_dtype, p)
-                 for p in SHARDED_POLICIES}
+        counts = _mesh_counts(self._mesh)
+        live = [a for a, n in zip(AXIS_NAMES, counts) if n > 1]
         from jax.sharding import NamedSharding, PartitionSpec as P
         T, Z, _, _ = self.dims
         yxh = self.fat_eo_pp[0].shape[-1]
         psi0 = jax.device_put(
             jnp.zeros((3, 2, T, Z, yxh), self.store_dtype),
-            NamedSharding(self._mesh, P(None, None, "t", "z", None)))
+            NamedSharding(self._mesh,
+                          P(None, None, "t", "z", ("y", "x"))))
         mesh_shape = tuple(int(self._mesh.shape[a])
                            for a in self._mesh.axis_names)
         aux = (f"{self._pallas_form}|mesh{mesh_shape}|"
                f"{jnp.dtype(self.store_dtype).name}")
-        warm = qtune.cached_param("staggered_eo_sharded_policy",
-                                  self.dims, aux=aux)
-        won = qtune.tune(
-            "staggered_eo_sharded_policy", self.dims, cands,
-            self._sharded_args(target_parity) + (psi0,), aux=aux)
-        self._sharded_policy_winner = won
+        pols = {a: "xla_facefix" for a in AXIS_NAMES}
+        warm, seeded = True, None
+        for ax in live:
+            axis_cands = [p for p in SHARDED_POLICIES
+                          if p == "xla_facefix" or ax in FUSED_HALO_AXES]
+            if len(axis_cands) < 2:
+                continue    # x: only the facefix transport serves it
+            cands = {p: self._build_sharded_fn(
+                        target_parity, out_dtype, dict(pols, **{ax: p}))
+                     for p in axis_cands}
+            name = f"staggered_eo_sharded_policy_{ax}"
+            warm = warm and (qtune.cached_param(
+                name, self.dims, aux=aux) is not None)
+            pols[ax] = qtune.tune(
+                name, self.dims, cands,
+                self._sharded_args(target_parity) + (psi0,), aux=aux)
+            seeded = cands[pols[ax]]
+        self._sharded_policy_winner = pols
         key = (target_parity,
                jnp.dtype(out_dtype or self.store_dtype).name)
-        self.__dict__.setdefault("_sharded_fns", {})[key] = cands[won]
+        if seeded is None:
+            seeded = self._build_sharded_fn(target_parity, out_dtype,
+                                            dict(pols))
+        self.__dict__.setdefault("_sharded_fns", {})[key] = seeded
         _notice_staggered_form(
-            self._pallas_form, won,
-            "warm cache (chip-keyed tunecache)" if warm is not None
+            self._pallas_form, _policy_label(pols, live),
+            "warm cache (chip-keyed tunecache)" if warm
             else "raced+cached (QUDA_TPU_SHARDED_POLICY=auto)")
-        return won
+        return pols
 
     def _sharded_d_to(self, target_parity, out_dtype):
         cache = self.__dict__.setdefault("_sharded_fns", {})
@@ -768,17 +834,34 @@ class DiracStaggeredPCPairs:
         return self.M_pairs_mrhs(self.M_pairs_mrhs(x_b))
 
     # -- complex in/out wrappers (interface boundary) -------------------
+    def _yx_block_pairs(self, x, inverse: bool = False):
+        """x-sharded meshes keep resident links AND solver spinors in
+        the block-contiguous fused layout (parallel/mesh.
+        fuse_block_layout) — a pure site relabeling the packed solver
+        algebra never observes; convert at the canonical boundary
+        only.  Identity off-mesh and when the x axis is unpartitioned."""
+        yx = getattr(self, "_mesh_yx", None)
+        if yx is None or yx[1] == 1:
+            return x
+        from ..parallel import mesh as qmesh
+        _, _, Y, X = self.dims
+        f = (qmesh.unfuse_block_layout if inverse
+             else qmesh.fuse_block_layout)
+        return f(x, yx[0], yx[1], Y, X // 2)
+
     def _to_pairs(self, x):
         from ..ops import staggered_packed as spk
         from ..ops.wilson_packed import to_packed_pairs
-        return to_packed_pairs(spk.pack_staggered(x), self.store_dtype)
+        return self._yx_block_pairs(
+            to_packed_pairs(spk.pack_staggered(x), self.store_dtype))
 
     def _from_pairs(self, x_pp, dtype):
         from ..ops import staggered_packed as spk
         from ..ops.wilson_packed import from_packed_pairs
         T, Z, Y, X = self.dims
-        return spk.unpack_staggered(from_packed_pairs(x_pp, dtype),
-                                    (T, Z, Y, X // 2))
+        return spk.unpack_staggered(
+            from_packed_pairs(self._yx_block_pairs(x_pp, inverse=True),
+                              dtype), (T, Z, Y, X // 2))
 
     def M(self, x):
         return self._from_pairs(self.M_pairs(self._to_pairs(x)), x.dtype)

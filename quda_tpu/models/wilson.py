@@ -318,6 +318,19 @@ class _PackedHopMixin:
         if pallas_version not in (2, 3):
             raise ValueError(f"pallas_version must be 2 or 3, got "
                              f"{pallas_version}")
+        if mesh is not None and pallas_version == 3:
+            ms = dict(mesh.shape)
+            if int(ms.get("y", 1)) > 1 or int(ms.get("x", 1)) > 1:
+                # the v3 scatter exterior shards t/z only; a y/x-
+                # partitioned mesh clamps to the v2 gather form (the
+                # measured-best default anyway, PERF.md round 5)
+                from ..utils import logging as qlog
+                qlog.printq(
+                    "mesh dslash: pallas v3 exterior shards t/z only "
+                    "— y/x-partitioned mesh clamps to the v2 gather "
+                    "form (pin QUDA_TPU_PALLAS_VERSION=2 to silence)",
+                    qlog.SUMMARIZE)
+                pallas_version = 2
         self._pallas_version = pallas_version
         # -- precision storage form (PERF.md round 16) ------------------
         # explicit kwarg > QUDA_TPU_PRECISION_FORM > legacy resolution
@@ -398,18 +411,44 @@ class _PackedHopMixin:
         # multi-chip: run the sharded eo pallas policy under shard_map;
         # the resident links move onto the mesh once here
         self._mesh = mesh
+        self._mesh_yx = None
         if mesh is not None:
             if not use_pallas:
                 raise ValueError(
                     "mesh-sharded packed hops need use_pallas=True "
                     "(the XLA pair stencil shards via GSPMD instead)")
+            from ..parallel.pallas_dslash import (
+                SHARDED_POLICIES, _mesh_counts, _policy_label,
+                notice_legacy_single_policy, resolve_axis_policies)
             self._sharded_policy = (
                 sharded_policy
                 or str(qconf.get("QUDA_TPU_SHARDED_POLICY", fresh=True))
                 or "auto")
+            if self._sharded_policy in SHARDED_POLICIES:
+                # bare single-value form: maps onto every partitioned
+                # axis, with a one-time deprecation-style notice
+                notice_legacy_single_policy(self._sharded_policy)
+            # y/x-partitioned meshes need the block-contiguous fused
+            # layout (parallel/mesh.fuse_block_layout): the trailing
+            # Y·Xh axis is re-ordered ONCE here so the ("y","x")
+            # PartitionSpec hands every shard whole local rows at the
+            # LOCAL row width (identity when n_x == 1)
+            _, _, n_y, n_x = _mesh_counts(mesh)
+            self._mesh_yx = (n_y, n_x)
+            if n_x > 1:
+                from ..parallel import mesh as qmesh
+                _, _, Y, X = self.dims
+                self.gauge_eo_pp = tuple(
+                    qmesh.fuse_block_layout(g, n_y, n_x, Y, X // 2)
+                    for g in self.gauge_eo_pp)
+                if getattr(self, "_u_bw", None) is not None:
+                    self._u_bw = tuple(
+                        qmesh.fuse_block_layout(g, n_y, n_x, Y, X // 2)
+                        for g in self._u_bw)
             from jax.sharding import NamedSharding, PartitionSpec as P
             gspec = NamedSharding(
-                mesh, P(None, None, None, None, "t", "z", None))
+                mesh,
+                P(None, None, None, None, "t", "z", ("y", "x")))
             self.gauge_eo_pp = tuple(jax.device_put(g, gspec)
                                      for g in self.gauge_eo_pp)
             if getattr(self, "_u_bw", None) is not None:
@@ -422,8 +461,13 @@ class _PackedHopMixin:
                 # into the surrounding trace instead of executing them)
                 self._resolve_sharded_policy(0, None)
             else:
+                pols = resolve_axis_policies(self._sharded_policy)
+                self._sharded_policy = pols
+                live = [a for a, n in zip(("t", "z", "y", "x"),
+                                          _mesh_counts(mesh)) if n > 1]
                 _notice_sharded_policy(self._pallas_version,
-                                       self._sharded_policy, "pinned",
+                                       _policy_label(pols, live),
+                                       "pinned",
                                        ici_bytes=self._ici_model_bytes())
 
     def _downgrade_precision_form(self, form: str, use_pallas: bool,
@@ -608,21 +652,23 @@ class _PackedHopMixin:
         import numpy as np
 
         from ..obs import comms as ocomms
+        from ..parallel.pallas_dslash import _mesh_counts
         return ocomms.wilson_eo_halo_model(
-            tuple(self.dims),
-            (int(self._mesh.shape["t"]), int(self._mesh.shape["z"])),
+            tuple(self.dims), _mesh_counts(self._mesh),
             itemsize=np.dtype(self.store_dtype).itemsize)["per_device"]
 
-    def _build_sharded_fn(self, target_parity, out_dtype, policy: str):
+    def _build_sharded_fn(self, target_parity, out_dtype, policy):
         """jitted shard_map of the sharded eo pallas policy for one
-        (parity, out_dtype, halo policy) configuration."""
+        (parity, out_dtype, halo policy) configuration; ``policy`` is
+        anything resolve_axis_policies accepts (bare name, per-axis
+        spec string, or {axis: policy} dict)."""
         from jax.sharding import PartitionSpec as P
 
         from ..parallel import compat
         from ..parallel.pallas_dslash import (dslash_eo_pallas_sharded,
                                               dslash_eo_pallas_sharded_v3)
-        pspec = P(None, None, None, "t", "z", None)
-        gspec = P(None, None, None, None, "t", "z", None)
+        pspec = P(None, None, None, "t", "z", ("y", "x"))
+        gspec = P(None, None, None, None, "t", "z", ("y", "x"))
         if self._pallas_version == 2:
             def local(uh, ub, p):
                 return dslash_eo_pallas_sharded(
@@ -641,26 +687,32 @@ class _PackedHopMixin:
             local, mesh=self._mesh, in_specs=(gspec, gspec, pspec),
             out_specs=pspec))
 
-    def _resolve_sharded_policy(self, target_parity, out_dtype) -> str:
-        """The policy engine: a pinned policy passes through; 'auto'
-        races every registered policy on REAL shard-resident operands
-        via utils.tune (QUDA's tune.cpp:862 rule — policies are timed,
-        never assumed) and caches the winner per (volume, mesh, kernel
-        form) in the tunecache.  A candidate that cannot run here (the
-        fused RDMA path off-chip without the distributed interpreter)
-        simply loses the race — tune skips failing candidates."""
+    def _resolve_sharded_policy(self, target_parity, out_dtype):
+        """The PER-AXIS policy engine (round 18): a pinned policy (bare
+        name, per-axis spec, or dict) normalizes and passes through;
+        'auto' races each PARTITIONED mesh axis independently on REAL
+        shard-resident operands via utils.tune (QUDA's tune.cpp:862
+        rule — policies are timed, never assumed), greedily: every axis
+        starts at xla_facefix and each axis race pins its winner before
+        the next axis races, cached per (volume, mesh, form, axis) in
+        the tunecache.  A candidate that cannot run here (the fused
+        RDMA path off-chip without the distributed interpreter) simply
+        loses its race — tune skips failing candidates."""
+        from ..parallel.pallas_dslash import (AXIS_NAMES,
+                                              FUSED_HALO_AXES,
+                                              SHARDED_POLICIES,
+                                              _mesh_counts,
+                                              _policy_label,
+                                              resolve_axis_policies)
         pol = self._sharded_policy
         if pol != "auto":
-            _notice_sharded_policy(self._pallas_version, pol, "pinned",
-                                   ici_bytes=self._ici_model_bytes())
-            return pol
+            return resolve_axis_policies(pol)
         won = getattr(self, "_sharded_policy_winner", None)
         if won is not None:
             return won
-        from ..parallel.pallas_dslash import SHARDED_POLICIES
         from ..utils import tune as qtune
-        cands = {p: self._build_sharded_fn(target_parity, out_dtype, p)
-                 for p in SHARDED_POLICIES}
+        counts = _mesh_counts(self._mesh)
+        live = [a for a, n in zip(AXIS_NAMES, counts) if n > 1]
         # concrete dummy operands at the solve shapes/shardings (the
         # race may be triggered from inside a solver trace, where psi is
         # a tracer — the links are resident concrete arrays already)
@@ -672,34 +724,50 @@ class _PackedHopMixin:
         psi0 = jax.device_put(
             jnp.zeros((4, 3, 2, T, Z, uh.shape[-1]), self.store_dtype),
             NamedSharding(self._mesh,
-                          P(None, None, None, "t", "z", None)))
+                          P(None, None, None, "t", "z", ("y", "x"))))
         mesh_shape = tuple(int(self._mesh.shape[a])
                            for a in self._mesh.axis_names)
         aux = (f"v{self._pallas_version}|mesh{mesh_shape}|"
                f"{jnp.dtype(self.store_dtype).name}")
-        # warm-cache provenance: a winner already raced on THIS chip
-        # (tune_key carries the platform component) is served without
-        # re-racing; the notice says which happened
-        warm = qtune.cached_param("wilson_eo_sharded_policy",
-                                  tuple(self.dims), aux=aux)
-        won = qtune.tune(
-            "wilson_eo_sharded_policy", tuple(self.dims), cands,
-            (uh, ub, psi0), aux=aux)
-        self._sharded_policy_winner = won
-        # the winning candidate is already traced+compiled — seed the
-        # hop cache with it so the first real application does not pay
-        # an identical second XLA compilation of the distributed dslash
+        pols = {a: "xla_facefix" for a in AXIS_NAMES}
+        # warm-cache provenance: winners already raced on THIS chip
+        # (tune_key carries the platform component) for EVERY live axis
+        # are served without re-racing; the notice says which happened
+        warm, seeded = True, None
+        for ax in live:
+            axis_cands = [p for p in SHARDED_POLICIES
+                          if p == "xla_facefix" or ax in FUSED_HALO_AXES]
+            if len(axis_cands) < 2:
+                continue    # x: only the facefix transport serves it
+            cands = {p: self._build_sharded_fn(
+                        target_parity, out_dtype, dict(pols, **{ax: p}))
+                     for p in axis_cands}
+            name = f"wilson_eo_sharded_policy_{ax}"
+            warm = warm and (qtune.cached_param(
+                name, tuple(self.dims), aux=aux) is not None)
+            pols[ax] = qtune.tune(name, tuple(self.dims), cands,
+                                  (uh, ub, psi0), aux=aux)
+            seeded = cands[pols[ax]]
+        self._sharded_policy_winner = pols
+        # the last race's winning candidate is already traced+compiled
+        # and equals the final joint configuration (later axes never
+        # change an earlier race's pinned values) — seed the hop cache
+        # with it so the first real application does not pay an
+        # identical second XLA compilation of the distributed dslash
         # (out_dtype=None means "psi dtype" = store_dtype here, so the
         # key must normalize or real lookups can never hit the seed)
         key = (target_parity,
                jnp.dtype(out_dtype or self.store_dtype).name)
-        self.__dict__.setdefault("_sharded_fns", {})[key] = cands[won]
+        if seeded is None:
+            seeded = self._build_sharded_fn(target_parity, out_dtype,
+                                            dict(pols))
+        self.__dict__.setdefault("_sharded_fns", {})[key] = seeded
         _notice_sharded_policy(
-            self._pallas_version, won,
-            "warm cache (chip-keyed tunecache)" if warm is not None
+            self._pallas_version, _policy_label(pols, live),
+            "warm cache (chip-keyed tunecache)" if warm
             else "raced+cached (QUDA_TPU_SHARDED_POLICY=auto)",
             ici_bytes=self._ici_model_bytes())
-        return won
+        return pols
 
     def _sharded_d_to(self, target_parity, out_dtype):
         """Memoized shard_map of the sharded eo pallas policy (a fresh
@@ -715,17 +783,36 @@ class _PackedHopMixin:
                                                 out_dtype, policy)
         return cache[key]
 
+    def _yx_block_pairs(self, x, inverse: bool = False):
+        """x-sharded meshes keep the resident links AND the solver
+        spinors in the block-contiguous fused layout
+        (parallel/mesh.fuse_block_layout) — a pure site relabeling the
+        packed solver algebra (elementwise + reductions over the fused
+        axis) never observes, so the conversion happens ONLY at the
+        canonical<->packed boundary.  Identity off-mesh and whenever
+        the x mesh axis is unpartitioned."""
+        yx = getattr(self, "_mesh_yx", None)
+        if yx is None or yx[1] == 1:
+            return x
+        from ..parallel import mesh as qmesh
+        _, _, Y, X = self.dims
+        f = (qmesh.unfuse_block_layout if inverse
+             else qmesh.fuse_block_layout)
+        return f(x, yx[0], yx[1], Y, X // 2)
+
     def _to_pairs(self, x):
         """Canonical (T,Z,Y,Xh,4,3) complex -> packed pairs."""
         from ..ops import wilson_packed as wpk
-        return wpk.to_packed_pairs(wpk.pack_spinor(x), self.store_dtype)
+        return self._yx_block_pairs(
+            wpk.to_packed_pairs(wpk.pack_spinor(x), self.store_dtype))
 
     def _from_pairs(self, x, dtype):
         """Packed pairs -> canonical (T,Z,Y,Xh,4,3) complex."""
         from ..ops import wilson_packed as wpk
         T, Z, Y, X = self.dims
         return wpk.unpack_spinor(
-            wpk.from_packed_pairs(x, dtype), (T, Z, Y, X // 2))
+            wpk.from_packed_pairs(self._yx_block_pairs(x, inverse=True),
+                                  dtype), (T, Z, Y, X // 2))
 
 
 class _SchurPairOpBase(_PackedHopMixin, _PairSloppyBase):

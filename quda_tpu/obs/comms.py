@@ -359,13 +359,47 @@ def attribute_solve(form: str, applies: float, dslash_per_apply: float,
                                     / ICI_NOMINAL_GBPS, 2),
            "policy": pol_label,
            "axes": "+".join(axes)}
+    # per-axis breakdown from ONE representative max group (the tied
+    # groups are alternatives moving identical slabs, so any one of
+    # them carries the per-axis split; summing the union would
+    # double-count ties).  Multi-axis meshes additionally get one
+    # ici:{form}:{axis} sub-row per partitioned axis so the roofline
+    # dump shows where the bytes go.
+    rep = next(g for g in groups.values() if g["bytes"] == per_inv)
+    axis_bytes: Dict[str, int] = {}
+    for r in rep["rows"]:
+        axis_bytes[r["axis"]] = axis_bytes.get(r["axis"], 0) + r["bytes"]
+    sub_rows = []
+    if len(axis_bytes) > 1:
+        for ax in sorted(axis_bytes):
+            b_ax = axis_bytes[ax]
+            t_ax = b_ax * float(applies) * float(dslash_per_apply) * n_dev
+            g_ax = (t_ax / seconds / 1e9) if seconds > 0 else 0.0
+            sub_rows.append({
+                "form": f"ici:{form}:{ax}", "label": label,
+                "ici_bytes": int(t_ax),
+                "bytes_per_invocation_per_device": int(b_ax),
+                "applies": float(applies),
+                "dslash_per_apply": float(dslash_per_apply),
+                "devices": n_dev, "seconds": round(float(seconds), 6),
+                "gbps": round(g_ax, 3),
+                "gbps_per_device": round(g_ax / n_dev, 3),
+                "pct_nominal_ici": round(100.0 * g_ax / n_dev
+                                         / ICI_NOMINAL_GBPS, 2),
+                "policy": pol_label, "axes": ax})
     with s.lock:
         s.solve_rows.append(row)
+        s.solve_rows.extend(sub_rows)
     from . import metrics as omet
     from . import trace as otr
     otr.event("ici_solve", cat="comms", **row)
-    omet.inc("ici_bytes_total", float(total), axis=row["axes"],
-             policy=pol_label)
+    # the counter splits per axis (ici_bytes_total{axis, policy}); the
+    # per-axis totals sum exactly to the row's mesh-aggregate bytes
+    for ax in sorted(axis_bytes):
+        t_ax = (axis_bytes[ax] * float(applies)
+                * float(dslash_per_apply) * n_dev)
+        omet.inc("ici_bytes_total", float(t_ax), axis=ax,
+                 policy=pol_label)
     return row
 
 
@@ -395,20 +429,27 @@ def wilson_eo_halo_model(dims, mesh_shape, itemsize: int = 4) -> dict:
     from first principles — the number the ledger must reproduce from
     the seams, and what the QUDA_TPU_SHARDED_POLICY race notice quotes
     next to its timing winner.  ``dims`` = global (T, Z, Y, X),
-    ``mesh_shape`` = (n_t, n_z).  Both v2 and v3 exchange exactly two
-    psi-shaped slabs per partitioned direction (one ``exchange`` call),
-    so the model is form-independent: 2 x face-plane bytes per axis."""
+    ``mesh_shape`` = (n_t, n_z) or the full (n_t, n_z, n_y, n_x).  Both
+    v2 and v3 exchange exactly two psi-shaped faces per partitioned
+    direction (one ``exchange`` call), so the model is form-independent:
+    2 x face bytes per axis.  t/z faces are whole planes, the y face is
+    one local row strip, and the x face is one local COLUMN stack of xh
+    slots (the eo slot-select reaches one column, w=1) — strided, which
+    is why x is the cheapest axis per device but ppermute-only."""
     T, Z, Y, X = dims
-    n_t, n_z = mesh_shape
-    yxh = Y * X // 2
+    n_t, n_z, n_y, n_x = tuple(mesh_shape) + (1,) * (4 - len(mesh_shape))
+    t_l, z_l = T // n_t, Z // n_z
+    y_l, xh_l = Y // n_y, (X // 2) // n_x
     axes = {}
     per_device = 0
-    for name, n, face_elems in (("t", n_t, 4 * 3 * 2 * (Z // n_z) * yxh),
-                                ("z", n_z, 4 * 3 * 2 * (T // n_t) * yxh)):
+    for name, n, face_elems in (("t", n_t, 4 * 3 * 2 * z_l * y_l * xh_l),
+                                ("z", n_z, 4 * 3 * 2 * t_l * y_l * xh_l),
+                                ("y", n_y, 4 * 3 * 2 * t_l * z_l * xh_l),
+                                ("x", n_x, 4 * 3 * 2 * t_l * z_l * y_l)):
         if n <= 1:
             continue
         b = 2 * face_elems * itemsize
         axes[name] = b
         per_device += b
     return {"per_device": per_device,
-            "total": per_device * n_t * n_z, "axes": axes}
+            "total": per_device * n_t * n_z * n_y * n_x, "axes": axes}

@@ -83,6 +83,44 @@ def shard_gauge(arr, mesh: Mesh):
     return jax.device_put(arr, NamedSharding(mesh, gauge_pspec()))
 
 
+def fuse_block_layout(arr, n_y: int, n_x: int, Y: int, xcols: int):
+    """Re-order a packed array's trailing fused Y·X axis so that
+    splitting it into n_y*n_x equal chunks yields BLOCK-contiguous
+    (Y_loc, X_loc) rectangles — the layout the y/x-sharded dslash
+    wrappers assume (parallel/pallas_dslash: one shard = whole local
+    rows of the LOCAL row width).
+
+    The natural fused order y*xcols + x splits, under a
+    PartitionSpec ("y", "x") on the trailing axis, into contiguous
+    index ranges that are NOT rectangles once n_x > 1; this permutation
+    makes chunk (i, j) hold rows [i*Y_loc, (i+1)*Y_loc) x columns
+    [j*X_loc, (j+1)*X_loc) stored row-major in the LOCAL width.
+    Identity when n_x == 1 (row splitting is already block-contiguous).
+    ``xcols`` is the GLOBAL row width of the fused axis: X full-lattice,
+    Xh = X//2 checkerboarded."""
+    if n_x == 1:
+        return arr
+    y_l, x_l = Y // n_y, xcols // n_x
+    lead = arr.shape[:-1]
+    a = arr.reshape(lead + (n_y, y_l, n_x, x_l))
+    a = np.moveaxis(a, -2, -3) if isinstance(arr, np.ndarray) \
+        else jax.numpy.moveaxis(a, -2, -3)
+    return a.reshape(lead + (Y * xcols,))
+
+
+def unfuse_block_layout(arr, n_y: int, n_x: int, Y: int, xcols: int):
+    """Inverse of :func:`fuse_block_layout` — back to the natural fused
+    y*xcols + x order."""
+    if n_x == 1:
+        return arr
+    y_l, x_l = Y // n_y, xcols // n_x
+    lead = arr.shape[:-1]
+    a = arr.reshape(lead + (n_y, n_x, y_l, x_l))
+    a = np.moveaxis(a, -2, -3) if isinstance(arr, np.ndarray) \
+        else jax.numpy.moveaxis(a, -2, -3)
+    return a.reshape(lead + (Y * xcols,))
+
+
 def local_extents(mesh: Mesh, lattice_shape: Tuple[int, int, int, int]):
     """Per-device local (T,Z,Y,X) extents; validates divisibility the way
     QUDA validates comm grid dims against the lattice."""

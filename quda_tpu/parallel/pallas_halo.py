@@ -377,7 +377,12 @@ def slab_exchange_bidir(send_down: jnp.ndarray, send_up: jnp.ndarray,
 
     i.e. one fused launch covering the two face transfers the sharded
     dslash needs per partitioned direction (include/dslash_shmem.h put
-    + wait, expressed as a drop-in for parallel/halo._permute_slice)."""
+    + wait, expressed as a drop-in for parallel/halo._permute_slice).
+
+    Generic over ``axis_name`` and slab shape: any CONTIGUOUS face
+    works — t/z plane slabs and y row strips of the fused Y·X axis
+    (pallas_dslash.FUSED_HALO_AXES).  x column faces are strided
+    gathers and stay on the ppermute policy."""
     kern = _make_exchange_kernel(axis_name, tuple(mesh_axes))
     ip = _require_dist_interpret(interpret)
     # ICI ledger: both slabs leave this device in one fused launch

@@ -186,18 +186,27 @@ _register("QUDA_TPU_PALLAS_VERSION", "int", 2,
           "autotuner can still select v3 per-shape when it wins)",
           reference="dslash policy selection; tune.cpp:862 — policies "
                     "are timed, never assumed")
-_register("QUDA_TPU_SHARDED_POLICY", "choice", "auto",
-          "multi-chip dslash halo policy: 'xla_facefix' = lax.ppermute "
-          "face fixes around the pallas interior (GSPMD collective-"
-          "permute transport); 'fused_halo' = in-kernel RDMA slab "
-          "exchange, both directions behind one neighbour barrier "
-          "(parallel/pallas_halo.slab_exchange_bidir, the NVSHMEM "
-          "analog); 'auto' = race both per (volume, mesh) via "
-          "utils.tune on first application and cache the winner "
-          "(QUDA-policy-engine style)",
-          ("", "auto", "xla_facefix", "fused_halo"),
+_register("QUDA_TPU_SHARDED_POLICY", "str", "auto",
+          "multi-chip dslash halo policy, PER MESH AXIS since round "
+          "18: 'xla_facefix' = lax.ppermute face fixes around the "
+          "pallas interior (GSPMD collective-permute transport, serves "
+          "every axis including the strided x column faces); "
+          "'fused_halo' = in-kernel RDMA strip exchange, both "
+          "directions behind one neighbour barrier (parallel/"
+          "pallas_halo.slab_exchange_bidir, the NVSHMEM analog — "
+          "contiguous t/z slabs and y row strips only); 'auto' = race "
+          "each partitioned axis per (volume, mesh, form, axis) via "
+          "utils.tune at construction and cache the winners "
+          "(QUDA-policy-engine style).  A per-axis spec pins axes "
+          "separately, e.g. 't=fused_halo,z=fused_halo,y=xla_facefix' "
+          "(unlisted axes get xla_facefix); a bare policy name is the "
+          "LEGACY single-value form — it maps onto all axes (x keeps "
+          "xla_facefix under fused_halo) with a one-time deprecation-"
+          "style notice.  Read at operator construction only, hence "
+          "NOT trace-safe",
           reference="dslash policy engine lib/dslash_policy.hpp:"
-                    "365-560,1566-1675 + QUDA_ENABLE_NVSHMEM")
+                    "365-560,1566-1675 + QUDA_ENABLE_NVSHMEM",
+          trace_safe=False)
 _register("QUDA_TPU_PALLAS_VMEM_MB", "float", 6.0,
           "single-buffer VMEM budget (MB) for pallas z-block selection "
           "(_pick_bz).  Default 6 leaves half the 16 MB scoped limit "

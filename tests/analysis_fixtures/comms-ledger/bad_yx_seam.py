@@ -1,0 +1,11 @@
+"""Seeded violation (round 18): a y/x exchange seam called outside
+parallel/pallas_dslash — the comms scope that labels its ledger rows
+with (site, policy, axis) never opens, so the transfer ships
+unattributed."""
+
+from quda_tpu.parallel.pallas_dslash import _eo_x_psi_sources
+
+
+def rogue_x_face_exchange(psi_pl, xh_loc, r0):
+    raw = lambda lo, hi, name, n: (hi, lo)     # unledgered transport
+    return _eo_x_psi_sources(psi_pl, xh_loc, raw, "x", 1, 1, r0)  # finding

@@ -1,0 +1,10 @@
+"""Clean twin: y/x-partitioned transfers go through the public sharded
+wrapper, which opens the comms scope and routes every face through the
+ledgered exchange seam."""
+
+from quda_tpu.parallel.pallas_dslash import dslash_eo_pallas_sharded
+
+
+def proper_x_face_exchange(u_here, u_bw, psi, dims, parity, mesh):
+    return dslash_eo_pallas_sharded(u_here, u_bw, psi, dims, parity,
+                                    mesh)

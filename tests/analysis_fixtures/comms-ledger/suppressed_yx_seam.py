@@ -1,0 +1,9 @@
+"""Suppressed twin: the y/x seam call is acknowledged with a reason
+(e.g. a migration shim that opens its own comms scope)."""
+
+from quda_tpu.parallel.pallas_dslash import _eo_x_psi_sources
+
+
+def shimmed_x_face_exchange(psi_pl, xh_loc, exchange, r0):
+    return _eo_x_psi_sources(  # quda-lint: disable=comms-ledger  reason=migration shim opens its own comms scope upstream
+        psi_pl, xh_loc, exchange, "x", 1, 1, r0)

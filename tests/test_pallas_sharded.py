@@ -484,18 +484,26 @@ def test_sharded_operator_defaults_to_v2_and_races_policy(tmp_path,
                    mesh=mesh)
     assert op._pallas_version == 2          # measured winner, not v3
     won = op._sharded_policy_winner
-    assert won in ("xla_facefix", "fused_halo")
+    # round 18: the engine races PER AXIS — the winner is a full
+    # {axis: policy} map with every partitioned axis raced and the
+    # unpartitioned ones pinned at the facefix transport
+    assert set(won) == {"t", "z", "y", "x"}
+    assert all(v in ("xla_facefix", "fused_halo") for v in won.values())
     # off-chip without the distributed interpreter the RDMA candidate
-    # cannot run, so the race must settle on the ppermute transport
+    # cannot run, so every axis race must settle on ppermute
     if not compat.has_dist_interpret():
-        assert won == "xla_facefix"
-    # the winner is persisted: the cache file holds exactly one entry
-    # for this (volume, name, aux) and a second operator re-reads it
-    # without re-racing (tune returns the cached param)
+        assert all(v == "xla_facefix" for v in won.values())
+    # the winners are persisted: one cache entry PER PARTITIONED AXIS
+    # (t and z here) and a second operator re-reads them without
+    # re-racing (tune returns the cached params)
     cache = json.loads((tmp_path / "tunecache.json").read_text())
-    keys = [k for k in cache if "wilson_eo_sharded_policy" in k]
-    assert len(keys) == 1
-    assert cache[keys[0]]["param"] == won
+    keys = sorted(k for k in cache if "wilson_eo_sharded_policy" in k)
+    assert len(keys) == 2
+    assert any("wilson_eo_sharded_policy_t" in k for k in keys)
+    assert any("wilson_eo_sharded_policy_z" in k for k in keys)
+    for k in keys:
+        ax = k.split("wilson_eo_sharded_policy_")[1].split("|")[0]
+        assert cache[k]["param"] == won[ax]
     op2 = dpk.pairs(jnp.float32, use_pallas=True,
                     pallas_interpret=True, mesh=mesh)
     assert op2._sharded_policy_winner == won
