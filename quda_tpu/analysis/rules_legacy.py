@@ -360,6 +360,49 @@ def check_flight_capture(index, mod):
                            "before the re-raise")
     if mod.rel.endswith("interfaces/quda_api.py"):
         yield from _check_api_guards(mod)
+    if "serve" in mod.rel.split("/")[:-1]:
+        yield from _check_serve_request_scope(mod)
+
+
+def _check_serve_request_scope(mod):
+    """Serve-scoped solves must carry request ids into capture: any
+    solve-API call made from a ``serve/`` module has SolveTickets
+    riding on it, so a postmortem bundle captured inside must be able
+    to name them — which requires the call to run lexically inside a
+    ``with opm.serve_requests(ids)`` block (obs/postmortem.py pushes
+    the ids the manifest writer reads).  A bundle without the ticket's
+    request_id strands the operator at 'some request failed'."""
+    solve_apis = frozenset(_GUARDED_APIS) - {"load_gauge_quda"}
+
+    def _with_names(w: ast.With) -> set:
+        names = set()
+        for item in w.items:
+            ctx = item.context_expr
+            f = ctx.func if isinstance(ctx, ast.Call) else ctx
+            names.add(mod.last_name(f))
+        return names
+
+    def _walk(node, scoped: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.With):
+                _walk(child, scoped
+                      or "serve_requests" in _with_names(child))
+                continue
+            if isinstance(child, ast.Call) \
+                    and mod.last_name(child.func) in solve_apis \
+                    and not scoped:
+                found.append(
+                    (child.lineno,
+                     f"serve-scoped {mod.last_name(child.func)}() call "
+                     "outside a serve_requests(...) scope — a "
+                     "postmortem bundle captured during this solve "
+                     "cannot carry its tickets' request_id (wrap the "
+                     "call in obs.postmortem.serve_requests)"))
+            _walk(child, scoped)
+
+    found: list = []
+    _walk(mod.tree, False)
+    yield from found
 
 
 def _check_api_guards(mod):

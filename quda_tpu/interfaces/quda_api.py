@@ -51,8 +51,10 @@ def init_quda(device: int = 0):
     from ..obs import comms as ocomms
     ocomms.maybe_start()       # ICI comms ledger (rides both knobs)
     from ..obs import flight as ofl
+    from ..obs import live as olive
     from ..obs import postmortem as opm
     ofl.maybe_start()          # QUDA_TPU_FLIGHT black-box ring buffer
+    olive.maybe_start()        # QUDA_TPU_LIVE telemetry HTTP plane
     opm.reset_session()        # fresh postmortem bundle index
     # warm-start the chip-keyed tuner cache (tune.cpp persistent-cache
     # behavior): a fresh worker with a shared QUDA_TPU_RESOURCE_PATH
@@ -114,6 +116,7 @@ def end_quda():
     from ..obs import comms as ocomms
     from ..obs import costmodel as ocost
     from ..obs import flight as ofl
+    from ..obs import live as olive
     from ..obs import memory as omem
     from ..obs import metrics as omet
     from ..obs import postmortem as opm
@@ -170,7 +173,11 @@ def end_quda():
         artifacts["cost_drift.tsv"] = ocost.save_report()
 
     errors = []
-    for step in (qmon.stop_default, print_summary, _save_tune_profile,
+    # olive.stop FIRST: the scrape plane reads every other leg's live
+    # session — it must be down before those sessions close, or a
+    # mid-teardown scrape races the flushes below
+    for step in (olive.stop,
+                 qmon.stop_default, print_summary, _save_tune_profile,
                  _save_roofline,
                  orf.reset,  # a later init/end must not re-dump rows
                  _save_cost_report,
@@ -195,6 +202,16 @@ def end_quda():
 def _require_init():
     if not _ctx["initialized"]:
         qlog.errorq("initQuda has not been called")
+
+
+def _serve_rid_attrs() -> dict:
+    """Request-id span/flight attributes when this API call executes a
+    solve-service batch (obs/postmortem.serve_requests scope): the
+    comma-joined ticket ids, {} outside the service so non-serve spans
+    stay unchanged."""
+    from ..obs import postmortem as opm
+    rids = opm.current_request_ids()
+    return {"request_ids": ",".join(rids)} if rids else {}
 
 
 def _pm_api(api: str, payload: Optional[str] = None):
@@ -920,7 +937,8 @@ def invert_quda(source, param: InvertParam):
     from ..obs import trace as otr
     from ..robust import escalate as resc
     with otr.api_span("invert_quda", dslash=param.dslash_type,
-                      inv=param.inv_type, tol=param.tol), \
+                      inv=param.inv_type, tol=param.tol,
+                      **_serve_rid_attrs()), \
             _hbm_sampled("invert_quda"):
         if resc.enabled():
             # QUDA_TPU_ROBUST=escalate: drive the attempt through the
@@ -1443,7 +1461,8 @@ def invert_multi_src_quda(sources, param: InvertParam):
     from ..obs import trace as otr
     from ..robust import escalate as resc
     with otr.api_span("invert_multi_src_quda", dslash=param.dslash_type,
-                      inv=param.inv_type, n_src=len(sources)), \
+                      inv=param.inv_type, n_src=len(sources),
+                      **_serve_rid_attrs()), \
             _hbm_sampled("invert_multi_src_quda"):
         if resc.enabled():
             return resc.run_ladder(_invert_multi_src_body, sources,
@@ -1903,7 +1922,8 @@ def invert_multishift_quda(source, param: InvertParam):
     from ..robust import escalate as resc
     with otr.api_span("invert_multishift_quda",
                       dslash=param.dslash_type,
-                      n_shifts=len(param.offset)), \
+                      n_shifts=len(param.offset),
+                      **_serve_rid_attrs()), \
             _hbm_sampled("invert_multishift_quda"):
         if resc.enabled():
             return resc.run_ladder(_invert_multishift_body, source,

@@ -1,0 +1,361 @@
+"""Live telemetry plane: HTTP scrape endpoint + periodic exporter.
+
+Every other observability leg (trace, metrics, flight, postmortems)
+exports at ``end_quda`` — but a production solve-service worker is
+long-lived and never reaches ``end_quda``, so without this module the
+fleet runs blind.  The reference's answer to live introspection is its
+NVTX-annotated wrappers and persistent QUDA_RESOURCE_PATH artifacts
+(lib/generate/wrap.py, lib/tune.cpp:450-610); ours is the pull-based
+Prometheus discipline the metrics registry was shaped for, with
+PLQCD-style always-draining semantics (arXiv:1405.0700): the queue
+keeps serving while the telemetry plane observes it.
+
+A stdlib ``ThreadingHTTPServer`` bound on 127.0.0.1 serves:
+
+* ``/metrics``  — Prometheus text from a lock-consistent live snapshot
+  of the registry (obs/metrics.py ``snapshot``; NO reset — scrapes are
+  idempotent reads);
+* ``/healthz``  — process liveness + the attached solve-service
+  worker-thread liveness;
+* ``/readyz``   — 200 only when the attached service can serve: worker
+  draining, warm start complete, a gauge registered/resident;
+* ``/fleet``    — the live ``fleet_report.txt`` render (obs/report.py);
+* ``/slo``      — ``serve_request_seconds`` error-budget burn rate
+  against QUDA_TPU_SLO_TARGET_MS / QUDA_TPU_SLO_OBJECTIVE.
+
+A background flusher (``QUDA_TPU_METRICS_FLUSH_SEC`` > 0) rewrites the
+metrics/fleet/flight/roofline artifacts every interval so a crashed
+worker loses at most one window of telemetry.
+
+Activation: ``QUDA_TPU_LIVE=1`` (read by ``init_quda`` via
+:func:`maybe_start`) or an explicit :func:`start`.  **Off means off**
+— the obs discipline: every entry point returns after one
+module-global load, no server/socket/thread exists, and no op is ever
+added to a compiled solve either way (pinned by a raising-stub test
+like every other leg).  The server holds its mutable state on the
+session instance behind ``self.lock``; scrape handlers only READ the
+other obs modules' lock-consistent snapshots.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Routes one GET to the session's endpoint methods via
+    :func:`_respond` (which owns the off-path gate); request logging
+    to stderr is silenced — the scrape cadence is not operator news."""
+
+    server_version = "quda-tpu-live"
+
+    def do_GET(self):  # noqa: N802 — http.server API name
+        status, ctype, body = _respond(self.path)
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, format, *args):  # noqa: A002 — API name
+        pass
+
+
+class _Live:
+    """One live-telemetry session: the HTTP server, its worker thread,
+    the optional periodic flusher, and the attached solve service."""
+
+    def __init__(self, port: int, flush_sec: float):
+        self.lock = threading.Lock()
+        self.service = None          # attached SolveService (or None)
+        self.flush_sec = float(flush_sec)
+        self.t0 = time.time()
+        self.shutdown = threading.Event()
+        self.server = ThreadingHTTPServer(("127.0.0.1", int(port)),
+                                          _Handler)
+        self.server.daemon_threads = True
+        self.port = int(self.server.server_address[1])
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       name="quda-live", daemon=True)
+        self.flusher: Optional[threading.Thread] = None
+        if self.flush_sec > 0:
+            self.flusher = threading.Thread(target=self._flush_loop,
+                                            name="quda-live-flush",
+                                            daemon=True)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self):
+        from . import trace as otr
+        self.thread.start()
+        if self.flusher is not None:
+            self.flusher.start()
+        otr.event("live_started", cat="live", port=self.port,
+                  flush_sec=self.flush_sec)
+
+    def close(self):
+        self.shutdown.set()
+        self.server.shutdown()
+        self.thread.join(timeout=5.0)
+        self.server.server_close()
+        if self.flusher is not None:
+            self.flusher.join(timeout=5.0)
+
+    # -- periodic exporter --------------------------------------------------
+
+    def _flush_loop(self):
+        while not self.shutdown.wait(self.flush_sec):
+            self.flush_window()
+
+    def flush_window(self) -> dict:
+        """One flush window: rewrite every incremental artifact.  Each
+        leg is isolated — a full disk on one file must not stop the
+        others (the end_quda epilogue contract)."""
+        from ..utils import logging as qlog
+        from . import flight as ofl
+        from . import metrics as omet
+        from . import roofline as orf
+        from . import trace as otr
+        written: dict = {}
+        for name, step in (("metrics", omet.flush),
+                           ("flight", ofl.flush),
+                           ("roofline", orf.save)):
+            try:
+                written[name] = step()
+            except Exception as e:   # noqa: BLE001 — keep flushing
+                written[name] = None
+                qlog.warn_once(
+                    f"live_flush_{name}",
+                    f"live flusher: {name} flush failed "
+                    f"({type(e).__name__}: {str(e)[:120]})")
+        omet.inc("live_flushes_total")
+        otr.event("live_flush", cat="live",
+                  artifacts=sorted(k for k, v in written.items() if v))
+        return written
+
+    # -- endpoints ----------------------------------------------------------
+
+    def metrics(self):
+        from . import metrics as omet
+        body = omet.render_prometheus(omet.snapshot())
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                body.encode())
+
+    def fleet(self):
+        from . import metrics as omet
+        from . import report as orep
+        return (200, "text/plain; charset=utf-8",
+                orep.render(omet.snapshot()).encode())
+
+    def healthz(self):
+        with self.lock:
+            svc = self.service
+        doc = {"uptime_s": round(time.time() - self.t0, 3),
+               "service_attached": svc is not None}
+        if svc is not None:
+            h = svc.health()
+            doc["worker_alive"] = h["worker_alive"]
+            doc["stopped"] = h["stopped"]
+        # liveness: the process answers; a dead worker thread behind a
+        # live socket is exactly the zombie /healthz exists to expose
+        ok = doc.get("worker_alive", True) or doc.get("stopped", False)
+        return (200 if ok else 503, "application/json",
+                (json.dumps(doc, sort_keys=True) + "\n").encode())
+
+    def readyz(self):
+        with self.lock:
+            svc = self.service
+        checks = {"service_attached": svc is not None}
+        if svc is not None:
+            h = svc.health()
+            checks["worker_alive"] = h["worker_alive"]
+            checks["queue_draining"] = (h["worker_alive"]
+                                        and not h["stopped"])
+            checks["warm_start_complete"] = h["warm_start_complete"]
+            checks["gauge_present"] = h["gauge_present"]
+        ready = bool(checks["service_attached"]
+                     and all(checks.values()))
+        doc = {"ready": ready, "checks": checks}
+        return (200 if ready else 503, "application/json",
+                (json.dumps(doc, sort_keys=True) + "\n").encode())
+
+    def slo(self):
+        from . import metrics as omet
+        summary = slo_summary()
+        for row in summary["families"]:
+            omet.set_gauge("slo_burn_rate", row["burn_rate"],
+                           family=row["family"])
+        omet.set_gauge("slo_burn_rate",
+                       summary["overall"]["burn_rate"], family="all")
+        return (200, "application/json",
+                (json.dumps(summary, sort_keys=True) + "\n").encode())
+
+
+_session: Optional[_Live] = None
+
+
+def enabled() -> bool:
+    return _session is not None
+
+
+def start(port: Optional[int] = None,
+          flush_sec: Optional[float] = None) -> _Live:
+    """Bind the telemetry server (idempotent: an active session and
+    its port win).  ``port`` 0 = OS-assigned ephemeral; :func:`port`
+    reports the bound one."""
+    global _session
+    if _session is not None:
+        return _session
+    from ..utils import config as qconf
+    if port is None:
+        port = int(qconf.get("QUDA_TPU_LIVE_PORT", fresh=True))
+    if flush_sec is None:
+        flush_sec = float(qconf.get("QUDA_TPU_METRICS_FLUSH_SEC",
+                                    fresh=True))
+    s = _Live(port, flush_sec)
+    _session = s
+    s.open()
+    return s
+
+
+def maybe_start() -> Optional[_Live]:
+    """Start the plane iff QUDA_TPU_LIVE is set (init_quda hook).  A
+    bind failure warns instead of raising — telemetry must never stop
+    a solve session from opening."""
+    from ..utils import config as qconf
+    if not qconf.get("QUDA_TPU_LIVE", fresh=True):
+        return None
+    try:
+        return start()
+    except OSError as e:
+        from ..utils import logging as qlog
+        qlog.warningq(f"live telemetry disabled: cannot bind "
+                      f"QUDA_TPU_LIVE_PORT ({e})")
+        return None
+
+
+def stop() -> Optional[int]:
+    """Tear the server down (end_quda hook; returns the port it held).
+    Runs BEFORE the other obs legs flush so no scrape can race their
+    teardown."""
+    global _session
+    s = _session
+    if s is None:
+        return None
+    _session = None
+    s.close()
+    return s.port
+
+
+def port() -> Optional[int]:
+    """The bound TCP port (None when the plane is off)."""
+    s = _session
+    if s is None:
+        return None
+    return s.port
+
+
+def attach(service):
+    """Point /healthz //readyz at a solve service (SolveService.start
+    hook; one global load when the plane is off)."""
+    s = _session
+    if s is None:
+        return
+    with s.lock:
+        s.service = service
+
+
+def detach(service):
+    """Drop the service reference at SolveService.stop — but only the
+    one that attached; a replacement service must not be detached by
+    its predecessor's teardown."""
+    s = _session
+    if s is None:
+        return
+    with s.lock:
+        if s.service is service:
+            s.service = None
+
+
+def flush_now() -> Optional[dict]:
+    """Run one flush window on the caller's thread (tests / operator
+    tooling; None when the plane is off)."""
+    s = _session
+    if s is None:
+        return None
+    return s.flush_window()
+
+
+def _respond(path: str):
+    """Route one request; the single off-path gate for every endpoint.
+    Returns (status, content-type, body-bytes)."""
+    s = _session
+    if s is None:
+        return (503, "text/plain; charset=utf-8",
+                b"no live telemetry session\n")
+    route = path.split("?", 1)[0].rstrip("/") or "/"
+    fn = {"/metrics": s.metrics, "/healthz": s.healthz,
+          "/readyz": s.readyz, "/fleet": s.fleet,
+          "/slo": s.slo}.get(route)
+    if fn is None:
+        out = (404, "text/plain; charset=utf-8",
+               b"endpoints: /metrics /healthz /readyz /fleet /slo\n")
+    else:
+        try:
+            out = fn()
+        except Exception as e:   # noqa: BLE001 — a scrape must never
+            # kill the server thread pool; the error IS the payload
+            out = (500, "text/plain; charset=utf-8",
+                   f"{type(e).__name__}: {e}\n".encode())
+    from . import metrics as omet
+    omet.inc("live_scrapes_total", endpoint=route.lstrip("/") or "root",
+             code=f"{out[0] // 100}xx")
+    return out
+
+
+def slo_summary(snap: Optional[dict] = None) -> dict:
+    """Burn-rate read of ``serve_request_seconds`` against the SLO
+    knobs.  A request counts as good when its bucket's upper bound is
+    within the target (the conservative read — bucketed data cannot
+    place a sample more precisely); burn rate =
+    (1 - compliance) / (1 - objective), so burn > 1 means the error
+    budget is being spent faster than provisioned."""
+    from ..utils import config as qconf
+    from . import metrics as omet
+    snap = snap or omet.snapshot()
+    target_s = float(qconf.get("QUDA_TPU_SLO_TARGET_MS",
+                               fresh=True)) / 1e3
+    objective = float(qconf.get("QUDA_TPU_SLO_OBJECTIVE", fresh=True))
+    budget = max(1e-9, 1.0 - objective)
+
+    def _grade(h) -> dict:
+        bounds = h.get("buckets", omet.HIST_BUCKETS)
+        good = sum(h["counts"][i] for i, ub in enumerate(bounds)
+                   if ub <= target_s)
+        n = h["n"]
+        compliance = (good / n) if n else 1.0
+        return {"n": n, "good": good,
+                "compliance": round(compliance, 6),
+                "burn_rate": round((1.0 - compliance) / budget, 6)}
+
+    families = []
+    pooled_n = pooled_good = 0
+    for (name, labels), h in sorted(snap["histograms"].items()):
+        if name != "serve_request_seconds":
+            continue
+        row = _grade(h)
+        row["family"] = dict(labels).get("family", "?")
+        families.append(row)
+        pooled_n += row["n"]
+        pooled_good += row["good"]
+    pooled = (pooled_good / pooled_n) if pooled_n else 1.0
+    return {"target_ms": target_s * 1e3,
+            "objective": objective,
+            "families": families,
+            "overall": {"n": pooled_n, "good": pooled_good,
+                        "compliance": round(pooled, 6),
+                        "burn_rate": round((1.0 - pooled) / budget, 6)}}

@@ -41,6 +41,31 @@ from . import schema
 # chip compiles
 HIST_BUCKETS = (0.005, 0.02, 0.1, 0.5, 2.0, 10.0, 60.0)
 
+
+def _hist_bounds(name: str) -> tuple:
+    """Bucket upper bounds for one histogram.  serve_request_seconds
+    honors QUDA_TPU_SERVE_SLO_BUCKETS (comma-separated seconds) so a
+    sub-second SLO is not quantized into one default bucket; a
+    malformed value warns once and falls back — a typoed knob must
+    never take down the recording path."""
+    if name != "serve_request_seconds":
+        return HIST_BUCKETS
+    from ..utils import config as qconf
+    raw = str(qconf.get("QUDA_TPU_SERVE_SLO_BUCKETS", fresh=True) or "")
+    if not raw.strip():
+        return HIST_BUCKETS
+    try:
+        bounds = tuple(sorted({float(t) for t in raw.split(",")
+                               if t.strip()}))
+    except ValueError:
+        from ..utils import logging as qlog
+        qlog.warn_once(
+            "serve_slo_buckets",
+            f"QUDA_TPU_SERVE_SLO_BUCKETS={raw!r} is not a comma-"
+            "separated list of seconds; using the default buckets")
+        return HIST_BUCKETS
+    return bounds or HIST_BUCKETS
+
 # export file prefix: quda_tpu_solves_total etc.
 _PROM_PREFIX = "quda_tpu_"
 
@@ -94,10 +119,11 @@ class _Registry:
         with self.lock:
             h = self.hists.get(k)
             if h is None:
+                bounds = _hist_bounds(name)
                 h = self.hists[k] = {
-                    "counts": [0] * (len(HIST_BUCKETS) + 1),
-                    "sum": 0.0, "n": 0}
-            for i, ub in enumerate(HIST_BUCKETS):
+                    "counts": [0] * (len(bounds) + 1),
+                    "sum": 0.0, "n": 0, "buckets": bounds}
+            for i, ub in enumerate(h["buckets"]):
                 if value <= ub:
                     h["counts"][i] += 1
                     break
@@ -258,7 +284,9 @@ def snapshot() -> dict:
         return {"counters": dict(r.counters),
                 "gauges": dict(r.gauges),
                 "histograms": {k: {"counts": list(h["counts"]),
-                                   "sum": h["sum"], "n": h["n"]}
+                                   "sum": h["sum"], "n": h["n"],
+                                   "buckets": tuple(
+                                       h.get("buckets", HIST_BUCKETS))}
                                for k, h in r.hists.items()}}
 
 
@@ -299,7 +327,7 @@ def render_prometheus(snap: Optional[dict] = None) -> str:
         for labels, v in sorted(by_name[name]):
             if meta["type"] == schema.HISTOGRAM:
                 cum = 0
-                for i, ub in enumerate(HIST_BUCKETS):
+                for i, ub in enumerate(v.get("buckets", HIST_BUCKETS)):
                     cum += v["counts"][i]
                     le = f'le="{ub}"'
                     lines.append(

@@ -77,6 +77,16 @@ _seq = 0
 _inflight = 0
 _bundles_lock = threading.Lock()
 
+# Serve-request scope stack (pushed by the solve-service worker around
+# each executed batch, serve/service.py _solve): the ids of the
+# SolveTickets whose batch is currently on the API — read by the API
+# spans (request_ids attribute) and by _write_bundle, which lands them
+# in manifest.json so an operator goes from a failed ticket to its
+# bundle in one grep.  Unlike _scopes this is NOT gated on capture
+# being enabled: a list push per batch is host-side noise, and the ids
+# must be present whenever a capture fires mid-batch.
+_serve_requests: List[tuple] = []
+
 # Per-API-call scope stack (pushed by quda_api's _pm_api guard): gives
 # capture sites deep in the call tree the API name, the caller's
 # source/param, and the knob snapshot AS OF API ENTRY (an escalation
@@ -137,10 +147,29 @@ def reset_session():
     # capture can be in flight; the scope stack is per-call LIFO state
     # a lock cannot meaningfully serialize
     _scopes.clear()  # quda-lint: disable=lock-discipline  reason=session teardown; no capture is in flight across init/end boundaries
+    _serve_requests.clear()  # quda-lint: disable=lock-discipline  reason=session teardown; the solve-service worker is stopped before end_quda runs
 
 
 def current_scope() -> Optional[dict]:
     return _scopes[-1] if _scopes else None
+
+
+@contextlib.contextmanager
+def serve_requests(ids):
+    """Mark the solve-service request ids riding the current API call
+    (see the stack comment above).  The worker wraps each executed
+    batch; nesting is the worker's own call nesting, single-threaded
+    by the service's one-worker contract."""
+    _serve_requests.append(tuple(str(i) for i in ids))  # quda-lint: disable=lock-discipline  reason=per-batch LIFO context stack, push/pop ordering is the worker thread's own nesting
+    try:
+        yield
+    finally:
+        _serve_requests.pop()  # quda-lint: disable=lock-discipline  reason=per-batch LIFO context stack, push/pop ordering is the worker thread's own nesting
+
+
+def current_request_ids() -> tuple:
+    """The innermost serve-request ids (() outside the service)."""
+    return _serve_requests[-1] if _serve_requests else ()
 
 
 @contextlib.contextmanager
@@ -237,7 +266,8 @@ def capture(trigger: str, api: Optional[str] = None, param=None,
     with _bundles_lock:
         _inflight -= 1         # reservation becomes the real entry
         _bundles.append({"path": path, "trigger": trigger, "api": api,
-                         "wall": time.time()})
+                         "wall": time.time(),
+                         "request_ids": list(current_request_ids())})
     omet.inc("postmortems_total", trigger=trigger)
     otr.event("postmortem_written", cat="postmortem", trigger=trigger,
               api=api, path=path)
@@ -419,11 +449,18 @@ def _write_bundle(trigger: str, api: str, param, fields, exc, note,
         except Exception:      # noqa: BLE001
             pass
 
+    # request-id correlation: the solve-service ids riding this API
+    # call (serve_requests scope).  request_id is the one-grep key for
+    # the single-request case; batched captures keep the full list
+    rids = current_request_ids()
+
     # manifest LAST: its presence marks the bundle complete
     manifest = {
         "schema": 1,
         "trigger": trigger,
         "api": api,
+        "request_id": rids[0] if len(rids) == 1 else None,
+        "request_ids": list(rids),
         "wall_time": time.time(),
         "written": time.strftime("%Y-%m-%d %H:%M:%S"),
         "note": note,
@@ -503,6 +540,7 @@ def write_artifacts_manifest(artifacts: dict,
         "postmortems": [
             {"path": b["path"], "trigger": b["trigger"],
              "api": b["api"],
+             "request_ids": b.get("request_ids", []),
              "manifest": os.path.join(b["path"], "manifest.json"),
              "bytes": _tree_bytes(b["path"])}
             for b in _bundles],

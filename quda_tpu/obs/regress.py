@@ -135,12 +135,19 @@ def canonicalize_recorded(recorded, stats: Optional[dict] = None
 
 
 def write_trends(hist: qhist.History, current: List[dict],
-                 path: Optional[str] = None) -> str:
-    """trends.tsv under the resource path (else the history dir):
-    the citable trend table."""
+                 path: Optional[str] = None) -> Optional[str]:
+    """trends.tsv: the citable trend table.  Destination: explicit
+    ``path`` (--trends / bench_suite --artifacts-dir) > the resource
+    path > an EXPLICITLY configured QUDA_TPU_BENCH_HISTORY_DIR.  With
+    none of those, returns None without writing — the history-dir
+    fallback is the repo root, and a bare compare run must not drop
+    artifacts into the working tree (the write_artifacts_manifest
+    contract)."""
     if not path:
         base = (_conf("QUDA_TPU_RESOURCE_PATH")
-                or default_history_dir())
+                or _conf("QUDA_TPU_BENCH_HISTORY_DIR"))
+        if not base:
+            return None
         path = os.path.join(base, "trends.tsv")
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(path, "w") as fh:

@@ -213,14 +213,16 @@ def _hist_percentile_bounds(h, qs=(0.5, 0.9, 0.99)):
     """Upper-bound percentile estimates from the cumulative histogram
     buckets: the tightest bucket bound covering each quantile (the
     standard Prometheus-histogram read; exact values are not retained
-    by design).  Returns {q: bound-or-None}, None meaning the +Inf
-    bucket."""
+    by design, so every estimate is an UPPER bound and is rendered as
+    one — p50≤, never p50=).  Buckets come from the histogram itself
+    (QUDA_TPU_SERVE_SLO_BUCKETS may have reshaped them).  Returns
+    {q: bound-or-None}, None meaning the +Inf bucket."""
     bounds = {}
     for q in qs:
         target = q * h["n"]
         cum = 0
         val = None
-        for i, ub in enumerate(omet.HIST_BUCKETS):
+        for i, ub in enumerate(h.get("buckets", omet.HIST_BUCKETS)):
             cum += h["counts"][i]
             if cum >= target:
                 val = ub
@@ -256,15 +258,16 @@ def _render_service(snap: dict, lines: list):
     for labels, h in _by_name(snap, "histograms",
                               "serve_request_seconds"):
         b = _hist_percentile_bounds(h)
+        last = h.get("buckets", omet.HIST_BUCKETS)[-1]
         pct = ", ".join(
-            f"p{int(q * 100)} "
-            + (f"<= {ub:g} s" if ub is not None
-               else f"> {omet.HIST_BUCKETS[-1]:g} s")
+            (f"p{int(q * 100)}≤ {ub:g} s" if ub is not None
+             else f"p{int(q * 100)}> {last:g} s")
             for q, ub in b.items())
         mean = h["sum"] / max(1, h["n"])
         lines.append(f"  solve_seconds SLO "
                      f"[{labels.get('family', '?')}]: {pct} "
-                     f"(n={h['n']}, mean {mean:.3f} s)")
+                     f"(bucket upper bounds; n={h['n']}, "
+                     f"mean {mean:.3f} s)")
     gauges_seen = {}
     for metric, col in (("serve_gauge_hits_total", "hits"),
                         ("serve_gauge_activations_total",

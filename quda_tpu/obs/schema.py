@@ -105,6 +105,16 @@ TRACE_EVENTS: dict[str, dict] = {
                          "doc": "worker warm start: persisted "
                                 "compilation-cache dir + executable-key "
                                 "index load stats"},
+    # live telemetry plane (obs/live.py)
+    "live_started": {"cat": "live",
+                     "doc": "telemetry HTTP server bound (port + "
+                            "flusher interval) — the scrape plane is "
+                            "answering while the worker drains"},
+    "live_flush": {"cat": "live",
+                   "doc": "one periodic artifact flush window "
+                          "completed (QUDA_TPU_METRICS_FLUSH_SEC): "
+                          "metrics/fleet/flight/roofline rewritten "
+                          "under the resource path"},
     # failure capture (obs/postmortem.py / obs/flight.py)
     "postmortem_written": {"cat": "postmortem",
                            "doc": "one failure-capture bundle written "
@@ -267,6 +277,23 @@ METRICS: dict[str, dict] = {
         "help": "persisted executable-key index at worker warm start, "
                 "by scope (loaded = keys seeded into compile "
                 "accounting, saved = keys written at shutdown)"},
+    # live telemetry plane (obs/live.py)
+    "live_scrapes_total": {
+        "type": COUNTER,
+        "help": "telemetry-endpoint requests answered, by endpoint "
+                "(metrics | healthz | readyz | fleet | slo) and HTTP "
+                "status class"},
+    "live_flushes_total": {
+        "type": COUNTER,
+        "help": "periodic background artifact flushes completed by "
+                "the live plane (QUDA_TPU_METRICS_FLUSH_SEC windows)"},
+    "slo_burn_rate": {
+        "type": GAUGE,
+        "help": "serve_request_seconds error-budget burn rate at the "
+                "last /slo evaluation, by family ('all' = every "
+                "family pooled): (1 - compliance) / "
+                "(1 - QUDA_TPU_SLO_OBJECTIVE) against "
+                "QUDA_TPU_SLO_TARGET_MS"},
     # bench harness (bench_suite.py)
     "bench_rows_total": {
         "type": COUNTER,

@@ -37,6 +37,9 @@ class SolveRequest:
     gauge_id: str
     ticket: Any = None            # service.SolveTicket
     submitted: float = 0.0        # time.monotonic() at submit
+    request_id: str = ""          # minted at submit; rides the batch
+    #                               into the API span/flight events and
+    #                               any postmortem bundle's manifest
 
 
 # InvertParam fields that do NOT define the solve: results the API

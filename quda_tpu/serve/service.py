@@ -42,6 +42,8 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import itertools
+import os
 import queue as _queue
 import threading
 import time
@@ -67,6 +69,9 @@ class SolveOutcome:
     gauge_id: str
     error: Optional[str] = None
     param: Any = None             # the executed param copy (results)
+    request_id: str = ""          # the ticket's id — grep key into
+    #                               trace spans, availability events,
+    #                               and postmortem manifests
 
 
 class SolveTicket:
@@ -79,7 +84,8 @@ class SolveTicket:
     and the timeout raises the BUILTIN TimeoutError on every supported
     Python (futures.TimeoutError is a distinct class before 3.11)."""
 
-    def __init__(self):
+    def __init__(self, request_id: str = ""):
+        self.request_id = request_id
         self._event = threading.Event()
         self._outcome: Optional[SolveOutcome] = None
 
@@ -128,6 +134,10 @@ class SolveService:
         self._pending_cv = threading.Condition()
         self._peak_depth = 0
         self.warm: Optional[dict] = None
+        # request-id mint: pid-qualified so ids stay grep-unique when
+        # several workers share one resource path (the fleet setup that
+        # also pid-qualifies postmortem bundle dirs)
+        self._rid_seq = itertools.count(1)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -152,6 +162,13 @@ class SolveService:
                                             name="quda-serve",
                                             daemon=True)
             self._thread.start()
+        # live telemetry plane: init_quda's maybe_start covers the
+        # service-owned-session path; an already-initialized session
+        # gets its chance here, and either way /healthz //readyz now
+        # answer for THIS worker (one global load each when off)
+        from ..obs import live as olive
+        olive.maybe_start()
+        olive.attach(self)
         return self
 
     def stop(self, end_session: Optional[bool] = None):
@@ -190,6 +207,8 @@ class SolveService:
                        len(leftovers))
         persist.save_warm_keys()
         self.residency.drop_all()
+        from ..obs import live as olive
+        olive.detach(self)
         end = self._owns_init if end_session is None else end_session
         if end:
             from ..interfaces import quda_api as api
@@ -223,14 +242,20 @@ class SolveService:
         """Enqueue one solve against a registered gauge; returns the
         ticket its SolveOutcome will be delivered on.  ``param`` is a
         template — the service copies it per executed batch, so one
-        template may back many concurrent submissions."""
+        template may back many concurrent submissions.  The ticket's
+        ``request_id`` is the correlation key: it labels the request's
+        availability events, rides the batch into the API span/flight
+        stream, and lands in any postmortem bundle's manifest — failed
+        ticket to bundle in one grep."""
         if gauge_id not in self._gauges:
             raise KeyError(f"gauge {gauge_id!r} is not registered; "
                            "call load_gauge first")
-        ticket = SolveTicket()
+        rid = f"rq-{os.getpid()}-{next(self._rid_seq):06d}"
+        ticket = SolveTicket(request_id=rid)
         req = batcher.SolveRequest(source=source, param=param,
                                    gauge_id=gauge_id, ticket=ticket,
-                                   submitted=time.monotonic())
+                                   submitted=time.monotonic(),
+                                   request_id=rid)
         with self._lifecycle:
             if self._stopped:
                 raise RuntimeError(
@@ -244,6 +269,23 @@ class SolveService:
         # gauge at each collection
         self._peak_depth = max(self._peak_depth, self._queue.qsize())
         return ticket
+
+    def health(self) -> dict:
+        """Liveness/readiness signals for the telemetry plane
+        (obs/live.py /healthz //readyz) — host-side reads only."""
+        t = self._thread
+        return {
+            "worker_alive": bool(t is not None and t.is_alive()),
+            "stopped": self._stopped,
+            "warm_start_complete": self.warm is not None,
+            # a registered host gauge can be served (residency loads
+            # it on first use); resident ids cover the already-active
+            # case after drop/eviction churn
+            "gauge_present": bool(self._gauges)
+                             or bool(self.residency.resident_ids()),
+            "queue_depth": self._queue.qsize(),
+            "pending": self._pending,
+        }
 
     # -- worker --------------------------------------------------------------
 
@@ -330,12 +372,13 @@ class SolveService:
                 kind = st.split(":", 1)[0]
                 omet.inc("serve_availability_events_total", kind=kind)
                 otr.event("serve_availability", cat="serve", kind=kind,
-                          gauge=gid, status=st)
+                          gauge=gid, status=st,
+                          request_id=r.request_id)
             self._deliver(r, SolveOutcome(
                 x=xs[i], status=st, converged=bool(conv[i]),
                 iter_count=int(iters[i]), true_res=float(res[i]),
                 secs=secs_req, batch_size=n, gauge_id=gid,
-                param=param))
+                param=param, request_id=r.request_id))
 
     def _fail(self, reqs, err: str, batch_size: int):
         """Deliver a failed outcome (+ the availability accounting) to
@@ -362,38 +405,48 @@ class SolveService:
                          family=family)
             omet.inc("serve_availability_events_total", kind="failed")
             otr.event("serve_availability", cat="serve", kind="failed",
-                      gauge=r.gauge_id, error=err[:200])
+                      gauge=r.gauge_id, error=err[:200],
+                      request_id=getattr(r, "request_id", ""))
             self._deliver(r, SolveOutcome(
                 x=None, status="failed", converged=False,
                 iter_count=0, true_res=float("nan"), secs=secs_req,
-                batch_size=batch_size, gauge_id=r.gauge_id, error=err))
+                batch_size=batch_size, gauge_id=r.gauge_id, error=err,
+                request_id=getattr(r, "request_id", "")))
 
     def _solve(self, grp, gid, param):
         """Activate the gauge and run the group as ONE solve: the MRHS
-        batch route for n > 1, plain invert_quda for singletons."""
+        batch route for n > 1, plain invert_quda for singletons.  The
+        whole API call runs inside the postmortem serve-request scope
+        so every span/flight attribute and any bundle captured on a
+        failure path carries the batch's request ids (the flight-
+        capture analysis rule pins this wrapping)."""
         import jax.numpy as jnp
 
         from ..interfaces import quda_api as api
+        from ..obs import postmortem as opm
         self.residency.ensure_active(
             gid, loader=self._loader(gid),
             version=self._gauge_versions.get(gid))
         n = len(grp)
-        if n == 1:
-            # multishift singletons (never batched — batcher.solve_key)
-            # take their own API entry point; x is the stacked
-            # per-shift solution batch, results are the batch-level
-            # param fields (converged_multi holds the per-shift claims)
-            if getattr(param, "num_offset", 0):
-                x = api.invert_multishift_quda(grp[0].source, param)
-            else:
-                x = api.invert_quda(grp[0].source, param)
-            st = (getattr(param, "solve_status", None)
-                  or ("converged" if param.converged
-                      else "unconverged"))
-            return ([x], [st], [param.converged], [param.iter_count],
-                    [param.true_res])
-        B = jnp.stack([jnp.asarray(r.source) for r in grp])
-        X = api.invert_multi_src_quda(B, param)
+        with opm.serve_requests([r.request_id for r in grp]):
+            if n == 1:
+                # multishift singletons (never batched —
+                # batcher.solve_key) take their own API entry point; x
+                # is the stacked per-shift solution batch, results are
+                # the batch-level param fields (converged_multi holds
+                # the per-shift claims)
+                if getattr(param, "num_offset", 0):
+                    x = api.invert_multishift_quda(grp[0].source,
+                                                   param)
+                else:
+                    x = api.invert_quda(grp[0].source, param)
+                st = (getattr(param, "solve_status", None)
+                      or ("converged" if param.converged
+                          else "unconverged"))
+                return ([x], [st], [param.converged],
+                        [param.iter_count], [param.true_res])
+            B = jnp.stack([jnp.asarray(r.source) for r in grp])
+            X = api.invert_multi_src_quda(B, param)
         conv = list(getattr(param, "converged_multi", None)
                     or [param.converged] * n)
         batch_st = getattr(param, "solve_status", None)

@@ -536,6 +536,54 @@ _register("QUDA_TPU_SERVE_COMPILE_CACHE", "choice", "",
           reference="QUDA_RESOURCE_PATH persistent tunecache as the "
                     "cross-process warm-start surface")
 
+# -- live telemetry plane (quda_tpu/obs/live.py) ----------------------------
+_register("QUDA_TPU_LIVE", "bool", False,
+          "serve the live telemetry HTTP plane (obs/live.py): a "
+          "loopback ThreadingHTTPServer answering /metrics (Prometheus "
+          "text from a lock-consistent registry snapshot, no reset), "
+          "/healthz, /readyz, /fleet (live fleet_report.txt render), "
+          "and /slo (serve_request_seconds burn rate) while the solve "
+          "service keeps draining; off (default) = no server thread, "
+          "no socket, and bit-identical compiled solves (pinned by "
+          "raising-stub test)",
+          reference="NVTX-annotated wrappers + QUDA_RESOURCE_PATH "
+                    "artifacts (lib/generate/wrap.py) as the fleet-"
+                    "introspection analog")
+_register("QUDA_TPU_LIVE_PORT", "int", 0,
+          "TCP port for the live telemetry endpoint, bound on "
+          "127.0.0.1; 0 (default) = OS-assigned ephemeral port "
+          "(obs.live.port() reports the bound one)",
+          reference="pull-based Prometheus scrape discipline")
+_register("QUDA_TPU_METRICS_FLUSH_SEC", "float", 0.0,
+          "interval (seconds) for the live plane's background flusher: "
+          "rewrites metrics.prom/metrics.tsv, fleet_report.txt, "
+          "flight.jsonl, and roofline.tsv under the resource path "
+          "every window so a crashed worker loses at most one "
+          "interval of telemetry; 0 (default) disables the flusher "
+          "(artifacts export at end_quda only)",
+          reference="tunecache.tsv incremental persistence "
+                    "(lib/tune.cpp:450-610)")
+_register("QUDA_TPU_SLO_TARGET_MS", "float", 1000.0,
+          "request-latency SLO target (milliseconds) the /slo endpoint "
+          "grades serve_request_seconds against: a request is 'good' "
+          "when its histogram bucket's upper bound is within the "
+          "target",
+          reference="fleet availability accounting (ROADMAP item 2)")
+_register("QUDA_TPU_SLO_OBJECTIVE", "float", 0.99,
+          "SLO objective: the fraction of requests required under "
+          "QUDA_TPU_SLO_TARGET_MS.  /slo reports burn rate = "
+          "(1 - compliance) / (1 - objective) — burn > 1 means the "
+          "error budget is being spent faster than provisioned",
+          reference="fleet availability accounting (ROADMAP item 2)")
+_register("QUDA_TPU_SERVE_SLO_BUCKETS", "str", "",
+          "comma-separated histogram bucket upper bounds (seconds) for "
+          "serve_request_seconds, e.g. '0.05,0.1,0.25,0.5,1'; empty "
+          "(default) = the registry-wide HIST_BUCKETS.  Set this when "
+          "the SLO target sits inside one default bucket — percentile "
+          "upper bounds and the /slo burn rate can only be as sharp "
+          "as the bucket grid",
+          reference="pull-based Prometheus scrape discipline")
+
 # CUDA-runtime knobs deliberately not carried over: the replacing
 # subsystem answers "where did it go".
 SUBSUMED = {

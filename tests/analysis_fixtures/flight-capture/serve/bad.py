@@ -1,0 +1,9 @@
+"""Seeded violation: a serve-scoped worker runs the solve API outside
+a serve_requests(...) scope — a postmortem bundle captured during the
+solve cannot carry the tickets' request_id."""
+
+
+def execute_batch(api, grp, param):
+    import jax.numpy as jnp
+    B = jnp.stack([r.source for r in grp])
+    return api.invert_multi_src_quda(B, param)         # finding

@@ -1,0 +1,7 @@
+"""Suppressed twin: the unscoped solve call is reasoned."""
+
+
+def execute_batch(api, grp, param):
+    import jax.numpy as jnp
+    B = jnp.stack([r.source for r in grp])
+    return api.invert_multi_src_quda(B, param)  # quda-lint: disable=flight-capture  reason=fixture pin: replay harness re-running a recorded batch whose manifest already carries the original request ids

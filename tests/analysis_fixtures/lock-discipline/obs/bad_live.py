@@ -1,0 +1,10 @@
+"""Seeded violation, live-telemetry shape: scrape handlers run on the
+HTTP server's thread pool, so module-level scrape accounting mutated
+without a lock races across concurrent scrapes."""
+
+_scrape_counts = {}
+
+
+def handle(path):
+    _scrape_counts[path] = _scrape_counts.get(path, 0) + 1   # finding
+    return 200

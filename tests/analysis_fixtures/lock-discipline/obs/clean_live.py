@@ -1,0 +1,23 @@
+"""Clean twin, live-telemetry shape: mutable scrape state lives on the
+session instance behind its lock (the obs/live.py pattern) — handler
+threads mutate under `with s.lock`, module level holds only the
+session slot."""
+
+import threading
+
+_session = None
+
+
+class _Live:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.scrape_counts = {}
+
+
+def handle(path):
+    s = _session
+    if s is None:
+        return 503
+    with s.lock:
+        s.scrape_counts[path] = s.scrape_counts.get(path, 0) + 1
+    return 200

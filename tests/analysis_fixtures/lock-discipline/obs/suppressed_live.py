@@ -1,0 +1,8 @@
+"""Suppressed twin: the unlocked scrape accounting is reasoned."""
+
+_scrape_counts = {}
+
+
+def handle(path):
+    _scrape_counts[path] = _scrape_counts.get(path, 0) + 1  # quda-lint: disable=lock-discipline  reason=fixture pin: single-threaded test server, handler concurrency is 1 by construction
+    return 200
