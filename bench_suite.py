@@ -1116,6 +1116,149 @@ def main(argv):
                                   "name": "cg_staggered_pc_24",
                                   "error": str(e)[:140]}), flush=True)
 
+            # --- operator-zoo chip rows (round 18): clover, twisted-
+            # clover, and Möbius through the SAME pallas-in-solver
+            # pipeline.  Per family: a fused-vs-staged M-apply A/B at
+            # identical flop accounting (the acceptance bar lives in
+            # speedup_vs_xla: fused >= 1.5x) plus the end-to-end CGNR
+            # solver row on the fused form.  Forms are pinned at
+            # construction — the staggered precedent above: the
+            # construction-time race cannot execute pallas on the CPU
+            # staging device — and the resident pair arrays move to the
+            # bench device afterwards.
+            def _zoo_to_device(op):
+                for attr in ("gauge_eo_pp", "_u_bw",
+                             "_m5p", "_mix", "_m5i"):
+                    v = getattr(op, attr, None)
+                    if v is not None:
+                        setattr(op, attr, tuple(
+                            jax.device_put(np.asarray(g)) for g in v))
+                for attr in ("clover_p_pp", "clover_inv_q_pp"):
+                    if hasattr(op, attr):
+                        setattr(op, attr, jax.device_put(
+                            np.asarray(getattr(op, attr))))
+                if hasattr(op, "tw_inv_q_pp"):
+                    op.tw_inv_q_pp = {
+                        s: jax.device_put(np.asarray(b))
+                        for s, b in op.tw_inv_q_pp.items()}
+                return op
+
+            def _zoo_chip_rows(fused_name, xla_name, cg_name,
+                               build_dpc, fl_site, model_p, model_x,
+                               seed, ls5=None):
+                """One zoo family at Lc^4: fused/staged apply A/B rows
+                (form = the KERNEL_MODELS label, so --compare joins the
+                roofline attribution) and the fused CGNR solver row."""
+                try:
+                    with jax.default_device(cpu0):
+                        dpc_z = build_dpc()
+                        op_p = dpc_z.pairs(jnp.float32, use_pallas=True,
+                                           form="pallas")
+                        op_x = dpc_z.pairs(jnp.float32, use_pallas=True,
+                                           form="xla")
+                    _zoo_to_device(op_p)
+                    _zoo_to_device(op_x)
+                    T_z, Z_z = op_p.dims[0], op_p.dims[1]
+                    yxh = op_p.gauge_eo_pp[0].shape[-1]
+                    shp = (4, 3, 2, T_z, Z_z, yxh)
+                    if ls5:
+                        shp = (ls5,) + shp
+                    rng_z = np.random.default_rng(seed)
+                    rhs_z = jax.device_put(jnp.asarray(
+                        rng_z.standard_normal(shp).astype(np.float32)))
+                    rhs_z.block_until_ready()
+                    fl_M = fl_site * (vol_c // 2)
+                    secs_p = _bench_op(op_p.M_pairs, rhs_z, n1=4, n2=40)
+                    secs_x = _bench_op(op_x.M_pairs, rhs_z, n1=4, n2=40)
+                    _emit("solver", fused_name, secs_p, fl_M, 0,
+                          platform, (Lc,) * 4, banner=banner,
+                          kind="apply", form=model_p,
+                          speedup_vs_xla=(round(secs_x / secs_p, 2)
+                                          if secs_p > 0 else None))
+                    _emit("solver", xla_name, secs_x, fl_M, 0,
+                          platform, (Lc,) * 4, banner=banner,
+                          kind="apply", form=model_x)
+                    solver_row(cg_name,
+                               jax.jit(lambda b: cg(
+                                   op_p.MdagM_pairs,
+                                   op_p.Mdag_pairs(b),
+                                   tol=1e-6, maxiter=600)),
+                               rhs_z, 2 * fl_M, Lc, form=model_p)
+                    return op_p, rhs_z
+                except Exception as e:
+                    print(json.dumps({"suite": "solver",
+                                      "name": fused_name,
+                                      "error": str(e)[:140]}),
+                          flush=True)
+                    return None, None
+
+            from quda_tpu.models.clover import DiracCloverPC
+            from quda_tpu.models.domain_wall import DiracMobiusPC
+            from quda_tpu.models.twisted import DiracTwistedCloverPC
+
+            _zoo_chip_rows(
+                "clover_pallas_24", "clover_xla_24",
+                "cgnr_clover_pc_f32pairs_pallas_24",
+                lambda: DiracCloverPC(jax.device_put(gc_h, cpu0),
+                                      geo_c, 0.124, 1.0),
+                2 * 1320 + 2 * 504 + 48,
+                "clover_pallas", "clover_xla", 21)
+            _zoo_chip_rows(
+                "twisted_clover_pallas_24", "twisted_clover_xla_24",
+                "cgnr_twisted_clover_pc_f32pairs_pallas_24",
+                lambda: DiracTwistedCloverPC(
+                    jax.device_put(gc_h, cpu0), geo_c, 0.124, 0.08,
+                    1.0),
+                2 * 1320 + 2 * 504 + 48,
+                "twisted_clover_pallas", "twisted_clover_xla", 22)
+            op_dw, rhs_dw = _zoo_chip_rows(
+                "dwf_ls8_pallas_24", "dwf_ls8_xla_24",
+                "cgnr_mobius_pc_f32pairs_pallas_ls8_24",
+                lambda: DiracMobiusPC(jax.device_put(gc_h, cpu0),
+                                      geo_c, 8, 1.8, 0.05, 1.5, 0.5),
+                8 * (2 * 1320 + 3 * 96 * 8),
+                "dwf_ls8_pallas", "dwf_xla", 23, ls5=8)
+
+            # DWF MRHS amortization: 4 sources x Ls=8 planes through
+            # ONE resident gauge tile (the (N*Ls)-deep batch of
+            # ops/dwf_pallas) vs 4 single-source Ls-batched hops —
+            # the per-plane link traffic drops from 576/Ls to
+            # 576/(N*Ls) B/site, and the ratio here measures what that
+            # buys on chip.
+            if op_dw is not None:
+                try:
+                    from quda_tpu.ops import dwf_pallas as dwp
+                    n_src = 4
+                    p5 = op_dw.matpc
+                    dims_c = tuple(op_dw.dims)
+                    u_here = op_dw.gauge_eo_pp[p5]
+                    u_bw = op_dw._u_bw[p5]
+                    rhs_dwb = jnp.stack([jnp.roll(rhs_dw, i, axis=-1)
+                                         for i in range(n_src)])
+                    rhs_dwb.block_until_ready()
+                    secs_1 = _bench_op(
+                        lambda u, ub, v: dwp.dslash_eo_pallas_packed_ls(
+                            u, ub, v, dims_c, p5),
+                        rhs_dw, consts=(u_here, u_bw), n1=4, n2=40)
+                    secs_b = _bench_op(
+                        lambda u, ub, v:
+                            dwp.dslash_eo_pallas_packed_ls_mrhs(
+                                u, ub, v, dims_c, p5),
+                        rhs_dwb, consts=(u_here, u_bw), n1=4, n2=40)
+                    fl_hop = 8 * 1320 * (vol_c // 2)
+                    _emit("solver", "dwf_ls8_mrhs4_hop_24", secs_b,
+                          n_src * fl_hop, 0, platform, (Lc,) * 4,
+                          banner=banner, kind="apply", nrhs=n_src,
+                          form="dwf_ls8_pallas_mrhs",
+                          amortization_vs_single=(
+                              round(n_src * secs_1 / secs_b, 2)
+                              if secs_b > 0 else None))
+                except Exception as e:
+                    print(json.dumps({"suite": "solver",
+                                      "name": "dwf_ls8_mrhs4_hop_24",
+                                      "error": str(e)[:140]}),
+                          flush=True)
+
     if "sharded" in suites and suite_guard("sharded"):
         # Multi-chip dslash policy A/B at 24^4 (round-8 tentpole): the
         # rows the next multi-chip window needs to settle (a) v2-sharded

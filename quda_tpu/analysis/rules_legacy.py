@@ -129,7 +129,8 @@ def check_obs_schema_orphans(index):
 
 # -- roofline-model ---------------------------------------------------------
 
-_FORM_PREFIXES = ("wilson", "staggered", "generic", "mg_coarse")
+_FORM_PREFIXES = ("wilson", "staggered", "generic", "mg_coarse",
+                  "clover", "twisted", "dwf")
 
 
 def _roofline_literals(mod):

@@ -922,6 +922,36 @@ def _solve_form(d) -> str:
                 return ("staggered_fat_naik" if base == "fat_naik"
                         else "staggered_fat")
         return "staggered_xla"
+    # operator zoo (PERF.md round 18).  The fused/staged split is read
+    # off the authoritative construction-time attribute (_op_form,
+    # models/formsel resolution); r12 off the resident link shape as in
+    # the wilson branch.  Order matters: 'ndeg' before 'twisted'
+    # (doublet classes contain 'twisted'), 'twisted' before 'clover'
+    # (DiracTwistedCloverPCPairs contains both).
+    gpp = getattr(op, "gauge_eo_pp", None)
+    r12 = (gpp is not None and len(gpp) > 0 and gpp[0].shape[1] == 2)
+    suffix = "_r12" if r12 else ""
+    fused = getattr(op, "_op_form", None) == "pallas"
+    if "ndeg" in name:
+        # doublet operators keep the staged composition permanently
+        # (flavor mixing is not an epilogue term) — flops-only label
+        return "twisted_xla"
+    if "twistedclover" in name:
+        return (f"twisted_clover_pallas{suffix}" if fused
+                else "twisted_clover_xla")
+    if "twisted" in name:
+        return (f"twisted_mass_pallas{suffix}" if fused
+                else "twisted_xla")
+    if "clover" in name:
+        return f"clover_pallas{suffix}" if fused else "clover_xla"
+    if "mobius" in name or "domainwall" in name:
+        if fused:
+            ls = getattr(op, "ls", None)
+            # only Ls in {4, 8} carry traffic models (roofline.py);
+            # other Ls report honest flops-only via 'dwf_pallas'
+            return (f"dwf_ls{ls}_pallas" if ls in (4, 8)
+                    else "dwf_pallas")
+        return "dwf_xla"
     return "generic"
 
 
@@ -1503,8 +1533,16 @@ def _invert_multi_src_body(sources, param: InvertParam):
     # the slow per-source path end to end); checked against ``mesh is
     # None`` at the route decision below, AFTER the split-grid gate may
     # have released an unusable mesh back to this route
+    # operator-zoo Schur families (round 18): clover/twisted-mass/
+    # twisted-clover ride the same batched-pairs pipeline via the
+    # _SchurPairOpBase MRHS suite.  Doublet (ndeg) and DWF operators
+    # stay per-source: the doublet flavor axis and the Ls axis already
+    # occupy the batch dimension their kernels lead with.
+    zoo_family = param.dslash_type in ("clover", "twisted-mass",
+                                       "twisted-clover")
     batched_able = (pc
-                    and (param.dslash_type == "wilson" or stag_family)
+                    and (param.dslash_type == "wilson" or stag_family
+                         or zoo_family)
                     and cg_family and tol_ok
                     and (param.cuda_prec == "single" or on_tpu)
                     and _packed_enabled(on_tpu))
@@ -1512,6 +1550,10 @@ def _invert_multi_src_body(sources, param: InvertParam):
     if stag_family:
         flops_m = 2 * (1146 if param.dslash_type != "staggered"
                        else 570) + 24
+    elif param.dslash_type in ("clover", "twisted-clover"):
+        flops_m = 2 * 1320 + 2 * 504 + 48
+    elif param.dslash_type == "twisted-mass":
+        flops_m = 2 * 1320 + 192
     else:
         flops_m = 2 * 1320 + 48
 
@@ -1689,8 +1731,9 @@ def _invert_multi_src_body(sources, param: InvertParam):
         t_solve = time.perf_counter() - t_solve0
         _record_solve_metrics(
             "invert_multi_src_quda",
-            ("staggered" if stag_family else "wilson")
-            + "_batched_pairs",
+            ("staggered" if stag_family
+             else param.dslash_type.replace("-", "_") if zoo_family
+             else "wilson") + "_batched_pairs",
             solver_name, t_solve, param.dslash_type, param.cuda_prec)
         conv = np.asarray(res.converged)
         if not conv.all():
@@ -1721,8 +1764,18 @@ def _invert_multi_src_body(sources, param: InvertParam):
                                 b2=b2_rhs)
             oconv.publish(rec, param)
             from ..obs import roofline as orf
+            zoo_fused = getattr(op, "_op_form", None) == "pallas"
             if not getattr(op, "use_pallas", False):
                 form = "generic"
+            elif param.dslash_type == "clover":
+                form = ("clover_pallas_mrhs" if zoo_fused
+                        else "clover_xla")
+            elif param.dslash_type == "twisted-mass":
+                form = ("twisted_mass_pallas_mrhs" if zoo_fused
+                        else "twisted_xla")
+            elif param.dslash_type == "twisted-clover":
+                form = ("twisted_clover_pallas_mrhs" if zoo_fused
+                        else "twisted_clover_xla")
             elif not stag_family:
                 form = "wilson_mrhs"
             else:

@@ -115,11 +115,18 @@ class DiracCloverPC(DiracPC):
         return 2 * 1320 + 2 * 504 + 48
 
     def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
-              pallas_interpret: bool = False) -> "DiracCloverPCPairs":
+              pallas_interpret: bool = False,
+              pallas_version: int | None = None,
+              form: str | None = None) -> "DiracCloverPCPairs":
         """Complex-free packed companion (f32 = the precise TPU solve
-        path; bf16 = the sloppy clover operator of mixed solves)."""
+        path; bf16 = the sloppy clover operator of mixed solves).
+        ``form`` / QUDA_TPU_CLOVER_FORM picks fused-pallas vs staged-XLA
+        (models/formsel); the legacy ``pallas_version`` kwarg maps
+        through it (v!=2 has no fused form)."""
         return DiracCloverPCPairs(self, store_dtype, use_pallas,
-                                  pallas_interpret)
+                                  pallas_interpret,
+                                  pallas_version=pallas_version,
+                                  form=form)
 
 
 def pack_clover_pairs(blocks: jnp.ndarray, store_dtype) -> jnp.ndarray:
@@ -170,10 +177,13 @@ class DiracCloverPCPairs(_SchurPairOpBase):
     """
 
     def __init__(self, dpc: "DiracCloverPC", store_dtype=jnp.float32,
-                 use_pallas: bool = False, pallas_interpret: bool = False):
+                 use_pallas: bool = False, pallas_interpret: bool = False,
+                 pallas_version: int | None = None,
+                 form: str | None = None):
         from ..ops import wilson_packed as wpk
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
                         store_dtype, use_pallas, pallas_interpret,
+                        pallas_version=pallas_version,
                         tb_sign=getattr(dpc, 'antiperiodic_t',
                                         True))
         self.kappa = float(dpc.kappa)
@@ -182,9 +192,27 @@ class DiracCloverPCPairs(_SchurPairOpBase):
                                              store_dtype)
         self.clover_inv_q_pp = pack_clover_pairs(dpc.clover_inv_q,
                                                  store_dtype)
+        from ..obs import memory as omem
+        omem.track("clover", "clover_pair_blocks",
+                   (self.clover_p_pp, self.clover_inv_q_pp))
+        from . import formsel
+        aux = jnp.dtype(store_dtype).name
+        self._op_form = formsel.resolve_form(
+            "clover", form, self,
+            race=lambda: formsel.race_schur("clover", self, aux=aux),
+            aux=aux)
 
     def _diag_sign_pairs(self, x, sign, out_dtype):
         return apply_clover_pairs(self.clover_p_pp, x, out_dtype)
 
     def _Ainv_q_sign_pairs(self, x, sign, out_dtype):
         return apply_clover_pairs(self.clover_inv_q_pp, x, out_dtype)
+
+    # fused-epilogue descriptors (ops/clover_pallas via _SchurPairOpBase):
+    # K1 = Ainv_q blocks post-hop, K2 = A_p blocks on the original x —
+    # both sign-independent (the clover PC operator is g5-hermitian)
+    def _fused_k1_params(self, sign):
+        return self.clover_inv_q_pp, None
+
+    def _fused_k2_params(self, sign):
+        return self.clover_p_pp, None

@@ -153,12 +153,18 @@ class DiracMobiusPC(DiracPC):
         return 2 * 1320 + 3 * 96 * self.ls
 
     def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
-              pallas_interpret: bool = False) -> "DiracMobiusPCPairs":
+              pallas_interpret: bool = False,
+              pallas_version: int | None = None,
+              form: str | None = None) -> "DiracMobiusPCPairs":
         """Complex-free packed companion (f32 = the precise TPU solve
         path; bf16 = the sloppy operator) — also serves the EOFA
-        subclass, whose corrected s-blocks it reads."""
+        subclass, whose corrected s-blocks it reads.  ``form`` /
+        QUDA_TPU_DWF_FORM picks the Ls-batched 4d hop kernel vs the
+        vmap-over-s stencil (models/formsel)."""
         return DiracMobiusPCPairs(self, store_dtype, use_pallas,
-                                  pallas_interpret)
+                                  pallas_interpret,
+                                  pallas_version=pallas_version,
+                                  form=form)
 
 
 class _LsPairIOMixin:
@@ -208,11 +214,14 @@ class DiracMobiusPCPairs(_LsPairIOMixin, _PackedHopMixin):
     hermitian = False
 
     def __init__(self, dpc: DiracMobiusPC, store_dtype=jnp.float32,
-                 use_pallas: bool = False, pallas_interpret: bool = False):
+                 use_pallas: bool = False, pallas_interpret: bool = False,
+                 pallas_version: int | None = None,
+                 form: str | None = None):
         import numpy as np
         from ..ops import wilson_packed as wpk
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
                         store_dtype, use_pallas, pallas_interpret,
+                        pallas_version=pallas_version,
                         tb_sign=getattr(dpc, 'antiperiodic_t',
                                         True))
         self.ls = dpc.ls
@@ -229,6 +238,15 @@ class DiracMobiusPCPairs(_LsPairIOMixin, _PackedHopMixin):
         self._m5p = blocks(dpc.s_m5p)
         self._mix = blocks(dpc.s_mix)
         self._m5i = blocks(dpc.s_m5i)
+        from ..obs import memory as omem
+        omem.track("dwf", "m5_pair_blocks",
+                   self._m5p + self._mix + self._m5i)
+        from . import formsel
+        aux = f"{jnp.dtype(store_dtype).name}|ls{self.ls}"
+        self._op_form = formsel.resolve_form(
+            "dwf", form, self,
+            race=lambda: formsel.race_ls_hop("dwf", self, aux=aux),
+            aux=aux)
 
     # -- building blocks ------------------------------------------------
     def _apply_blocks(self, blk, x, adjoint=False, out_dtype=None):
@@ -244,10 +262,24 @@ class DiracMobiusPCPairs(_LsPairIOMixin, _PackedHopMixin):
         out = jnp.concatenate([up, dn], axis=1)
         return out.astype(out_dtype or self.store_dtype)
 
-    def _hop_to_pairs(self, x, target_parity, out_dtype=None):
-        """The 4d hop on every s-slice: the mixin's version-aware eo
-        stencil vmapped over the leading Ls axis."""
+    def _hop_to_pairs(self, x, target_parity, out_dtype=None,
+                      form=None):
+        """The 4d hop on every s-slice.  form='pallas' (the resolved
+        _op_form default on chip): the Ls-batched kernel — Ls is the
+        innermost grid axis, each gauge tile fetched once per
+        (t, z-block) while Ls spinor planes stream through it
+        (576+576/Ls B/site/plane).  form='xla': the mixin's
+        version-aware eo stencil vmapped over the leading Ls axis
+        (batch outermost — links re-fetched per plane)."""
         odt = out_dtype or self.store_dtype
+        if (form or self._op_form) == "pallas":
+            from ..ops import dwf_pallas as dwp
+            return dwp.dslash_eo_pallas_packed_ls(
+                self.gauge_eo_pp[target_parity],
+                self._u_bw[target_parity], x, tuple(self.dims),
+                target_parity, interpret=self._pallas_interpret,
+                block_z=getattr(self, "_block_z", None), out_dtype=odt,
+                tb_sign=self._tb_sign)
         return jax.vmap(
             lambda v: self._d_to(v, target_parity, odt))(x)
 
@@ -547,11 +579,17 @@ class DiracDomainWall5DPC(DiracPC):
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
 
     def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
-              pallas_interpret: bool = False
+              pallas_interpret: bool = False,
+              pallas_version: int | None = None,
+              form: str | None = None
               ) -> "DiracDomainWall5DPCPairs":
-        """Complex-free packed companion (the TPU solve path)."""
+        """Complex-free packed companion (the TPU solve path).
+        ``form`` / QUDA_TPU_DWF_FORM picks the Ls/2-batched 4d hop
+        kernel vs the vmap-over-s stencil (models/formsel)."""
         return DiracDomainWall5DPCPairs(self, store_dtype, use_pallas,
-                                        pallas_interpret)
+                                        pallas_interpret,
+                                        pallas_version=pallas_version,
+                                        form=form)
 
 
 class DiracDomainWall5DPCPairs(_LsPairIOMixin, _PackedHopMixin):
@@ -569,10 +607,13 @@ class DiracDomainWall5DPCPairs(_LsPairIOMixin, _PackedHopMixin):
     hermitian = False
 
     def __init__(self, dpc: DiracDomainWall5DPC, store_dtype=jnp.float32,
-                 use_pallas: bool = False, pallas_interpret: bool = False):
+                 use_pallas: bool = False, pallas_interpret: bool = False,
+                 pallas_version: int | None = None,
+                 form: str | None = None):
         from ..ops import wilson_packed as wpk
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
                         store_dtype, use_pallas, pallas_interpret,
+                        pallas_version=pallas_version,
                         tb_sign=getattr(dpc, 'antiperiodic_t',
                                         True))
         self.ls = dpc.ls
@@ -580,6 +621,25 @@ class DiracDomainWall5DPCPairs(_LsPairIOMixin, _PackedHopMixin):
         self.m5 = float(dpc.m5)
         self.kappa5 = float(dpc.kappa5)
         self.matpc = dpc.matpc
+        from . import formsel
+        aux = f"{jnp.dtype(store_dtype).name}|ls{self.ls}|5dpc"
+
+        def _race():
+            yxh = self.gauge_eo_pp[0].shape[-1]
+            T, Z, _, _ = self.dims
+            psi0 = jnp.zeros((self.ls, 4, 3, 2, T, Z, yxh),
+                             self.store_dtype)
+            cands = {
+                "pallas": jax.jit(lambda v: self._hop4_pairs(
+                    v, 0, jnp.float32, form="pallas")),
+                "xla": jax.jit(lambda v: self._hop4_pairs(
+                    v, 0, jnp.float32, form="xla")),
+            }
+            return formsel.race_forms("dwf", self, cands, (psi0,),
+                                      aux=aux)
+
+        self._op_form = formsel.resolve_form("dwf", form, self,
+                                             race=_race, aux=aux)
 
     def _shop_pairs(self, x, swap_pm: bool):
         """2 (P_- S^- + P_+ S^+) on pair planes: s-rolls with the -mf
@@ -601,15 +661,28 @@ class DiracDomainWall5DPCPairs(_LsPairIOMixin, _PackedHopMixin):
             return 2.0 * (hi * up + lo * dn)
         return 2.0 * (lo * up + hi * dn)
 
-    def _hop4_pairs(self, x, target_p5: int, out_dtype):
+    def _hop4_pairs(self, x, target_p5: int, out_dtype, form=None):
         # (target_p5 + s) % 2 takes two values: group the s-slices by
-        # parity and vmap each group in ONE stencil call (2 launches per
-        # hop instead of Ls; the pallas grid grows to (Ls/2, T, Z/bz))
+        # parity and hop each group in ONE stencil call (2 launches per
+        # hop instead of Ls).  form='pallas': each group rides the
+        # Ls-batched kernel (batch INNERMOST, gauge tile resident);
+        # form='xla': vmap of the per-slice stencil (batch outermost)
         out = jnp.zeros(x.shape, out_dtype)
+        fused = (form or self._op_form) == "pallas"
         for r in (0, 1):
             tp = (target_p5 + r) % 2
-            grp = jax.vmap(
-                lambda v, tp=tp: self._d_to(v, tp, out_dtype))(x[r::2])
+            if fused:
+                from ..ops import dwf_pallas as dwp
+                grp = dwp.dslash_eo_pallas_packed_ls(
+                    self.gauge_eo_pp[tp], self._u_bw[tp], x[r::2],
+                    tuple(self.dims), tp,
+                    interpret=self._pallas_interpret,
+                    block_z=getattr(self, "_block_z", None),
+                    out_dtype=out_dtype, tb_sign=self._tb_sign)
+            else:
+                grp = jax.vmap(
+                    lambda v, tp=tp: self._d_to(v, tp,
+                                                out_dtype))(x[r::2])
             out = out.at[r::2].set(grp)
         return out
 

@@ -14,6 +14,14 @@ Preconditioner form (QUDA's use inside PCG on the PC operator M):
     K(r) = T^dag  Minv_cheap  T r
 where Minv_cheap is a loose solve with a small-Ls Möbius PC operator.
 Training minimises ||r - M K(r)||^2 / ||r||^2 over random vectors.
+
+The fine and cheap operators are duck-typed M/Mdag callables: the
+complex DiracMobiusPC here, or its ``.pairs(...)`` companion — whose
+4d hop form (Ls-batched pallas kernel vs vmap-over-s stencil) was
+already resolved at construction via QUDA_TPU_DWF_FORM
+(models/formsel), so MADWF inherits the operator-zoo fast path with no
+dispatch of its own.  Note the Ls_cheap inner operator resolves its
+form independently (its own tunecache row keyed on ls).
 """
 
 from __future__ import annotations

@@ -122,11 +122,17 @@ class DiracTwistedMassPC(DiracPC):
         return 2 * 1320 + 192  # two hops + twist apply/inverse + axpy
 
     def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
-              pallas_interpret: bool = False) -> "DiracTwistedMassPCPairs":
+              pallas_interpret: bool = False,
+              pallas_version: int | None = None,
+              form: str | None = None) -> "DiracTwistedMassPCPairs":
         """Complex-free packed companion (f32 = the precise TPU solve
-        path; bf16 = the sloppy operator)."""
+        path; bf16 = the sloppy operator).  ``form`` /
+        QUDA_TPU_TWISTED_FORM picks the fused-twist pallas kernel vs
+        the staged XLA composition (models/formsel)."""
         return DiracTwistedMassPCPairs(self, store_dtype, use_pallas,
-                                       pallas_interpret)
+                                       pallas_interpret,
+                                       pallas_version=pallas_version,
+                                       form=form)
 
 
 def _ig5_rot_pairs(x_pp: jnp.ndarray, c: float) -> jnp.ndarray:
@@ -161,21 +167,40 @@ class DiracTwistedMassPCPairs(_SchurPairOpBase):
     template's Mdag = g5 M(-s) g5 is exactly the twisted dagger."""
 
     def __init__(self, dpc: "DiracTwistedMassPC", store_dtype=jnp.float32,
-                 use_pallas: bool = False, pallas_interpret: bool = False):
+                 use_pallas: bool = False, pallas_interpret: bool = False,
+                 pallas_version: int | None = None,
+                 form: str | None = None):
         from ..ops import wilson_packed as wpk
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
                         store_dtype, use_pallas, pallas_interpret,
+                        pallas_version=pallas_version,
                         tb_sign=getattr(dpc, 'antiperiodic_t',
                                         True))
         self.kappa = float(dpc.kappa)
         self.a = float(dpc.a)
         self.matpc = dpc.matpc
+        from . import formsel
+        aux = jnp.dtype(store_dtype).name
+        self._op_form = formsel.resolve_form(
+            "twisted", form, self,
+            race=lambda: formsel.race_schur("twisted", self, aux=aux),
+            aux=aux)
 
     def _diag_sign_pairs(self, x, sign, out_dtype):
         return _twist_pairs(x, self.a, sign, out_dtype)
 
     def _Ainv_q_sign_pairs(self, x, sign, out_dtype):
         return _twist_inv_pairs(x, self.a, sign, out_dtype)
+
+    # fused-epilogue descriptors: the twist is two STATIC scalars — K1
+    # applies (1 + i s a g5)^{-1} = (v + i(-s a) g5 v)/(1+a^2) post-hop
+    # in-register, K2 adds i (s a) g5 x to the original x (no blocks)
+    def _fused_k1_params(self, sign):
+        a = self.a
+        return None, (-sign * a, 1.0 / (1.0 + a * a))
+
+    def _fused_k2_params(self, sign):
+        return None, sign * self.a
 
 
 class DiracTwistedCloverPCPairs(_SchurPairOpBase):
@@ -185,11 +210,14 @@ class DiracTwistedCloverPCPairs(_SchurPairOpBase):
 
     def __init__(self, dpc: "DiracTwistedCloverPC",
                  store_dtype=jnp.float32, use_pallas: bool = False,
-                 pallas_interpret: bool = False):
+                 pallas_interpret: bool = False,
+                 pallas_version: int | None = None,
+                 form: str | None = None):
         from ..ops import wilson_packed as wpk
         from .clover import pack_clover_pairs
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
                         store_dtype, use_pallas, pallas_interpret,
+                        pallas_version=pallas_version,
                         tb_sign=getattr(dpc, 'antiperiodic_t',
                                         True))
         self.kappa = float(dpc.kappa)
@@ -200,6 +228,16 @@ class DiracTwistedCloverPCPairs(_SchurPairOpBase):
         self.tw_inv_q_pp = {
             s: pack_clover_pairs(dpc.tw_inv_q[s], store_dtype)
             for s in (+1, -1)}
+        from ..obs import memory as omem
+        omem.track("clover", "tw_clover_pair_blocks",
+                   (self.clover_p_pp,) + tuple(
+                       self.tw_inv_q_pp[s] for s in (+1, -1)))
+        from . import formsel
+        aux = jnp.dtype(store_dtype).name
+        self._op_form = formsel.resolve_form(
+            "twisted", form, self,
+            race=lambda: formsel.race_schur("twisted", self, aux=aux),
+            aux=aux)
 
     def _diag_sign_pairs(self, x, sign, out_dtype):
         # A + i s a g5: clover matvec plus the direct twist rotation
@@ -212,11 +250,26 @@ class DiracTwistedCloverPCPairs(_SchurPairOpBase):
         from .clover import apply_clover_pairs
         return apply_clover_pairs(self.tw_inv_q_pp[sign], x, out_dtype)
 
+    # fused-epilogue descriptors: K1 = the dense (A_q + i s a g5)^{-1}
+    # blocks (the twist is already folded into them), K2 = A_p blocks
+    # plus the in-register i (s a) g5 rotation of the original x
+    def _fused_k1_params(self, sign):
+        return self.tw_inv_q_pp[sign], None
+
+    def _fused_k2_params(self, sign):
+        return self.clover_p_pp, sign * self.a
+
 
 class _NdegPairsBase(_SchurPairOpBase):
     """Flavor-doublet pair-form base: spinors (2, 4, 3, 2, T, Z, Y*Xh)
     with the flavor axis leading; the hop is the mixin's eo stencil
-    vmapped over flavor, and gamma5 acts on spin axis 1."""
+    vmapped over flavor, and gamma5 acts on spin axis 1.
+
+    The doublet families keep the staged XLA composition (_op_form
+    stays 'xla'): the -b tau1 flavor mixing couples the two flavor
+    planes, which is not expressible as the per-plane epilogue the
+    fused kernels implement — QUDA_TPU_TWISTED_FORM=pallas therefore
+    only governs the degenerate operators."""
 
     _spin_axis = 1
 
@@ -250,12 +303,15 @@ class DiracNdegTwistedMassPCPairs(_NdegPairsBase):
 
     def __init__(self, dpc: "DiracNdegTwistedMassPC",
                  store_dtype=jnp.float32, use_pallas: bool = False,
-                 pallas_interpret: bool = False):
+                 pallas_interpret: bool = False,
+                 form: str | None = None):
         from ..ops import wilson_packed as wpk
+        from . import formsel
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
                         store_dtype, use_pallas, pallas_interpret,
                         tb_sign=getattr(dpc, 'antiperiodic_t',
                                         True))
+        self._op_form = formsel.resolve_ndeg(form)
         self.kappa = float(dpc.kappa)
         self.a = float(dpc.a)
         self.b = float(dpc.b)
@@ -287,13 +343,16 @@ class DiracNdegTwistedCloverPCPairs(_NdegPairsBase):
 
     def __init__(self, dpc: "DiracNdegTwistedCloverPC",
                  store_dtype=jnp.float32, use_pallas: bool = False,
-                 pallas_interpret: bool = False):
+                 pallas_interpret: bool = False,
+                 form: str | None = None):
         from ..ops import wilson_packed as wpk
+        from . import formsel
         from .clover import pack_clover_pairs
         self._setup_hop(dpc.geom, wpk.pack_gauge_eo(dpc.gauge_eo),
                         store_dtype, use_pallas, pallas_interpret,
                         tb_sign=getattr(dpc, 'antiperiodic_t',
                                         True))
+        self._op_form = formsel.resolve_ndeg(form)
         self.kappa = float(dpc.kappa)
         self.a = float(dpc.a)
         self.b = float(dpc.b)
@@ -480,11 +539,17 @@ class DiracTwistedCloverPC(DiracPC):
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
 
     def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
-              pallas_interpret: bool = False) -> "DiracTwistedCloverPCPairs":
+              pallas_interpret: bool = False,
+              pallas_version: int | None = None,
+              form: str | None = None) -> "DiracTwistedCloverPCPairs":
         """Complex-free packed companion (f32 = the precise TPU solve
-        path; bf16 = the sloppy operator)."""
+        path; bf16 = the sloppy operator).  ``form`` /
+        QUDA_TPU_TWISTED_FORM picks the fused blocks+twist pallas
+        kernel vs the staged XLA composition (models/formsel)."""
         return DiracTwistedCloverPCPairs(self, store_dtype, use_pallas,
-                                         pallas_interpret)
+                                         pallas_interpret,
+                                         pallas_version=pallas_version,
+                                         form=form)
 
 
 class DiracNdegTwistedClover(Dirac):
@@ -631,12 +696,17 @@ class DiracNdegTwistedCloverPC(DiracPC):
         return (x_p, x_q) if p == EVEN else (x_q, x_p)
 
     def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
-              pallas_interpret: bool = False
+              pallas_interpret: bool = False,
+              form: str | None = None
               ) -> "DiracNdegTwistedCloverPCPairs":
-        """Complex-free packed companion (flavor-doublet pair form)."""
+        """Complex-free packed companion (flavor-doublet pair form).
+        ``form`` is validated but always resolves to the staged
+        composition — the doublet has no fused kernel
+        (models/formsel.resolve_ndeg)."""
         return DiracNdegTwistedCloverPCPairs(self, store_dtype,
                                              use_pallas,
-                                             pallas_interpret)
+                                             pallas_interpret,
+                                             form=form)
 
 
 class DiracNdegTwistedMassPC(DiracPC):
@@ -710,8 +780,12 @@ class DiracNdegTwistedMassPC(DiracPC):
         return 2 * (2 * 1320) + 384  # two flavor hops each parity + twist
 
     def pairs(self, store_dtype=jnp.float32, use_pallas: bool = False,
-              pallas_interpret: bool = False
+              pallas_interpret: bool = False,
+              form: str | None = None
               ) -> "DiracNdegTwistedMassPCPairs":
-        """Complex-free packed companion (flavor-doublet pair form)."""
+        """Complex-free packed companion (flavor-doublet pair form).
+        ``form`` is validated but always resolves to the staged
+        composition — the doublet has no fused kernel
+        (models/formsel.resolve_ndeg)."""
         return DiracNdegTwistedMassPCPairs(self, store_dtype, use_pallas,
-                                           pallas_interpret)
+                                           pallas_interpret, form=form)

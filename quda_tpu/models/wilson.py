@@ -828,14 +828,59 @@ class _SchurPairOpBase(_PackedHopMixin, _PairSloppyBase):
     form: for sign-symmetric operators it reduces to the g5 trick.
     """
 
+    # pallas-vs-xla family form (models/formsel.resolve_form sets it at
+    # family construction; 'pallas' routes _M_sign_pairs through the
+    # fused epilogue kernels of ops/clover_pallas)
+    _op_form = "xla"
+
     def _diag_sign_pairs(self, x, sign, out_dtype):
         raise NotImplementedError
 
     def _Ainv_q_sign_pairs(self, x, sign, out_dtype):
         raise NotImplementedError
 
-    def _M_sign_pairs(self, x, sign):
+    # -- fused-epilogue hooks (ops/clover_pallas) -----------------------
+    # A family that can fold its diagonals into the v2 kernel epilogue
+    # describes them here: K1 applies E = Ainv_q as a post-hop epilogue
+    # (resident chiral blocks and/or a static (c, scale) twist
+    # rotation); K2 adds the p-parity diagonal (blocks and/or an
+    # i c g5 rotation of the ORIGINAL x) to the -kappa^2-scaled second
+    # hop.  Raising here means the family has no fused form.
+
+    def _fused_k1_params(self, sign):
+        """-> (blk_pl or None, twist (c, scale) or None)."""
+        raise NotImplementedError
+
+    def _fused_k2_params(self, sign):
+        """-> (blk_pl or None, diag_twist c or None)."""
+        raise NotImplementedError
+
+    def _M_sign_pairs(self, x, sign, form=None):
         p = self.matpc
+        if (form or self._op_form) == "pallas":
+            from ..ops import clover_pallas as clp
+            k1_blk, k1_twist = self._fused_k1_params(sign)
+            k2_blk, k2_twist = self._fused_k2_params(sign)
+            dims = tuple(self.dims)
+            itp = self._pallas_interpret
+            bz = getattr(self, "_block_z", None)
+            # K1: Ainv_q(D_{q<-p} x) in one pass; the hop accumulator
+            # rounds to store_dtype through the out-tile read-back, so
+            # the staged rounding of the XLA composition is preserved
+            t = clp.dslash_eo_pallas_post(
+                self.gauge_eo_pp[1 - p], self._u_bw[1 - p], x, dims,
+                1 - p, blk_pl=k1_blk, twist=k1_twist, interpret=itp,
+                block_z=bz, out_dtype=self.store_dtype,
+                tb_sign=self._tb_sign)
+            # K2: diag_p(x) - kappa^2 D_{p<-q} t, f32 out (lossless
+            # read-back), cast to storage at the boundary as the
+            # staged composition does
+            out = clp.dslash_eo_pallas_diag_hop(
+                self.gauge_eo_pp[p], self._u_bw[p], t, x, dims, p,
+                hop_coeff=-(self.kappa ** 2), blk_pl=k2_blk,
+                diag_twist=k2_twist, interpret=itp, block_z=bz,
+                out_dtype=jnp.float32, tb_sign=self._tb_sign)
+            return out.astype(self.store_dtype)
         t = self._d_to(x, 1 - p, self.store_dtype)
         t = self._Ainv_q_sign_pairs(t, sign, self.store_dtype)
         dd = self._d_to(t, p, jnp.float32)
@@ -851,6 +896,89 @@ class _SchurPairOpBase(_PackedHopMixin, _PairSloppyBase):
 
     def MdagM_pairs(self, x):
         return self.Mdag_pairs(self.M_pairs(x))
+
+    # -- multi-RHS forms ------------------------------------------------
+    # The _PairSloppyBase MRHS defaults encode the WILSON composition
+    # (x - kappa^2 DD) and are wrong for any operator with a nontrivial
+    # diagonal; the Schur family gets its own batched forms here, with
+    # the fused path riding the MRHS epilogue kernels (gauge AND block
+    # tiles resident across the RHS stream).
+
+    def _diag_sign_pairs_mrhs(self, x, sign, out_dtype):
+        return jax.vmap(
+            lambda v: self._diag_sign_pairs(v, sign, out_dtype))(x)
+
+    def _Ainv_q_sign_pairs_mrhs(self, x, sign, out_dtype):
+        return jax.vmap(
+            lambda v: self._Ainv_q_sign_pairs(v, sign, out_dtype))(x)
+
+    def _M_sign_pairs_mrhs(self, x, sign, form=None):
+        p = self.matpc
+        if (form or self._op_form) == "pallas":
+            from ..ops import clover_pallas as clp
+            k1_blk, k1_twist = self._fused_k1_params(sign)
+            k2_blk, k2_twist = self._fused_k2_params(sign)
+            dims = tuple(self.dims)
+            itp = self._pallas_interpret
+            bz = getattr(self, "_block_z", None)
+            t = clp.dslash_eo_pallas_post_mrhs(
+                self.gauge_eo_pp[1 - p], self._u_bw[1 - p], x, dims,
+                1 - p, blk_pl=k1_blk, twist=k1_twist, interpret=itp,
+                block_z=bz, out_dtype=self.store_dtype,
+                tb_sign=self._tb_sign)
+            out = clp.dslash_eo_pallas_diag_hop_mrhs(
+                self.gauge_eo_pp[p], self._u_bw[p], t, x, dims, p,
+                hop_coeff=-(self.kappa ** 2), blk_pl=k2_blk,
+                diag_twist=k2_twist, interpret=itp, block_z=bz,
+                out_dtype=jnp.float32, tb_sign=self._tb_sign)
+            return out.astype(self.store_dtype)
+        t = self._d_to_mrhs(x, 1 - p, self.store_dtype)
+        t = self._Ainv_q_sign_pairs_mrhs(t, sign, self.store_dtype)
+        dd = self._d_to_mrhs(t, p, jnp.float32)
+        out = (self._diag_sign_pairs_mrhs(x, sign, jnp.float32)
+               - (self.kappa ** 2) * dd)
+        return out.astype(self.store_dtype)
+
+    def M_pairs_mrhs(self, x):
+        return self._M_sign_pairs_mrhs(x, +1)
+
+    def Mdag_pairs_mrhs(self, x):
+        return self._g5_pairs_mrhs(
+            self._M_sign_pairs_mrhs(self._g5_pairs_mrhs(x), -1))
+
+    def MdagM_pairs_mrhs(self, x):
+        return self.Mdag_pairs_mrhs(self.M_pairs_mrhs(x))
+
+    def prepare_pairs_mrhs(self, b_even_b, b_odd_b):
+        """Batched prepare: b_p + kappa D Ainv_q b_q with the MRHS hop
+        (canonical complex parity batches in, f32 pair rhs out — the
+        wilson MRHS boundary convention)."""
+        from ..fields.geometry import EVEN
+        p = self.matpc
+        b_p, b_q = ((b_even_b, b_odd_b) if p == EVEN
+                    else (b_odd_b, b_even_b))
+        to_pp = jax.vmap(self._to_pairs)
+        t = self._Ainv_q_sign_pairs_mrhs(to_pp(b_q), +1,
+                                         self.store_dtype)
+        t = self._d_to_mrhs(t, p, jnp.float32)
+        return to_pp(b_p).astype(jnp.float32) + self.kappa * t
+
+    def solution_from_pairs_mrhs(self, x_b, dtype=jnp.complex64):
+        return jax.vmap(lambda x: self._from_pairs(x, dtype))(x_b)
+
+    def reconstruct_pairs_mrhs(self, x_b, b_even_b, b_odd_b):
+        """Batched reconstruct: x_q = Ainv_q (b_q + kappa D x_p)."""
+        from ..fields.geometry import EVEN
+        p = self.matpc
+        b_q = b_odd_b if p == EVEN else b_even_b
+        to_pp = jax.vmap(self._to_pairs)
+        t = self._d_to_mrhs(x_b, 1 - p, jnp.float32)
+        xq_b = self._Ainv_q_sign_pairs_mrhs(
+            to_pp(b_q).astype(jnp.float32) + self.kappa * t, +1,
+            jnp.float32)
+        x_p = self.solution_from_pairs_mrhs(x_b, b_q.dtype)
+        x_q = self.solution_from_pairs_mrhs(xq_b, b_q.dtype)
+        return (x_p, x_q) if p == EVEN else (x_q, x_p)
 
     # -- prepare / reconstruct in pair space ----------------------------
     def prepare_pairs(self, b_even, b_odd):

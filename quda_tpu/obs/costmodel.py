@@ -148,6 +148,38 @@ def _ref_flops_per_site(family: str) -> float:
         cost = xla_cost(
             lambda f, ln, p: spk.dslash_staggered_packed_pairs(
                 f, p, X, Y, long_pp=ln), f, ln, p)
+    elif family == "clover":
+        # hop + one chiral-block matvec: the staged composition the
+        # fused clover/twisted-clover kernels are bit-matched against
+        from ..models.clover import apply_clover_pairs
+        from ..ops import wilson_packed as wpk
+        g = arr((4, 3, 3, 2, T, Z, Y * X))
+        blk = arr((2, 6, 6, 2, T, Z, Y * X))
+        p = arr((4, 3, 2, T, Z, Y * X))
+        cost = xla_cost(
+            lambda g, blk, p: apply_clover_pairs(
+                blk, wpk.dslash_packed_pairs(g, p, X, Y)), g, blk, p)
+    elif family == "twisted_mass":
+        # hop + the (1 + i a g5)^{-1} chirality rotation
+        from ..models.twisted import _twist_inv_pairs
+        from ..ops import wilson_packed as wpk
+        g = arr((4, 3, 3, 2, T, Z, Y * X))
+        p = arr((4, 3, 2, T, Z, Y * X))
+        cost = xla_cost(
+            lambda g, p: _twist_inv_pairs(
+                wpk.dslash_packed_pairs(g, p, X, Y), 0.25, +1), g, p)
+    elif family in ("dwf_ls4", "dwf_ls8"):
+        # the Ls-batched 4d hop (the s-diagonal seam the DWF/Möbius
+        # fused form accelerates); vol below is 4d sites so the count
+        # lands per updated 4d site, matching the Ls x 1320 models
+        import jax
+        from ..ops import wilson_packed as wpk
+        Ls = int(family.rsplit("ls", 1)[1])
+        g = arr((4, 3, 3, 2, T, Z, Y * X))
+        p = arr((Ls, 4, 3, 2, T, Z, Y * X))
+        cost = xla_cost(
+            lambda g, p: jax.vmap(
+                lambda v: wpk.dslash_packed_pairs(g, v, X, Y))(p), g, p)
     elif family == "mg_coarse":
         # the MG coarse stencil at the canonical probe size (n_vec=4,
         # E=16): the XLA form of the identical stacked contraction the
@@ -176,6 +208,8 @@ def _ref_flops_per_site(family: str) -> float:
 # O(surface) halo transport — the comms ledger owns it).
 
 _G, _G12, _PSI, _SPSI = 288.0, 192.0, 96.0, 24.0
+# packed clover/twisted-clover chiral pair blocks: 2 x 6x6 complex f32
+_BLK = 576.0
 
 _FOOTPRINTS: Dict[str, dict] = {
     # v2 gather: forward links + resident pre-shifted backward copy
@@ -238,6 +272,37 @@ _FOOTPRINTS: Dict[str, dict] = {
                            "floor": lambda n: 2 * _G / n + 2 * _SPSI},
     "staggered_sharded_fat": {"alias": "staggered_fat"},
     "staggered_sharded_fat_naik": {"alias": "staggered_fat_naik"},
+    # operator-zoo fused forms (PERF.md round 18): hop operand set +
+    # the resident diagonal term's storage.  The clover/twisted-clover
+    # rows read the packed chiral blocks once per pass; the twisted-mass
+    # twist is two compiled-in scalars (zero bytes); the MRHS rows
+    # amortize links AND blocks over the RHS stream.  The r12 floors
+    # charge the reconstruct-12 link storage at the FORM's dtype basis
+    "clover_pallas": {"family": "clover",
+                      "floor": lambda n: 2 * _PSI + 2 * _G + _BLK},
+    "clover_pallas_r12": {"family": "clover",
+                          "floor": lambda n: 2 * _PSI + 2 * _G12
+                          + _BLK},
+    "clover_pallas_mrhs": {"family": "clover",
+                           "floor": lambda n: 2 * _PSI
+                           + (2 * _G + _BLK) / n},
+    "twisted_mass_pallas": {"family": "twisted_mass",
+                            "floor": lambda n: 2 * _PSI + 2 * _G},
+    "twisted_mass_pallas_r12": {"family": "twisted_mass",
+                                "floor": lambda n: 2 * _PSI + 2 * _G12},
+    "twisted_mass_pallas_mrhs": {"family": "twisted_mass",
+                                 "floor": lambda n: 2 * _PSI
+                                 + 2 * _G / n},
+    # twisted clover runs the clover operand set (twist folded into the
+    # inverse blocks / an in-register rotation)
+    "twisted_clover_pallas": {"alias": "clover_pallas"},
+    "twisted_clover_pallas_r12": {"alias": "clover_pallas_r12"},
+    "twisted_clover_pallas_mrhs": {"alias": "clover_pallas_mrhs"},
+    # Ls-batched DWF hop: Ls spinor planes in+out, ONE gauge fetch
+    "dwf_ls4_pallas": {"family": "dwf_ls4",
+                       "floor": lambda n: 4 * 2 * _PSI + 2 * _G},
+    "dwf_ls8_pallas": {"family": "dwf_ls8",
+                       "floor": lambda n: 8 * 2 * _PSI + 2 * _G},
     # fused MG coarse stencil at the canonical probe size (E=16): the
     # distinct operands of one invocation are the 9 embedded link
     # matrices (36*E^2 B/site), the input vector read once (4*E) and

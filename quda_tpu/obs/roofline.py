@@ -146,6 +146,64 @@ KERNEL_MODELS: Dict[str, dict] = {
     # ops/coarse_pallas.coarse_model(nc) — this row is the drift-lint
     # anchor (obs/costmodel.py family 'mg_coarse')
     "mg_coarse_pallas": {"flops_per_site": 4608, "bytes_per_site": 9856},
+    # -- operator-zoo fused forms (PERF.md round 18) --------------------
+    # Clover PC fused kernel (ops/clover_pallas): per fused pass the v2
+    # hop operand set (psi 5x96 + out 96 + fwd/bw links 2x288) plus the
+    # resident chiral pair blocks streamed per tile — 2x6x6 complex f32
+    # = 576 B/site (288 at bf16).  flops: hop 1320 + one 2x(6x6)
+    # complex block matvec 504
+    "clover_pallas": {"flops_per_site": 1824, "bytes_per_site": 1728},
+    "clover_pallas_r12": {"flops_per_site": 1824,
+                          "bytes_per_site": 1536},
+    # MRHS fused clover: links AND blocks amortize over the RHS stream
+    # (both index maps ignore n) — psi 480 + out 96 + (576+576)/N
+    "clover_pallas_mrhs": {
+        "flops_per_site": 1824,
+        "bytes_per_site": lambda nrhs: 576.0 + 1152.0 / nrhs},
+    # twisted mass: the twist is two STATIC scalars compiled into the
+    # epilogue — zero extra traffic over the v2 hop; flops: hop 1320 +
+    # twist rotate/combine 96
+    "twisted_mass_pallas": {"flops_per_site": 1416,
+                            "bytes_per_site": 1152},
+    "twisted_mass_pallas_r12": {"flops_per_site": 1416,
+                                "bytes_per_site": 960},
+    "twisted_mass_pallas_mrhs": {
+        "flops_per_site": 1416,
+        "bytes_per_site": lambda nrhs: 576.0 + 576.0 / nrhs},
+    # twisted clover: dense block term (the twist is folded into the
+    # inverse blocks / added in-register) — clover traffic and flops
+    "twisted_clover_pallas": {"flops_per_site": 1824,
+                              "bytes_per_site": 1728},
+    "twisted_clover_pallas_r12": {"flops_per_site": 1824,
+                                  "bytes_per_site": 1536},
+    "twisted_clover_pallas_mrhs": {
+        "flops_per_site": 1824,
+        "bytes_per_site": lambda nrhs: 576.0 + 1152.0 / nrhs},
+    # Ls-batched DWF/Möbius 4d hop (ops/dwf_pallas): per UPDATED 4d
+    # site per dslash invocation with Ls baked in — Ls spinor planes
+    # (Ls x 576) stream through ONE gauge-tile fetch (576), i.e.
+    # 576 + 576/Ls per plane.  flops Ls x 1320.  Only Ls in {4, 8} get
+    # traffic rows: at Ls >= 12 the honest model (psi still read 5x per
+    # plane) exceeds the BYTES_REREAD_MAX re-read ceiling over the
+    # operand floor, so larger Ls report flops-only via 'dwf_pallas'
+    "dwf_ls4_pallas": {"flops_per_site": 5280, "bytes_per_site": 2880},
+    "dwf_ls8_pallas": {"flops_per_site": 10560,
+                       "bytes_per_site": 5184},
+    # Ls outside the registered set: flops come from the operator
+    # (flops_per_site override), no static traffic claim
+    "dwf_pallas": {"flops_per_site": None, "bytes_per_site": None},
+    # multi-source Möbius: N sources x Ls planes share one gauge tile;
+    # bytes honesty as above (amortization shown by the bench row, not
+    # a static model)
+    "dwf_ls8_pallas_mrhs": {"flops_per_site": 10560,
+                            "bytes_per_site": None},
+    # staged XLA compositions: flop models only (same honesty rule as
+    # wilson_xla — XLA's fusion choices make a traffic claim dishonest)
+    "clover_xla": {"flops_per_site": 1824, "bytes_per_site": None},
+    "twisted_xla": {"flops_per_site": 1416, "bytes_per_site": None},
+    "twisted_clover_xla": {"flops_per_site": 1824,
+                           "bytes_per_site": None},
+    "dwf_xla": {"flops_per_site": None, "bytes_per_site": None},
     # operator-supplied flop count, no traffic model
     "generic": {"flops_per_site": None, "bytes_per_site": None},
 }

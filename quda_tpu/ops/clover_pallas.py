@@ -1,0 +1,405 @@
+"""Fused clover / twisted-mass / twisted-clover pallas kernels.
+
+The operator-zoo fast path (ROADMAP item 5): the proven v2 Wilson
+gather kernel (ops/wilson_pallas_packed._make_kernel) with the family
+diagonal folded into the kernel epilogue, so diag+hop is ONE VMEM pass
+over the spinor tile instead of a hop launch followed by an XLA
+einsum/rotation pass re-reading the hop output from HBM.
+
+Two fused shapes cover every Schur-preconditioned family member
+(QUDA fuses the same way: dslash_wilson_clover*.cu apply the A-block
+or the twist in the kernel epilogue, never as a second pass):
+
+* ``dslash_eo_pallas_post``: E(D_{p<-q} psi) — the K1 stage of the PC
+  operator, with E the q-parity inverse diagonal (clover^-1 blocks, the
+  twisted inverse rotation, or the dense twisted-clover inverse
+  blocks).  The hop accumulator is written to the out tile at the out
+  dtype FIRST and read back before E is applied, so the staged rounding
+  matches the XLA composition (hop -> store_dtype -> A^{-1}) exactly.
+* ``dslash_eo_pallas_diag_hop``: diag(x) + hop_coeff * D_{q<-p} t —
+  the K2 stage: the second hop plus the p-parity diagonal (A_p blocks
+  and/or the +i a g5 twist of the ORIGINAL x) and the -kappa^2 combine,
+  one pass.  The extra center operand x rides a sixth psi-layout input
+  whose BlockSpec matches the center spinor block.
+
+The clover term enters as the resident packed pair blocks of
+models/clover.pack_clover_pairs — (2,6,6,2,T,Z,YXh), 576 B/site at f32
+(288 at bf16) — streamed per (t, z-block) tile exactly like the gauge
+tiles; spins (0,1)/(2,3) map to chirality block rows i = 3*(s%2)+c.
+The twist is two STATIC floats (c = sign*a and a scale), compiled into
+the kernel — in-register, zero bytes.
+
+MRHS variants batch RHS innermost via the same _mrhs_wrap adapter as
+the Wilson kernels (gauge AND block index maps ignore the RHS index,
+so both stay tile-resident across the RHS stream); the full-lattice
+``clover_pallas_packed`` serves the unpreconditioned M = A - kappa D
+with the diagonal read from the center psi tile itself (no extra
+operand at all).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import wilson_pallas_packed as wpp
+
+F32 = jnp.float32
+
+# extra resident (bz, YXh) planes the epilogue operands add to the
+# _pick_bz working-set estimate: a pair-form chiral block array is
+# 2*6*6*2 = 144 planes; a sixth psi-layout center input is 4*3*2 = 24
+_BLK_PLANES = 144
+_XC_PLANES = 24
+
+
+def _load_sc(ref):
+    """(4,3,2,1,bz,YXh) tile -> 4x3 grid of (re, im) f32 tiles."""
+    return [[(ref[s, c, 0, 0].astype(F32), ref[s, c, 1, 0].astype(F32))
+             for c in range(3)] for s in range(4)]
+
+
+def _store_sc(ref, vals):
+    odt = ref.dtype
+    for s in range(4):
+        for c in range(3):
+            ref[s, c, 0, 0] = vals[s][c][0].astype(odt)
+            ref[s, c, 1, 0] = vals[s][c][1].astype(odt)
+
+
+def _blk_mul(blk_ref, vals):
+    """A v with A the resident chiral 6x6 pair blocks
+    ((2,6,6,2,1,bz,YXh) tile): spins (0,1) -> chirality 0, (2,3) -> 1,
+    block row i = 3*(s%2) + c — the in-kernel form of
+    models/clover.apply_clover_pairs."""
+    out = [[None] * 3 for _ in range(4)]
+    for ch in range(2):
+        for i in range(6):
+            acc = None
+            for j in range(6):
+                a = (blk_ref[ch, i, j, 0, 0].astype(F32),
+                     blk_ref[ch, i, j, 1, 0].astype(F32))
+                m = wpp._cmul(a, vals[2 * ch + j // 3][j % 3])
+                acc = m if acc is None else wpp._cadd(acc, m)
+            out[2 * ch + i // 3][i % 3] = acc
+    return out
+
+
+def _ig5_rot(vals, c: float):
+    """i c gamma5 v: (re,im) -> (-c g5 im, c g5 re), g5 = (+,+,-,-)
+    in DeGrand-Rossi (models/twisted._ig5_rot_pairs in-register)."""
+    out = []
+    for s in range(4):
+        g5c = c if s < 2 else -c
+        out.append([(-g5c * v[1], g5c * v[0]) for v in vals[s]])
+    return out
+
+
+def _add_sc(a, b):
+    return [[wpp._cadd(a[s][c], b[s][c]) for c in range(3)]
+            for s in range(4)]
+
+
+def _scale_sc(vals, k: float):
+    return [[(k * v[0], k * v[1]) for v in row] for row in vals]
+
+
+def _epilogue_kernel(X, bz, eo, T, tb_sign, *, xc_mode, with_blk,
+                     twist, diag_twist, hop_coeff):
+    """v2 hop kernel + family epilogue over the out tile.
+
+    xc_mode: None (no diagonal operand), 'input' (sixth psi-layout
+    ref), or 'center' (diagonal of the hop INPUT itself — the
+    full-lattice M = A - kappa D shape).
+    twist: (c, scale) post-rotation scale*(v + i c g5 v) applied to the
+    hop result (the twisted-mass A^{-1}); diag_twist: c of the +i c g5
+    rotation of the ORIGINAL x added to the diagonal term.
+    hop_coeff: None = E(hop) only; float = diag(x) + hop_coeff * hop.
+    """
+    base = wpp._make_kernel(X, bz, eo=eo, T=T, tb_sign=tb_sign)
+
+    def kernel(*refs):
+        k = 5
+        xc_ref = None
+        if xc_mode == "input":
+            xc_ref = refs[5]
+            k = 6
+        elif xc_mode == "center":
+            xc_ref = refs[0]
+        g_c, g_m = refs[k], refs[k + 1]
+        blk_ref = refs[k + 2] if with_blk else None
+        out_ref = refs[-1]
+        # the unchanged v2 hop body writes its accumulator to the out
+        # tile (VMEM); the epilogue reads it straight back — for the
+        # post kernels that write/read at the store dtype, which IS the
+        # staged rounding of the XLA composition it replaces
+        base(*refs[:5], g_c, g_m, out_ref)
+        hop = _load_sc(out_ref)
+        if hop_coeff is None:
+            v = _blk_mul(blk_ref, hop) if with_blk else hop
+            if twist is not None:
+                c, scale = twist
+                v = _add_sc(v, _ig5_rot(v, c))
+                if scale != 1.0:
+                    v = _scale_sc(v, scale)
+        else:
+            x = _load_sc(xc_ref)
+            d = _blk_mul(blk_ref, x) if with_blk else x
+            if diag_twist is not None:
+                d = _add_sc(d, _ig5_rot(x, diag_twist))
+            v = _add_sc(d, _scale_sc(hop, hop_coeff))
+        _store_sc(out_ref, v)
+
+    return kernel
+
+
+def _planes(R: int, xc_mode, with_blk: bool) -> int:
+    return ((288 if R == 3 else 240)
+            + (_BLK_PLANES if with_blk else 0)
+            + (_XC_PLANES if xc_mode == "input" else 0))
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "dims", "target_parity", "twist", "diag_twist", "hop_coeff",
+    "interpret", "block_z", "out_dtype", "tb_sign"))
+def _fused_eo_call(u_here_pl, u_bw_pl, psi_pl, xc_pl, blk_pl, dims,
+                   target_parity, twist=None, diag_twist=None,
+                   hop_coeff=None, interpret=False, block_z=None,
+                   out_dtype=None, tb_sign=True):
+    from jax.experimental import pallas as pl
+
+    T, Z, Y, X = dims
+    Xh = X // 2
+    R = u_here_pl.shape[1]
+    YXh = psi_pl.shape[-1]
+    with_blk = blk_pl is not None
+    xc_mode = "input" if xc_pl is not None else None
+    bz = block_z if block_z is not None else wpp._pick_bz(
+        Z, YXh, psi_pl.dtype, planes=_planes(R, xc_mode, with_blk))
+    if Z % bz != 0:
+        raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    nzb = Z // bz
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (4, 3, 2, 1, bz, YXh),
+            lambda t, zb, dt=dt, dz=dz: (0, 0, 0, (t + dt) % T,
+                                         (zb + dz) % nzb, 0))
+
+    gauge_spec = pl.BlockSpec(
+        (4, R, 3, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+    blk_spec = pl.BlockSpec(
+        (2, 6, 6, 2, 1, bz, YXh), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+
+    kernel = _epilogue_kernel(X, bz, (target_parity, Xh), T, tb_sign,
+                              xc_mode=xc_mode, with_blk=with_blk,
+                              twist=twist, diag_twist=diag_twist,
+                              hop_coeff=hop_coeff)
+
+    in_specs = [psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
+                psi_spec(0, +1), psi_spec(0, -1)]
+    operands = [psi_pl, psi_pl, psi_pl, psi_pl, psi_pl]
+    if xc_mode == "input":
+        in_specs.append(psi_spec(0, 0))
+        operands.append(xc_pl)
+    in_specs += [gauge_spec, gauge_spec]
+    operands += [u_here_pl, u_bw_pl]
+    if with_blk:
+        in_specs.append(blk_spec)
+        operands.append(blk_pl)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(T, nzb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((4, 3, 2, 1, bz, YXh),
+                               lambda t, zb: (0, 0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape,
+                                       out_dtype or psi_pl.dtype),
+        interpret=interpret,
+    )(*operands)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "dims", "target_parity", "twist", "diag_twist", "hop_coeff",
+    "interpret", "block_z", "out_dtype", "tb_sign"))
+def _fused_eo_call_mrhs(u_here_pl, u_bw_pl, psi_pl, xc_pl, blk_pl, dims,
+                        target_parity, twist=None, diag_twist=None,
+                        hop_coeff=None, interpret=False, block_z=None,
+                        out_dtype=None, tb_sign=True):
+    from jax.experimental import pallas as pl
+
+    T, Z, Y, X = dims
+    Xh = X // 2
+    N = psi_pl.shape[0]
+    R = u_here_pl.shape[1]
+    YXh = psi_pl.shape[-1]
+    with_blk = blk_pl is not None
+    xc_mode = "input" if xc_pl is not None else None
+    bz = block_z if block_z is not None else wpp._pick_bz(
+        Z, YXh, psi_pl.dtype, planes=_planes(R, xc_mode, with_blk))
+    if Z % bz != 0:
+        raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    nzb = Z // bz
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (1, 4, 3, 2, 1, bz, YXh),
+            lambda t, zb, n, dt=dt, dz=dz: (n, 0, 0, 0, (t + dt) % T,
+                                            (zb + dz) % nzb, 0))
+
+    # gauge AND block index maps ignore n: both stay tile-resident
+    # across the innermost RHS stream (the MRHS amortisation carries
+    # over to the 576 B/site clover blocks, not just the links)
+    gauge_spec = pl.BlockSpec(
+        (4, R, 3, 2, 1, bz, YXh),
+        lambda t, zb, n: (0, 0, 0, 0, t, zb, 0))
+    blk_spec = pl.BlockSpec(
+        (2, 6, 6, 2, 1, bz, YXh),
+        lambda t, zb, n: (0, 0, 0, 0, t, zb, 0))
+
+    n_psi = 6 if xc_mode == "input" else 5
+    kernel = wpp._mrhs_wrap(
+        _epilogue_kernel(X, bz, (target_parity, Xh), T, tb_sign,
+                         xc_mode=xc_mode, with_blk=with_blk,
+                         twist=twist, diag_twist=diag_twist,
+                         hop_coeff=hop_coeff),
+        n_psi=n_psi)
+
+    in_specs = [psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
+                psi_spec(0, +1), psi_spec(0, -1)]
+    operands = [psi_pl, psi_pl, psi_pl, psi_pl, psi_pl]
+    if xc_mode == "input":
+        in_specs.append(psi_spec(0, 0))
+        operands.append(xc_pl)
+    in_specs += [gauge_spec, gauge_spec]
+    operands += [u_here_pl, u_bw_pl]
+    if with_blk:
+        in_specs.append(blk_spec)
+        operands.append(blk_pl)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(T, nzb, N),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 4, 3, 2, 1, bz, YXh),
+                               lambda t, zb, n: (n, 0, 0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape,
+                                       out_dtype or psi_pl.dtype),
+        interpret=interpret,
+    )(*operands)
+
+
+# -- public entry points ----------------------------------------------------
+
+def dslash_eo_pallas_post(u_here_pl, u_bw_pl, psi_pl, dims,
+                          target_parity, *, blk_pl=None, twist=None,
+                          interpret=False, block_z=None, out_dtype=None,
+                          tb_sign=True):
+    """E(D_{p<-q} psi) in one VMEM pass — the K1 stage of the fused PC
+    operator.  E = the resident chiral blocks (``blk_pl``, e.g. the
+    clover inverse or the dense twisted-clover inverse) and/or the
+    static twist rotation ``twist=(c, scale)`` mapping
+    v -> scale*(v + i c g5 v)."""
+    return _fused_eo_call(u_here_pl, u_bw_pl, psi_pl, None, blk_pl,
+                          tuple(dims), target_parity, twist=twist,
+                          interpret=interpret, block_z=block_z,
+                          out_dtype=out_dtype, tb_sign=tb_sign)
+
+
+def dslash_eo_pallas_diag_hop(u_here_pl, u_bw_pl, psi_pl, xc_pl, dims,
+                              target_parity, *, hop_coeff, blk_pl=None,
+                              diag_twist=None, interpret=False,
+                              block_z=None, out_dtype=None,
+                              tb_sign=True):
+    """diag(x) + hop_coeff * D_{p<-q} psi in one VMEM pass — the K2
+    stage: diag(x) = blk x (+ i c g5 x with ``diag_twist=c``), x riding
+    a sixth psi-layout operand whose BlockSpec is the center block.
+    Pass out_dtype=f32 so the hop read-back loses nothing before the
+    f32 combine (the caller casts the final result to storage)."""
+    return _fused_eo_call(u_here_pl, u_bw_pl, psi_pl, xc_pl, blk_pl,
+                          tuple(dims), target_parity,
+                          diag_twist=diag_twist, hop_coeff=hop_coeff,
+                          interpret=interpret, block_z=block_z,
+                          out_dtype=out_dtype, tb_sign=tb_sign)
+
+
+def dslash_eo_pallas_post_mrhs(u_here_pl, u_bw_pl, psi_pl, dims,
+                               target_parity, *, blk_pl=None,
+                               twist=None, interpret=False,
+                               block_z=None, out_dtype=None,
+                               tb_sign=True):
+    """MRHS ``dslash_eo_pallas_post``: psi (N,4,3,2,T,Z,YXh), RHS
+    innermost, gauge and block tiles fetched once per (t, z-block)."""
+    return _fused_eo_call_mrhs(u_here_pl, u_bw_pl, psi_pl, None, blk_pl,
+                               tuple(dims), target_parity, twist=twist,
+                               interpret=interpret, block_z=block_z,
+                               out_dtype=out_dtype, tb_sign=tb_sign)
+
+
+def dslash_eo_pallas_diag_hop_mrhs(u_here_pl, u_bw_pl, psi_pl, xc_pl,
+                                   dims, target_parity, *, hop_coeff,
+                                   blk_pl=None, diag_twist=None,
+                                   interpret=False, block_z=None,
+                                   out_dtype=None, tb_sign=True):
+    """MRHS ``dslash_eo_pallas_diag_hop`` (x batched like psi)."""
+    return _fused_eo_call_mrhs(u_here_pl, u_bw_pl, psi_pl, xc_pl,
+                               blk_pl, tuple(dims), target_parity,
+                               diag_twist=diag_twist,
+                               hop_coeff=hop_coeff, interpret=interpret,
+                               block_z=block_z, out_dtype=out_dtype,
+                               tb_sign=tb_sign)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "X", "kappa", "diag_twist", "interpret", "block_z", "tb_sign"))
+def clover_pallas_packed(gauge_pl, blk_pl, psi_pl, X, kappa,
+                         diag_twist=None, interpret=False, block_z=None,
+                         gauge_bw=None, tb_sign=True):
+    """Full-lattice fused M psi = A psi - kappa D psi (+ i c g5 psi
+    with ``diag_twist``): the v2 full-lattice hop with the clover
+    diagonal read from the CENTER psi tile — no extra spinor operand.
+    gauge_pl (4,R,3,2,T,Z,YX), blk_pl (2,6,6,2,T,Z,YX), psi_pl
+    (4,3,2,T,Z,YX); layouts as ops/wilson_pallas_packed."""
+    from jax.experimental import pallas as pl
+
+    _, _, _, T, Z, YX = psi_pl.shape
+    R = gauge_pl.shape[1]
+    bz = block_z if block_z is not None else wpp._pick_bz(
+        Z, YX, psi_pl.dtype, planes=_planes(R, None, True))
+    if Z % bz != 0:
+        raise ValueError(f"block_z={bz} does not divide Z={Z}")
+    nzb = Z // bz
+    if gauge_bw is None:
+        gauge_bw = wpp.backward_gauge(gauge_pl, X)
+
+    def psi_spec(dt, dz):
+        return pl.BlockSpec(
+            (4, 3, 2, 1, bz, YX),
+            lambda t, zb, dt=dt, dz=dz: (0, 0, 0, (t + dt) % T,
+                                         (zb + dz) % nzb, 0))
+
+    gauge_spec = pl.BlockSpec(
+        (4, R, 3, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+    blk_spec = pl.BlockSpec(
+        (2, 6, 6, 2, 1, bz, YX), lambda t, zb: (0, 0, 0, 0, t, zb, 0))
+
+    kernel = _epilogue_kernel(X, bz, None, T, tb_sign,
+                              xc_mode="center", with_blk=True,
+                              twist=None, diag_twist=diag_twist,
+                              hop_coeff=-float(kappa))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(T, nzb),
+        in_specs=[psi_spec(0, 0), psi_spec(+1, 0), psi_spec(-1, 0),
+                  psi_spec(0, +1), psi_spec(0, -1), gauge_spec,
+                  gauge_spec, blk_spec],
+        out_specs=pl.BlockSpec((4, 3, 2, 1, bz, YX),
+                               lambda t, zb: (0, 0, 0, t, zb, 0)),
+        out_shape=jax.ShapeDtypeStruct(psi_pl.shape, psi_pl.dtype),
+        interpret=interpret,
+    )(psi_pl, psi_pl, psi_pl, psi_pl, psi_pl, gauge_pl, gauge_bw,
+      blk_pl)

@@ -236,6 +236,44 @@ _register("QUDA_TPU_STAGGERED_FORM", "choice", "auto",
           ("", "auto", "fused", "two_pass", "v3"),
           reference="dslash policy selection; tune.cpp:862 — policies "
                     "are timed, never assumed")
+_register("QUDA_TPU_CLOVER_FORM", "choice", "auto",
+          "clover PC pair-operator form: 'pallas' = the fused v2 "
+          "kernel with the resident 2x6x6 chiral clover blocks applied "
+          "in the kernel epilogue (ops/clover_pallas — diag+hop one "
+          "VMEM pass), 'xla' = the staged hop + einsum composition, "
+          "'auto' = race both via utils.tune at operator construction "
+          "and cache the winner per (volume, dtype).  Read at operator "
+          "construction only, hence NOT trace-safe",
+          ("", "auto", "pallas", "xla"),
+          reference="dslash policy selection; tune.cpp:862 — policies "
+                    "are timed, never assumed "
+                    "(dslash_wilson_clover_preconditioned.cu)",
+          trace_safe=False)
+_register("QUDA_TPU_TWISTED_FORM", "choice", "auto",
+          "twisted-mass / twisted-clover PC pair-operator form: "
+          "'pallas' = the fused v2 kernel with the in-register i mu "
+          "gamma5 twist (plus dense twisted-clover blocks) in the "
+          "kernel epilogue, 'xla' = the staged composition, 'auto' = "
+          "race and cache per (volume, dtype).  Nondegenerate "
+          "flavor-doublet operators always take the XLA composition "
+          "(the -b tau1 flavor mixing is not an epilogue term).  Read "
+          "at operator construction only, hence NOT trace-safe",
+          ("", "auto", "pallas", "xla"),
+          reference="dslash policy selection; tune.cpp:862 "
+                    "(dslash_twisted_clover_preconditioned.cu)",
+          trace_safe=False)
+_register("QUDA_TPU_DWF_FORM", "choice", "auto",
+          "domain-wall / Möbius 4d-hop form: 'pallas' = the Ls-batched "
+          "v2 kernel (ops/dwf_pallas — Ls innermost, gauge tile "
+          "fetched once per (t, z-block) while Ls spinor planes stream "
+          "through: 576+576/Ls B/site/plane), 'xla' = the vmap-over-s "
+          "stencil, 'auto' = race and cache per (volume, dtype, Ls). "
+          "The dense (Ls,Ls) m5 algebra stays XLA-batched either way. "
+          "Read at operator construction only, hence NOT trace-safe",
+          ("", "auto", "pallas", "xla"),
+          reference="dslash policy selection; tune.cpp:862 "
+                    "(dslash_domain_wall_m5.cuh batches s like rhs)",
+          trace_safe=False)
 _register("QUDA_TPU_DF64", "choice", "",
           "extended-precision (float32-pair) precise path for deep-tol "
           "Wilson CG: '1' = force, '0' = off, empty = auto (engaged when "

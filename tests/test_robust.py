@@ -327,6 +327,43 @@ def test_acceptance_pallas_build_failure_recovers(_api, monkeypatch):
     assert "solve_retry" in names and "fault_injected" in names
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("family", ["clover", "mobius"])
+def test_zoo_pallas_build_failure_recovers(_api, monkeypatch, family):
+    """Round-18 acceptance: the operator-zoo fused families inherit the
+    robustness ladder — a forced pallas-construction failure in the
+    clover / Möbius pair route degrades to the XLA rung and produces a
+    verified-converged solution (no new supervision code: the injection
+    fires in the shared _setup_hop, the ladder catches construct
+    errors family-agnostically)."""
+    from quda_tpu.interfaces.quda_api import invert_quda
+    L, tmp_path = _api
+    monkeypatch.setenv("QUDA_TPU_PALLAS", "1")
+    monkeypatch.setenv("QUDA_TPU_PACKED", "1")
+    qconf.reset_cache()
+    finj.arm("pallas_build", "1")
+    if family == "clover":
+        p = _wilson_param(dslash_type="clover", csw=1.0)
+        src = _rand_src(L)
+    else:
+        p = _wilson_param(dslash_type="mobius", Ls=4, m5=1.8,
+                          mass=0.04, b5=1.5, c5=0.5, tol=1e-5)
+        rng = np.random.default_rng(3)
+        src = (rng.standard_normal((4, L, L, L, L, 4, 3))
+               + 1j * rng.standard_normal((4, L, L, L, L, 4, 3))
+               ).astype(np.complex64)
+    x = invert_quda(src, p)
+    assert p.solve_status == "converged"
+    assert p.verified_res <= 100 * p.tol
+    assert np.isfinite(np.asarray(x)).all()
+    assert p.solve_attempts[0]["status"] == \
+        "construct_error:InjectedFault"
+    assert p.solve_attempts[1]["rung"] == "xla"
+    assert p.solve_attempts[1]["status"] == "converged"
+    names = [e["name"] for e in _trace_names(tmp_path)]
+    assert "solve_retry" in names and "fault_injected" in names
+
+
 def test_acceptance_residual_inflation_retries(_api):
     """A verification mismatch (solver claims converged, recomputed
     residual says otherwise) escalates instead of being served."""

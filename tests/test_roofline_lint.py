@@ -78,9 +78,27 @@ def _staggered_ops():
               long_eo_pp=None)
 
 
+def _zoo_ops():
+    """Operator-zoo sweep (round 18): every class-name family x fused/
+    staged x link storage x (for DWF) Ls — including the Ls values that
+    must fall back to the flops-only 'dwf_pallas' row."""
+    g18 = (np.zeros((4, 3, 3, 2, 2, 2, 4), np.float32),)
+    g12 = (np.zeros((4, 2, 3, 2, 2, 2, 4), np.float32),)
+    schur = ("DiracCloverPCPairs", "DiracTwistedMassPCPairs",
+             "DiracTwistedCloverPCPairs", "DiracNdegTwistedMassPCPairs")
+    for cls, form, g in itertools.product(schur, ("pallas", "xla", None),
+                                          (g18, g12)):
+        yield _mk(cls, _op_form=form, gauge_eo_pp=g)
+    for cls, form, ls in itertools.product(
+            ("DiracMobiusPCPairs", "DiracDomainWall5DPCPairs"),
+            ("pallas", "xla"), (4, 6, 8, 12, 16)):
+        yield _mk(cls, _op_form=form, gauge_eo_pp=g18, ls=ls)
+
+
 def test_solve_form_labels_have_models():
     missing = {}
-    for op in itertools.chain(_wilson_ops(), _staggered_ops()):
+    for op in itertools.chain(_wilson_ops(), _staggered_ops(),
+                              _zoo_ops()):
         form = _solve_form(op)
         if form not in orf.KERNEL_MODELS:
             missing.setdefault(form, type(op).__name__)
@@ -129,8 +147,32 @@ def test_mrhs_models_amortize_with_nrhs():
     and anchored to the single-RHS two-pass totals at N=1."""
     for form, n1 in (("staggered_mrhs", 1512.0),
                      ("staggered_fat_mrhs", 720.0),
-                     ("wilson_mrhs", 1152.0)):
+                     ("wilson_mrhs", 1152.0),
+                     ("clover_pallas_mrhs", 1728.0),
+                     ("twisted_mass_pallas_mrhs", 1152.0),
+                     ("twisted_clover_pallas_mrhs", 1728.0)):
         bps = orf.KERNEL_MODELS[form]["bytes_per_site"]
         assert callable(bps)
         assert bps(1) == n1
         assert bps(8) < bps(4) < bps(1)
+
+
+def test_zoo_fused_models_meet_round18_traffic_targets():
+    """Acceptance pins for the operator-zoo fused forms: one VMEM pass
+    means the fused diagonal adds only the resident block bytes over
+    the v2 hop (nothing for the static twist), and the Ls-batched DWF
+    hop amortizes the 576 B/site links to 576/Ls per plane."""
+    hop = orf.KERNEL_MODELS["wilson_v2"]["bytes_per_site"]
+    assert orf.KERNEL_MODELS["clover_pallas"]["bytes_per_site"] == hop + 576
+    assert (orf.KERNEL_MODELS["twisted_mass_pallas"]["bytes_per_site"]
+            == hop)
+    assert (orf.KERNEL_MODELS["twisted_clover_pallas"]["bytes_per_site"]
+            == orf.KERNEL_MODELS["clover_pallas"]["bytes_per_site"])
+    for ls, name in ((4, "dwf_ls4_pallas"), (8, "dwf_ls8_pallas")):
+        per_plane = orf.KERNEL_MODELS[name]["bytes_per_site"] / ls
+        assert per_plane == 576.0 + 576.0 / ls
+    # unregistered Ls and every staged composition stay flops-only or
+    # fully generic — no traffic claim without a matching kernel
+    for name in ("dwf_pallas", "dwf_xla", "clover_xla", "twisted_xla",
+                 "twisted_clover_xla", "dwf_ls8_pallas_mrhs"):
+        assert orf.KERNEL_MODELS[name]["bytes_per_site"] is None

@@ -1,0 +1,172 @@
+"""Fused twisted-mass / twisted-clover pallas kernels vs the staged XLA
+composition (interpret mode) — both twist signs, M and Mdag.
+
+The twist enters the fused kernels two ways: twisted mass as two STATIC
+scalars compiled into the K1/K2 epilogues (in-register rotation, zero
+traffic), twisted clover as the dense per-sign inverse blocks on K1
+plus blocks + rotation on K2.  Mdag exercises the OPPOSITE sign's
+parameters through the g5 M(-s) g5 template, so both elements of the
+tw_inv_q_pp pair are pinned."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from quda_tpu.fields.geometry import EVEN, ODD, LatticeGeometry
+from quda_tpu.fields.spinor import ColorSpinorField, even_odd_split
+from quda_tpu.fields.gauge import GaugeField
+from quda_tpu.models.twisted import DiracTwistedCloverPC, DiracTwistedMassPC
+from quda_tpu.ops import blas
+from quda_tpu.ops import wilson_packed as wpk
+
+GEOM = LatticeGeometry((4, 4, 4, 4))
+KAPPA = 0.12
+CSW = 1.1
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    g = GaugeField.random(jax.random.PRNGKey(40), GEOM).data.astype(
+        jnp.complex64)
+    psi = ColorSpinorField.gaussian(jax.random.PRNGKey(41),
+                                    GEOM).data.astype(jnp.complex64)
+    return g, psi
+
+
+def _rel(a, b):
+    a = a.astype(jnp.float32)
+    b = b.astype(jnp.float32)
+    return float(jnp.sqrt(blas.norm2(a - b) / blas.norm2(b)))
+
+
+def _both(dpc):
+    op_p = dpc.pairs(jnp.float32, use_pallas=True, pallas_interpret=True,
+                     form="pallas")
+    op_x = dpc.pairs(jnp.float32, use_pallas=True, pallas_interpret=True,
+                     form="xla")
+    assert op_p._op_form == "pallas" and op_x._op_form == "xla"
+    return op_p, op_x
+
+
+@pytest.mark.slow
+def test_k1_twist_kernel_matches_staged(cfg):
+    """The K1 fused kernel with the static twist epilogue alone: the
+    in-register scale*(v + i c g5 v) rotation == the staged twisted
+    inverse on the staged hop.  Slow with the rest of the kernel pins
+    (fused interpret compiles vs the tier-1 wall-clock budget — see
+    test_clover_pallas.py); the non-slow tier keeps the ndeg/label/
+    ledger wiring pins."""
+    from quda_tpu.models.twisted import _twist_inv_pairs
+    from quda_tpu.ops import clover_pallas as clp
+    from quda_tpu.ops import wilson_pallas_packed as wpp
+    from quda_tpu.ops.wilson import split_gauge_eo
+    g, psi = cfg
+    T, Z, Y, X = GEOM.lattice_shape
+    dims = (T, Z, Y, X)
+    parity = 0
+    a = 2.0 * KAPPA * 0.08
+    gauge_eo_pp = tuple(
+        wpk.to_packed_pairs(wpk.pack_gauge(geo), jnp.float32)
+        for geo in split_gauge_eo(g, GEOM))
+    _, po = even_odd_split(psi, GEOM)
+    src_pp = wpk.to_packed_pairs(wpk.pack_spinor(po), jnp.float32)
+    u_bw = wpp.backward_gauge_eo(gauge_eo_pp[1 - parity], dims, parity)
+    got = clp.dslash_eo_pallas_post(
+        gauge_eo_pp[parity], u_bw, src_pp, dims, parity,
+        twist=(-a, 1.0 / (1.0 + a * a)), interpret=True,
+        out_dtype=jnp.float32)
+    hop = wpk.dslash_eo_packed_pairs(gauge_eo_pp, src_pp, dims, parity)
+    ref = _twist_inv_pairs(hop.astype(jnp.float32), a, +1,
+                           out_dtype=jnp.float32)
+    assert _rel(got, ref) < 1e-6
+
+
+@pytest.mark.parametrize("mu", [0.08, -0.08])
+@pytest.mark.parametrize("matpc", [EVEN, ODD])
+@pytest.mark.slow
+def test_twisted_mass_fused_matches_staged(cfg, mu, matpc):
+    g, psi = cfg
+    op_p, op_x = _both(DiracTwistedMassPC(g, GEOM, KAPPA, mu,
+                                          matpc=matpc))
+    pe, po = even_odd_split(psi, GEOM)
+    x = pe if matpc == EVEN else po
+    xp = wpk.to_packed_pairs(wpk.pack_spinor(x), jnp.float32)
+    for fn in ("M_pairs", "Mdag_pairs"):
+        assert _rel(getattr(op_p, fn)(xp),
+                    getattr(op_x, fn)(xp)) < 1e-6, (fn, mu)
+
+
+@pytest.mark.parametrize("mu", [0.08, -0.08])
+@pytest.mark.slow
+def test_twisted_clover_fused_matches_staged(cfg, mu):
+    g, psi = cfg
+    op_p, op_x = _both(DiracTwistedCloverPC(g, GEOM, KAPPA, mu, CSW))
+    pe, _ = even_odd_split(psi, GEOM)
+    xp = wpk.to_packed_pairs(wpk.pack_spinor(pe), jnp.float32)
+    for fn in ("M_pairs", "Mdag_pairs"):
+        assert _rel(getattr(op_p, fn)(xp),
+                    getattr(op_x, fn)(xp)) < 1e-6, (fn, mu)
+
+
+@pytest.mark.slow
+def test_twisted_mass_fused_mrhs_matches_staged(cfg):
+    g, psi = cfg
+    op_p, op_x = _both(DiracTwistedMassPC(g, GEOM, KAPPA, 0.08))
+    pe, _ = even_odd_split(psi, GEOM)
+    xp = wpk.to_packed_pairs(wpk.pack_spinor(pe), jnp.float32)
+    xb = jnp.stack([xp, -0.5 * xp])
+    assert _rel(op_p.M_pairs_mrhs(xb), op_x.M_pairs_mrhs(xb)) < 1e-6
+
+
+@pytest.mark.slow
+def test_twisted_clover_fused_mrhs_matches_staged(cfg):
+    g, psi = cfg
+    op_p, op_x = _both(DiracTwistedCloverPC(g, GEOM, KAPPA, 0.08, CSW))
+    pe, _ = even_odd_split(psi, GEOM)
+    xp = wpk.to_packed_pairs(wpk.pack_spinor(pe), jnp.float32)
+    xb = jnp.stack([xp, xp[::-1]])
+    assert _rel(op_p.Mdag_pairs_mrhs(xb),
+                op_x.Mdag_pairs_mrhs(xb)) < 1e-6
+
+
+def test_ndeg_doublet_stays_staged(cfg):
+    """The non-degenerate doublet keeps the staged composition (the
+    -b tau_1 flavor mixing is not a per-plane epilogue term): resolve
+    must land on 'xla' even when 'pallas' is requested."""
+    from quda_tpu.models.twisted import DiracNdegTwistedMassPC
+    g, _ = cfg
+    dpc = DiracNdegTwistedMassPC(g, GEOM, KAPPA, 0.08, 0.05)
+    op = dpc.pairs(jnp.float32, use_pallas=True, pallas_interpret=True,
+                   form="pallas")
+    assert op._op_form == "xla"
+
+
+def test_solve_form_labels(cfg):
+    """Label order pins: 'twistedclover' resolves before 'twisted'
+    before 'clover'; ndeg stays on the flops-only xla row."""
+    from quda_tpu.interfaces.quda_api import _solve_form
+    from quda_tpu.models.twisted import DiracNdegTwistedMassPC
+    from quda_tpu.obs.roofline import KERNEL_MODELS
+    g, _ = cfg
+    tm_p, tm_x = _both(DiracTwistedMassPC(g, GEOM, KAPPA, 0.08))
+    tc_p, tc_x = _both(DiracTwistedCloverPC(g, GEOM, KAPPA, 0.08, CSW))
+    nd = DiracNdegTwistedMassPC(g, GEOM, KAPPA, 0.08, 0.05).pairs(
+        jnp.float32, use_pallas=True, pallas_interpret=True)
+    labels = {_solve_form(tm_p): "twisted_mass_pallas",
+              _solve_form(tm_x): "twisted_xla",
+              _solve_form(tc_p): "twisted_clover_pallas",
+              _solve_form(tc_x): "twisted_clover_xla",
+              _solve_form(nd): "twisted_xla"}
+    for got, want in labels.items():
+        assert got == want
+        assert got in KERNEL_MODELS
+
+
+def test_tw_clover_blocks_in_hbm_ledger(cfg):
+    """Both twisted-clover inverse block signs + A_p live in the HBM
+    ledger under the clover family."""
+    from quda_tpu.obs import memory as omem
+    g, _ = cfg
+    _both(DiracTwistedCloverPC(g, GEOM, KAPPA, 0.08, CSW))
+    rows = {(r["family"], r["field"]) for r in omem.ledger()}
+    assert ("clover", "tw_clover_pair_blocks") in rows
